@@ -1,0 +1,79 @@
+"""Engine configuration.
+
+The reference configures each node with a single `Config` struct validated at
+construction (reference: raft.go:124-336). The TPU engine splits that into:
+
+- `Shape`: the *static* capacities that determine array shapes and therefore
+  XLA program identity. Changing any of these recompiles the step kernel.
+  These are the reference's unbounded dynamic structures pinned to fixed
+  sizes, per SURVEY §7 ("the reference's own size limits become the static
+  shapes").
+- `LaneConfig` (see state.py): per-lane *dynamic* tunables (election ticks,
+  feature flags, byte limits). Kept as device arrays so heterogeneous
+  per-group configs never trigger a recompile — the batched analog of the
+  reference constructing each node with its own Config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Shape:
+    """Static capacities of the batched engine.
+
+    Attributes:
+      n_lanes: number of raft nodes hosted in this batch ("N"). For an
+        in-process simulated cluster this is groups*voters; for a production
+        shard it is the number of group-members homed on this host.
+      max_peers: max voters+learners per group ("V"). The reference
+        optimizes for <=7 voters (quorum/majority.go:137-141); 8 keeps the
+        lane count a power of two with learner headroom.
+      log_window: entries resident on device per lane ("W", circular).
+        Mirrors the bounded in-memory log the reference keeps between
+        compactions (storage.go:98-120 + log_unstable.go); older entries
+        live host-side. Must be a power of two.
+      max_msg_entries: entries carried per MsgApp ("E") — the static-shape
+        version of Config.MaxSizePerMsg's "limit in entries" role
+        (reference: raft.go:188-192).
+      max_inflight: per-peer in-flight MsgApp window ("F") — the static
+        capacity of tracker.Inflights (reference: tracker/inflights.go:28-40,
+        Config.MaxInflightMsgs raft.go:211-215).
+      outbox: max messages one lane can emit from a single step call. A
+        leader stepping one message can fan out at most one MsgApp/heartbeat
+        per peer plus a self-ack and a commit-triggered re-broadcast.
+    """
+
+    n_lanes: int
+    max_peers: int = 8
+    log_window: int = 64
+    max_msg_entries: int = 8
+    max_inflight: int = 8
+    outbox: int = 0  # 0 -> derived
+
+    def __post_init__(self):
+        if self.log_window & (self.log_window - 1):
+            raise ValueError("log_window must be a power of two")
+        if self.outbox == 0:
+            object.__setattr__(self, "outbox", 2 * self.max_peers + 2)
+
+    @property
+    def n(self) -> int:
+        return self.n_lanes
+
+    @property
+    def v(self) -> int:
+        return self.max_peers
+
+    @property
+    def w(self) -> int:
+        return self.log_window
+
+
+# Defaults mirroring reference raft.go:288-336 validate() fallbacks.
+DEFAULT_ELECTION_TICK = 10
+DEFAULT_HEARTBEAT_TICK = 1
+DEFAULT_MAX_SIZE_PER_MSG = 1 << 20
+DEFAULT_MAX_UNCOMMITTED_SIZE = 1 << 30
+DEFAULT_MAX_COMMITTED_SIZE_PER_READY = 1 << 20

@@ -1,0 +1,138 @@
+"""SoA message batches.
+
+The reference's universal `raftpb.Message` (reference: raftpb/raft.proto:71-108)
+becomes a struct-of-arrays batch with a fixed per-message entry capacity E.
+Entry payload bytes never ride in device messages — an entry is globally
+identified by (group, index, term), so receivers resolve payloads from the
+host-side store; the device only needs (term, type, size) columns, which is
+everything the algorithm reads (reference: log.go:109-456).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.types import MessageType
+
+I32 = jnp.int32
+
+
+def _dc(cls):
+    fields = [f.name for f in dataclasses.fields(cls)]
+    return jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class MsgBatch:
+    """A batch of messages with arbitrary leading shape [...].
+
+    Field semantics match raftpb.Message (reference: raftpb/raft.proto:71-108).
+    `type == MSG_NONE` marks an empty slot.
+    """
+
+    type: Any  # [...] i32
+    to: Any  # [...] i32 raft id (within destination group)
+    frm: Any  # [...] i32 ("from" is a Python keyword)
+    term: Any  # [...] i32
+    log_term: Any  # [...] i32
+    index: Any  # [...] i32
+    commit: Any  # [...] i32
+    vote: Any  # [...] i32
+    reject: Any  # [...] bool
+    reject_hint: Any  # [...] i32
+    context: Any  # [...] i32 (read-index ctx ticket / campaign-transfer flag)
+    # Entries [..., E]: index of entry k is msg.index + 1 + k.
+    n_ents: Any  # [...] i32
+    ent_term: Any  # [..., E] i32
+    ent_type: Any  # [..., E] i32
+    ent_bytes: Any  # [..., E] i32
+    # MsgSnap metadata (snapshot *data* + ConfState ride host-side).
+    snap_index: Any  # [...] i32
+    snap_term: Any  # [...] i32
+
+    @property
+    def batch_shape(self):
+        return self.type.shape
+
+    @property
+    def is_present(self):
+        return self.type != MessageType.MSG_NONE
+
+    def at(self, *idx) -> "MsgBatch":
+        return jax.tree.map(lambda x: x[idx], self)
+
+
+def empty_batch(batch_shape: tuple[int, ...], max_entries: int) -> MsgBatch:
+    z = jnp.zeros(batch_shape, I32)
+    ze = jnp.zeros((*batch_shape, max_entries), I32)
+    return MsgBatch(
+        type=jnp.full(batch_shape, MessageType.MSG_NONE, I32),
+        to=z,
+        frm=z,
+        term=z,
+        log_term=z,
+        index=z,
+        commit=z,
+        vote=z,
+        reject=jnp.zeros(batch_shape, jnp.bool_),
+        reject_hint=z,
+        context=z,
+        n_ents=z,
+        ent_term=ze,
+        ent_type=ze,
+        ent_bytes=ze,
+        snap_index=z,
+        snap_term=z,
+    )
+
+
+def make_msg(
+    max_entries: int,
+    type: int,
+    to: int = 0,
+    frm: int = 0,
+    term: int = 0,
+    log_term: int = 0,
+    index: int = 0,
+    commit: int = 0,
+    vote: int = 0,
+    reject: bool = False,
+    reject_hint: int = 0,
+    context: int = 0,
+    ent_terms=(),
+    ent_types=None,
+    ent_sizes=None,
+    snap_index: int = 0,
+    snap_term: int = 0,
+) -> MsgBatch:
+    """Build a single (scalar batch shape) message, mostly for tests/host."""
+    n = len(ent_terms)
+    if n > max_entries:
+        raise ValueError(f"{n} entries > capacity {max_entries}")
+    ent_types = list(ent_types) if ent_types is not None else [0] * n
+    ent_sizes = list(ent_sizes) if ent_sizes is not None else [0] * n
+    pad = [0] * (max_entries - n)
+    return MsgBatch(
+        type=jnp.asarray(type, I32),
+        to=jnp.asarray(to, I32),
+        frm=jnp.asarray(frm, I32),
+        term=jnp.asarray(term, I32),
+        log_term=jnp.asarray(log_term, I32),
+        index=jnp.asarray(index, I32),
+        commit=jnp.asarray(commit, I32),
+        vote=jnp.asarray(vote, I32),
+        reject=jnp.asarray(reject, jnp.bool_),
+        reject_hint=jnp.asarray(reject_hint, I32),
+        context=jnp.asarray(context, I32),
+        n_ents=jnp.asarray(n, I32),
+        ent_term=jnp.asarray(list(ent_terms) + pad, I32),
+        ent_type=jnp.asarray(ent_types + pad, I32),
+        ent_bytes=jnp.asarray(ent_sizes + pad, I32),
+        snap_index=jnp.asarray(snap_index, I32),
+        snap_term=jnp.asarray(snap_term, I32),
+    )

@@ -1,0 +1,100 @@
+"""Quorum math as batched reductions over a trailing voter axis.
+
+Reference semantics (quorum/majority.go, quorum/joint.go):
+
+- `MajorityConfig.CommittedIndex` collects each voter's acked index, sorts,
+  and picks element n-(n/2+1) — i.e. the (n/2+1)-th *largest*
+  (quorum/majority.go:126-172). Empty config yields MaxUint64, the identity
+  element that makes the joint min() reduce correctly (majority.go:129-131).
+- `MajorityConfig.VoteResult` counts yes/missing vs q=n/2+1 → Won/Pending/Lost
+  (majority.go:178-207); empty config → Won (180-184).
+- `JointConfig` = elementwise min of the two committed indexes
+  (joint.go:49-56) and AND of the two vote results (joint.go:61-75).
+
+Here a voter set is a boolean mask over V slots; all functions broadcast over
+arbitrary leading batch dims and reduce the trailing V axis — the [groups x
+voters] kernels named in BASELINE.json. V<=8, so XLA lowers jnp.sort to a
+fixed sorting network; no dynamic shapes anywhere.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.types import VoteResult, VoteState
+
+I32 = jnp.int32
+# Identity element standing in for the reference's MaxUint64 (majority.go:129).
+COMMITTED_INF = jnp.int32(2**31 - 1)
+
+
+def quorum_size(mask):
+    """q = n/2 + 1 over the trailing voter axis. [..., V] -> [...]"""
+    n = jnp.sum(mask.astype(I32), axis=-1)
+    return n // 2 + 1
+
+
+def majority_committed(match, mask):
+    """(n/2+1)-th largest acked index among masked voters; INF if mask empty.
+
+    match: [..., V] i32 acked (Match) indexes; mask: [..., V] bool voter set.
+    reference: quorum/majority.go:126-172.
+    """
+    n = jnp.sum(mask.astype(I32), axis=-1)
+    q = n // 2 + 1
+    # Non-voters sort below every real acked index (acked >= 0).
+    vals = jnp.where(mask, match, -1)
+    srt = jnp.sort(vals, axis=-1)  # ascending over V
+    v = match.shape[-1]
+    # reference picks srt[n - q] of the n-ascending array; our array has
+    # (V - n) pad values of -1 in front, so the same element is srt[V - q].
+    idx = jnp.clip(v - q, 0, v - 1)
+    picked = jnp.take_along_axis(srt, idx[..., None], axis=-1)[..., 0]
+    return jnp.where(n == 0, COMMITTED_INF, picked)
+
+
+def majority_vote(votes, mask):
+    """VoteResult over the trailing voter axis.
+
+    votes: [..., V] i32 VoteState (PENDING/GRANTED/REJECTED); mask: voter set.
+    reference: quorum/majority.go:178-207.
+    """
+    n = jnp.sum(mask.astype(I32), axis=-1)
+    q = n // 2 + 1
+    granted = jnp.sum((mask & (votes == VoteState.GRANTED)).astype(I32), axis=-1)
+    missing = jnp.sum((mask & (votes == VoteState.PENDING)).astype(I32), axis=-1)
+    won = granted >= q
+    pending = granted + missing >= q
+    res = jnp.where(
+        won,
+        jnp.int32(VoteResult.VOTE_WON),
+        jnp.where(pending, jnp.int32(VoteResult.VOTE_PENDING), jnp.int32(VoteResult.VOTE_LOST)),
+    )
+    return jnp.where(n == 0, jnp.int32(VoteResult.VOTE_WON), res)
+
+
+def joint_committed(match, mask_in, mask_out):
+    """min of the two halves' committed indexes. reference: quorum/joint.go:49-56."""
+    return jnp.minimum(
+        majority_committed(match, mask_in), majority_committed(match, mask_out)
+    )
+
+
+def joint_vote(votes, mask_in, mask_out):
+    """Both halves must win; either Lost loses. reference: quorum/joint.go:61-75."""
+    r1 = majority_vote(votes, mask_in)
+    r2 = majority_vote(votes, mask_out)
+    both = jnp.maximum(r1, r2)  # WON=1 < LOST=2 < PENDING=3
+    # maximum gives LOST priority over WON but PENDING over LOST; fix the
+    # (Lost, Pending) combination which must be Lost (joint.go:67-71).
+    any_lost = (r1 == VoteResult.VOTE_LOST) | (r2 == VoteResult.VOTE_LOST)
+    return jnp.where(any_lost, jnp.int32(VoteResult.VOTE_LOST), both)
+
+
+def joint_active(active, mask_in, mask_out):
+    """CheckQuorum liveness: treat RecentActive as votes and require a joint
+    win. reference: tracker/tracker.go:217-227."""
+    votes = jnp.where(
+        active, jnp.int32(VoteState.GRANTED), jnp.int32(VoteState.REJECTED)
+    )
+    return joint_vote(votes, mask_in, mask_out) == VoteResult.VOTE_WON

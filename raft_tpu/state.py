@@ -1,0 +1,259 @@
+"""Device-resident batched raft state.
+
+One *lane* == one raft node (one member of one raft group), mirroring the
+reference `raft` struct (reference: raft.go:338-430) flattened into arrays
+batched over the lane axis N, per SURVEY §7's state layout:
+
+- `[N]` per-node scalars (term, vote, lead, role, tick counters, ...)
+- `[N, V]` per-peer progress/vote lanes (reference: tracker/progress.go:30-98,
+  tracker/tracker.go:117-126)
+- `[N, V, F]` inflight rings (reference: tracker/inflights.go:28-40)
+- `[N, W]` columnar circular log window of (term, type, size) — the merged
+  raftLog/unstable/MemoryStorage metadata view (reference: log.go:24-63,
+  log_unstable.go:33-50, storage.go:98-120). Entry *payloads* never live on
+  device; they are keyed host-side by (group, index, term).
+
+Everything is int32/bool_: TPUs have no fast int64 path and every decision in
+the reference log layer reads only Term/Index/size (log.go:109-456).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import (
+    DEFAULT_ELECTION_TICK,
+    DEFAULT_HEARTBEAT_TICK,
+    DEFAULT_MAX_COMMITTED_SIZE_PER_READY,
+    DEFAULT_MAX_SIZE_PER_MSG,
+    DEFAULT_MAX_UNCOMMITTED_SIZE,
+    Shape,
+)
+from raft_tpu.types import StateType
+
+I32 = jnp.int32
+BOOL = jnp.bool_
+
+
+def _dc(cls):
+    """Register a dataclass whose fields are all pytree data."""
+    fields = [f.name for f in dataclasses.fields(cls)]
+    return jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class LaneConfig:
+    """Per-lane dynamic tunables — the batched `Config` (reference:
+    raft.go:124-286). Device arrays so heterogeneous groups share one compiled
+    program."""
+
+    election_tick: Any  # [N] i32
+    heartbeat_tick: Any  # [N] i32
+    max_size_per_msg: Any  # [N] i32, bytes per MsgApp (raft.go:188)
+    max_uncommitted_size: Any  # [N] i32 (raft.go:200-204)
+    max_committed_size_per_ready: Any  # [N] i32 (raft.go:193-199)
+    max_inflight_bytes: Any  # [N] i32 (raft.go:216-220)
+    check_quorum: Any  # [N] bool (raft.go:221-225)
+    pre_vote: Any  # [N] bool (raft.go:226-229)
+    read_only_lease_based: Any  # [N] bool (raft.go:230-240)
+    disable_proposal_forwarding: Any  # [N] bool (raft.go:257-265)
+    step_down_on_removal: Any  # [N] bool (raft.go:272-276)
+    disable_conf_change_validation: Any  # [N] bool (raft.go:266-271)
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class RaftState:
+    """The complete batched state machine. All arrays leading dim N."""
+
+    # --- identity & role (reference: raft.go:338-430) ---
+    id: Any  # [N] i32: this node's raft id within its group
+    term: Any  # [N] i32
+    vote: Any  # [N] i32
+    state: Any  # [N] i32 StateType
+    lead: Any  # [N] i32
+    lead_transferee: Any  # [N] i32 (raft.go:398)
+    is_learner: Any  # [N] bool (raft.go:356)
+    pending_conf_index: Any  # [N] i32 (raft.go:390-394)
+    uncommitted_size: Any  # [N] i32 payload bytes (raft.go:396, 2033-2047)
+
+    # --- tick machinery (reference: raft.go:400-421, 823-862, 1984-1990) ---
+    election_elapsed: Any  # [N] i32
+    heartbeat_elapsed: Any  # [N] i32
+    randomized_election_timeout: Any  # [N] i32
+    rng: Any  # [N] u32 per-lane LCG state (replaces lockedRand, raft.go:89-102)
+
+    # --- log window (reference: log.go:24-63 + log_unstable.go + storage.go) ---
+    # Entry index i occupies slot i & (W-1) when snap_index < i <= last.
+    log_term: Any  # [N, W] i32
+    log_type: Any  # [N, W] i32 EntryType
+    log_bytes: Any  # [N, W] i32 payload size
+    last: Any  # [N] i32 lastIndex
+    stabled: Any  # [N] i32 highest index durably persisted (unstable.offset-1)
+    committed: Any  # [N] i32
+    applying: Any  # [N] i32 (log.go:45-57)
+    applied: Any  # [N] i32
+    snap_index: Any  # [N] i32 compaction point: firstIndex = snap_index+1
+    snap_term: Any  # [N] i32
+    # In-flight incoming snapshot (unstable.snapshot, log_unstable.go:38-40):
+    pending_snap_index: Any  # [N] i32 (0 = none)
+    pending_snap_term: Any  # [N] i32
+
+    # --- membership (reference: tracker/tracker.go:27-78) ---
+    # Slot-major: peer slot j of lane n describes group-member prs_id[n, j].
+    # Slot 0 is always the lane's own id when it is part of the config.
+    prs_id: Any  # [N, V] i32 (0 = empty slot)
+    voters_in: Any  # [N, V] bool — incoming (main) voter set
+    voters_out: Any  # [N, V] bool — outgoing set when in joint consensus
+    learners: Any  # [N, V] bool
+    learners_next: Any  # [N, V] bool
+    auto_leave: Any  # [N] bool
+
+    # --- per-peer progress (reference: tracker/progress.go:30-98) ---
+    pr_match: Any  # [N, V] i32
+    pr_next: Any  # [N, V] i32
+    pr_state: Any  # [N, V] i32 ProgressState
+    pr_pending_snapshot: Any  # [N, V] i32
+    pr_recent_active: Any  # [N, V] bool
+    pr_msg_app_flow_paused: Any  # [N, V] bool
+    # votes (reference: tracker/tracker.go:121 Votes map)
+    votes: Any  # [N, V] i32 VoteState
+
+    # --- inflights ring (reference: tracker/inflights.go:28-40) ---
+    infl_index: Any  # [N, V, F] i32
+    infl_bytes: Any  # [N, V, F] i32
+    infl_start: Any  # [N, V] i32
+    infl_count: Any  # [N, V] i32
+    infl_total_bytes: Any  # [N, V] i32
+
+    cfg: LaneConfig
+
+    # Convenience views ----------------------------------------------------
+    @property
+    def first_index(self):
+        """reference: log.go firstIndex == snapshot index + 1."""
+        return self.snap_index + 1
+
+    def slot(self, index):
+        w = self.log_term.shape[-1]
+        return index & (w - 1)
+
+
+def make_lane_config(shape: Shape, **overrides) -> LaneConfig:
+    n = shape.n
+
+    def full(val, dtype=I32):
+        return jnp.full((n,), val, dtype=dtype)
+
+    defaults = dict(
+        election_tick=full(DEFAULT_ELECTION_TICK),
+        heartbeat_tick=full(DEFAULT_HEARTBEAT_TICK),
+        max_size_per_msg=full(DEFAULT_MAX_SIZE_PER_MSG),
+        max_uncommitted_size=full(DEFAULT_MAX_UNCOMMITTED_SIZE),
+        max_committed_size_per_ready=full(DEFAULT_MAX_COMMITTED_SIZE_PER_READY),
+        max_inflight_bytes=full(2**30),
+        check_quorum=full(False, BOOL),
+        pre_vote=full(False, BOOL),
+        read_only_lease_based=full(False, BOOL),
+        disable_proposal_forwarding=full(False, BOOL),
+        step_down_on_removal=full(False, BOOL),
+        disable_conf_change_validation=full(False, BOOL),
+    )
+    for k, v in overrides.items():
+        base = defaults[k]
+        defaults[k] = jnp.broadcast_to(jnp.asarray(v, base.dtype), base.shape)
+    return LaneConfig(**defaults)
+
+
+def init_state(
+    shape: Shape,
+    ids: np.ndarray,
+    peer_ids: np.ndarray,
+    peer_is_learner: np.ndarray | None = None,
+    seed: int = 1,
+    cfg: LaneConfig | None = None,
+) -> RaftState:
+    """Fresh boot state: every lane a term-0(-becomes-1 on first tick)
+    follower with an empty log, mirroring newRaft + becomeFollower(1, None)
+    (reference: raft.go:432-477). Bootstrap entries (bootstrap.go:30-80) are
+    applied by the host-side bootstrap helper, not here.
+
+    Args:
+      ids: [N] this-node raft ids.
+      peer_ids: [N, V] group membership per lane, 0-padded, own id included.
+      peer_is_learner: [N, V] bool learner mask.
+    """
+    n, v, w = shape.n, shape.v, shape.w
+    f = shape.max_inflight
+    ids = np.asarray(ids, np.int32)
+    peer_ids = np.asarray(peer_ids, np.int32)
+    if peer_ids.shape != (n, v):
+        raise ValueError(f"peer_ids must be [{n},{v}], got {peer_ids.shape}")
+    if peer_is_learner is None:
+        peer_is_learner = np.zeros((n, v), bool)
+    present = peer_ids != 0
+    voters_in = present & ~peer_is_learner
+    self_slot = peer_ids == ids[:, None]
+    own_learner = (peer_is_learner & self_slot).any(axis=1)
+
+    zeros_n = jnp.zeros((n,), I32)
+    zeros_nv = jnp.zeros((n, v), I32)
+
+    rng = (np.uint32(seed) * np.uint32(2654435761) + np.arange(n, dtype=np.uint32)) | np.uint32(1)
+
+    return RaftState(
+        id=jnp.asarray(ids),
+        term=zeros_n,
+        vote=zeros_n,
+        state=jnp.full((n,), StateType.FOLLOWER, I32),
+        lead=zeros_n,
+        lead_transferee=zeros_n,
+        is_learner=jnp.asarray(own_learner),
+        pending_conf_index=zeros_n,
+        uncommitted_size=zeros_n,
+        election_elapsed=zeros_n,
+        heartbeat_elapsed=zeros_n,
+        # becomeFollower resets this on first real transition; init like
+        # newRaft's becomeFollower call by sampling below via reset in step 0.
+        randomized_election_timeout=jnp.asarray(
+            DEFAULT_ELECTION_TICK + (rng % np.uint32(DEFAULT_ELECTION_TICK)).astype(np.int32)
+        ),
+        rng=jnp.asarray(rng),
+        log_term=jnp.zeros((n, w), I32),
+        log_type=jnp.zeros((n, w), I32),
+        log_bytes=jnp.zeros((n, w), I32),
+        last=zeros_n,
+        stabled=zeros_n,
+        committed=zeros_n,
+        applying=zeros_n,
+        applied=zeros_n,
+        snap_index=zeros_n,
+        snap_term=zeros_n,
+        pending_snap_index=zeros_n,
+        pending_snap_term=zeros_n,
+        prs_id=jnp.asarray(peer_ids),
+        voters_in=jnp.asarray(voters_in),
+        voters_out=jnp.zeros((n, v), BOOL),
+        learners=jnp.asarray(peer_is_learner & present),
+        learners_next=jnp.zeros((n, v), BOOL),
+        auto_leave=jnp.zeros((n,), BOOL),
+        pr_match=zeros_nv,
+        pr_next=jnp.ones((n, v), I32),
+        pr_state=zeros_nv,
+        pr_pending_snapshot=zeros_nv,
+        pr_recent_active=jnp.zeros((n, v), BOOL),
+        pr_msg_app_flow_paused=jnp.zeros((n, v), BOOL),
+        votes=zeros_nv,
+        infl_index=jnp.zeros((n, v, f), I32),
+        infl_bytes=jnp.zeros((n, v, f), I32),
+        infl_start=zeros_nv,
+        infl_count=zeros_nv,
+        infl_total_bytes=zeros_nv,
+        cfg=cfg if cfg is not None else make_lane_config(shape),
+    )

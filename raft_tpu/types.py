@@ -1,0 +1,149 @@
+"""Wire-level enums and constants for the TPU-native multi-raft engine.
+
+Numbering is kept bit-compatible with the reference protobuf definitions
+(reference: raftpb/raft.proto:15-69, raftpb/raft.proto:110-135) so that state
+dumps, goldens, and any future interop shim agree with `go.etcd.io/raft/v3`
+without translation tables.
+
+Unlike the reference (uint64 everywhere), the device engine uses int32 for
+terms/indexes/ids: TPUs have no fast 64-bit integer path, and 2^31 log entries
+per group is far beyond the device-resident window this engine keeps anyway.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class EntryType(enum.IntEnum):
+    # reference: raftpb/raft.proto:15-19
+    ENTRY_NORMAL = 0
+    ENTRY_CONF_CHANGE = 1
+    ENTRY_CONF_CHANGE_V2 = 2
+
+
+class MessageType(enum.IntEnum):
+    # reference: raftpb/raft.proto:41-69
+    MSG_HUP = 0
+    MSG_BEAT = 1
+    MSG_PROP = 2
+    MSG_APP = 3
+    MSG_APP_RESP = 4
+    MSG_VOTE = 5
+    MSG_VOTE_RESP = 6
+    MSG_SNAP = 7
+    MSG_HEARTBEAT = 8
+    MSG_HEARTBEAT_RESP = 9
+    MSG_UNREACHABLE = 10
+    MSG_SNAP_STATUS = 11
+    MSG_CHECK_QUORUM = 12
+    MSG_TRANSFER_LEADER = 13
+    MSG_TIMEOUT_NOW = 14
+    MSG_READ_INDEX = 15
+    MSG_READ_INDEX_RESP = 16
+    MSG_PRE_VOTE = 17
+    MSG_PRE_VOTE_RESP = 18
+    MSG_STORAGE_APPEND = 19
+    MSG_STORAGE_APPEND_RESP = 20
+    MSG_STORAGE_APPLY = 21
+    MSG_STORAGE_APPLY_RESP = 22
+    MSG_FORGET_LEADER = 23
+    # Sentinel for an empty message slot in an SoA batch (not a wire type).
+    MSG_NONE = 63
+
+
+class StateType(enum.IntEnum):
+    # reference: raft.go:47-53
+    FOLLOWER = 0
+    CANDIDATE = 1
+    LEADER = 2
+    PRE_CANDIDATE = 3
+
+
+class ProgressState(enum.IntEnum):
+    # reference: tracker/state.go:20-34
+    PROBE = 0
+    REPLICATE = 1
+    SNAPSHOT = 2
+
+
+class VoteState(enum.IntEnum):
+    """Per-voter recorded vote (reference: tracker/tracker.go:260-290 keeps a
+    map[id]bool; we keep a ternary lane so 'not yet voted' is representable)."""
+
+    PENDING = 0
+    GRANTED = 1
+    REJECTED = 2
+
+
+class VoteResult(enum.IntEnum):
+    # reference: quorum/quorum.go:48-58
+    VOTE_WON = 1
+    VOTE_LOST = 2
+    VOTE_PENDING = 3
+
+
+class ReadOnlyOption(enum.IntEnum):
+    # reference: raft.go:56-68
+    READ_ONLY_SAFE = 0
+    READ_ONLY_LEASE_BASED = 1
+
+
+class CampaignType(enum.IntEnum):
+    """Reference uses strings (raft.go:71-81); the device engine needs ints."""
+
+    PRE_ELECTION = 0
+    ELECTION = 1
+    TRANSFER = 2
+
+
+# reference: raft.go:36-45 — placeholder node id ("None") and the async-storage
+# thread pseudo-ids. We keep None == 0; storage threads get negative ids since
+# the device engine is int32.
+NO_NODE = 0
+LOCAL_APPEND_THREAD = -1
+LOCAL_APPLY_THREAD = -2
+
+# Terms/indexes use 0 as "invalid/none", matching the reference where the
+# dummy entry at index 0 has term 0 (storage.go:98-120).
+NO_TERM = 0
+NO_INDEX = 0
+
+# Messages from this set are never sent over the "network"; they are local
+# inputs (reference: util.go:29-46).
+LOCAL_MSGS = frozenset(
+    {
+        MessageType.MSG_HUP,
+        MessageType.MSG_BEAT,
+        MessageType.MSG_UNREACHABLE,
+        MessageType.MSG_SNAP_STATUS,
+        MessageType.MSG_CHECK_QUORUM,
+        MessageType.MSG_STORAGE_APPEND,
+        MessageType.MSG_STORAGE_APPEND_RESP,
+        MessageType.MSG_STORAGE_APPLY,
+        MessageType.MSG_STORAGE_APPLY_RESP,
+    }
+)
+
+# reference: util.go:48-63
+RESPONSE_MSGS = frozenset(
+    {
+        MessageType.MSG_APP_RESP,
+        MessageType.MSG_VOTE_RESP,
+        MessageType.MSG_HEARTBEAT_RESP,
+        MessageType.MSG_UNREACHABLE,
+        MessageType.MSG_READ_INDEX_RESP,
+        MessageType.MSG_PRE_VOTE_RESP,
+        MessageType.MSG_STORAGE_APPEND_RESP,
+        MessageType.MSG_STORAGE_APPLY_RESP,
+    }
+)
+
+
+def vote_resp_msg_type(t: MessageType) -> MessageType:
+    """reference: util.go:70-79"""
+    if t == MessageType.MSG_VOTE:
+        return MessageType.MSG_VOTE_RESP
+    if t == MessageType.MSG_PRE_VOTE:
+        return MessageType.MSG_PRE_VOTE_RESP
+    raise ValueError(f"not a vote message: {t}")

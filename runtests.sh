@@ -1,0 +1,10 @@
+#!/bin/bash
+# Run the test suite on a virtual 8-device CPU mesh.
+#
+# This image injects an axon PJRT hook via sitecustomize that dials the
+# (single) remote TPU on every interpreter start; unsetting
+# PALLAS_AXON_POOL_IPS disables the hook so CPU-only test runs don't
+# serialize on the chip claim.
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python -m pytest "${@:-tests/}" -x -q

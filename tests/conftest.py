@@ -1,0 +1,12 @@
+"""Test config: run everything on a virtual 8-device CPU mesh so sharding
+tests exercise real collectives without TPU hardware (driver benches run the
+same code on the real chip)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

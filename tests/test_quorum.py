@@ -1,0 +1,161 @@
+"""Quorum kernel tests.
+
+Mirrors the reference's strategy of checking the optimized implementation
+against an independent "dumb" alternative (reference: quorum/quick_test.go:28,
+alternativeMajorityCommittedIndex at quick_test.go:85) plus hand cases in the
+spirit of quorum/testdata — re-derived, not copied.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.ops import quorum
+from raft_tpu.types import VoteResult, VoteState
+
+INF = int(quorum.COMMITTED_INF)
+
+
+def dumb_committed(match, mask):
+    """Max index k such that a quorum of voters has acked >= k (0 if none)."""
+    voters = [m for m, ok in zip(match, mask) if ok]
+    if not voters:
+        return INF
+    q = len(voters) // 2 + 1
+    best = 0
+    for k in set(voters) | {0}:
+        if sum(1 for m in voters if m >= k) >= q:
+            best = max(best, k)
+    return best
+
+
+def dumb_vote(votes, mask):
+    voters = [v for v, ok in zip(votes, mask) if ok]
+    if not voters:
+        return VoteResult.VOTE_WON
+    q = len(voters) // 2 + 1
+    granted = sum(1 for v in voters if v == VoteState.GRANTED)
+    missing = sum(1 for v in voters if v == VoteState.PENDING)
+    if granted >= q:
+        return VoteResult.VOTE_WON
+    if granted + missing >= q:
+        return VoteResult.VOTE_PENDING
+    return VoteResult.VOTE_LOST
+
+
+@pytest.mark.parametrize(
+    "match,mask,want",
+    [
+        # single voter: its own match
+        ([5, 0, 0, 0], [1, 0, 0, 0], 5),
+        # 3 voters: median
+        ([2, 4, 9, 0], [1, 1, 1, 0], 4),
+        # 3 voters, one at zero (never acked)
+        ([0, 4, 9, 0], [1, 1, 1, 0], 4),
+        # 5 voters: 3rd largest
+        ([1, 2, 3, 4], [1, 1, 1, 1], 2),  # 4 voters, q=3 -> 3rd largest = 2
+        # empty config -> identity element
+        ([0, 0, 0, 0], [0, 0, 0, 0], INF),
+    ],
+)
+def test_committed_hand_cases(match, mask, want):
+    got = quorum.majority_committed(
+        jnp.asarray(match, jnp.int32), jnp.asarray(mask, bool)
+    )
+    assert int(got) == want
+
+
+def test_committed_matches_dumb_oracle():
+    rng = np.random.default_rng(0)
+    v = 8
+    for _ in range(500):
+        n = rng.integers(0, v + 1)
+        mask = np.zeros(v, bool)
+        mask[rng.permutation(v)[:n]] = True
+        match = rng.integers(0, 20, size=v).astype(np.int32)
+        got = int(quorum.majority_committed(jnp.asarray(match), jnp.asarray(mask)))
+        assert got == dumb_committed(match, mask), (match, mask)
+
+
+def test_committed_batched():
+    match = np.array([[2, 4, 9, 0], [7, 7, 7, 7]], np.int32)
+    mask = np.array([[1, 1, 1, 0], [1, 1, 1, 1]], bool)
+    got = np.asarray(quorum.majority_committed(jnp.asarray(match), jnp.asarray(mask)))
+    assert got.tolist() == [4, 7]
+
+
+def test_vote_matches_dumb_oracle():
+    rng = np.random.default_rng(1)
+    v = 8
+    for _ in range(500):
+        n = rng.integers(0, v + 1)
+        mask = np.zeros(v, bool)
+        mask[rng.permutation(v)[:n]] = True
+        votes = rng.integers(0, 3, size=v).astype(np.int32)
+        got = int(quorum.majority_vote(jnp.asarray(votes), jnp.asarray(mask)))
+        assert got == dumb_vote(votes, mask), (votes, mask)
+
+
+def test_joint_committed_is_min():
+    rng = np.random.default_rng(2)
+    v = 8
+    for _ in range(200):
+        mask_in = rng.integers(0, 2, size=v).astype(bool)
+        mask_out = rng.integers(0, 2, size=v).astype(bool)
+        match = rng.integers(0, 20, size=v).astype(np.int32)
+        got = int(
+            quorum.joint_committed(
+                jnp.asarray(match), jnp.asarray(mask_in), jnp.asarray(mask_out)
+            )
+        )
+        want = min(dumb_committed(match, mask_in), dumb_committed(match, mask_out))
+        assert got == want
+
+
+def test_joint_vote_truth_table():
+    # reference joint.go:61-75: both-won=won, any-lost=lost, else pending.
+    W, L, P = VoteResult.VOTE_WON, VoteResult.VOTE_LOST, VoteResult.VOTE_PENDING
+    rng = np.random.default_rng(3)
+    v = 8
+    for _ in range(300):
+        mask_in = rng.integers(0, 2, size=v).astype(bool)
+        mask_out = rng.integers(0, 2, size=v).astype(bool)
+        votes = rng.integers(0, 3, size=v).astype(np.int32)
+        r1, r2 = dumb_vote(votes, mask_in), dumb_vote(votes, mask_out)
+        if r1 == W and r2 == W:
+            want = W
+        elif r1 == L or r2 == L:
+            want = L
+        else:
+            want = P
+        got = int(
+            quorum.joint_vote(
+                jnp.asarray(votes), jnp.asarray(mask_in), jnp.asarray(mask_out)
+            )
+        )
+        assert got == want, (votes, mask_in, mask_out, r1, r2)
+
+
+def test_joint_vote_nonjoint_reduces_to_majority():
+    # outgoing empty -> behaves exactly like simple majority (the identity
+    # property the reference relies on, majority.go:180-184).
+    rng = np.random.default_rng(4)
+    v = 8
+    empty = np.zeros(v, bool)
+    for _ in range(100):
+        mask = rng.integers(0, 2, size=v).astype(bool)
+        votes = rng.integers(0, 3, size=v).astype(np.int32)
+        got = int(
+            quorum.joint_vote(jnp.asarray(votes), jnp.asarray(mask), jnp.asarray(empty))
+        )
+        assert got == dumb_vote(votes, mask)
+
+
+def test_joint_active():
+    # 3 voters, 2 active -> quorum alive; 1 active -> dead.
+    mask = jnp.asarray([1, 1, 1, 0], bool)
+    empty = jnp.zeros(4, bool)
+    active2 = jnp.asarray([1, 1, 0, 0], bool)
+    active1 = jnp.asarray([1, 0, 0, 0], bool)
+    assert bool(quorum.joint_active(active2, mask, empty))
+    assert not bool(quorum.joint_active(active1, mask, empty))
