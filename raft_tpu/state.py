@@ -132,6 +132,13 @@ class RaftState:
     infl_count: Any  # [N, V] i32
     infl_total_bytes: Any  # [N, V] i32
 
+    # Where the reference panics on broken invariants (e.g. log.go:319-324,
+    # log.go:135-137), a lockstep tensor program can't: violations set a bit
+    # here and the offending update is clamped to a no-op. Tests and the host
+    # runtime assert this stays zero (the batched analog of `go test -race`
+    # + panic: SURVEY §5 race-detection parity).
+    error_bits: Any  # [N] i32
+
     cfg: LaneConfig
 
     # Convenience views ----------------------------------------------------
@@ -255,5 +262,6 @@ def init_state(
         infl_start=zeros_nv,
         infl_count=zeros_nv,
         infl_total_bytes=zeros_nv,
+        error_bits=zeros_n,
         cfg=cfg if cfg is not None else make_lane_config(shape),
     )

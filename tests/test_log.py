@@ -1,0 +1,245 @@
+"""Log-window op tests.
+
+Re-derivations of the reference's white-box log tables (log_test.go:
+TestLogMaybeAppend:205, TestFindConflict, TestFindConflictByTerm:58,
+TestCompactionSideEffects, unstable stableTo ABA cases in
+log_unstable_test.go) against the circular columnar window.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import Shape
+from raft_tpu.ops import log as lg
+from raft_tpu.state import init_state
+
+SHAPE = Shape(n_lanes=2, max_peers=4, log_window=16, max_msg_entries=4)
+E = SHAPE.max_msg_entries
+
+
+def mk(terms, committed=0, snap_index=0, snap_term=0, stabled=None):
+    """Single meaningful lane (lane 0) with given entry terms starting at
+    snap_index+1; lane 1 stays empty as a batching control."""
+    ids = np.array([1, 1], np.int32)
+    peers = np.zeros((2, 4), np.int32)
+    peers[:, 0] = 1
+    st = init_state(SHAPE, ids, peers)
+    n = len(terms)
+    log_term = np.zeros((2, 16), np.int32)
+    for k, t in enumerate(terms):
+        idx = snap_index + 1 + k
+        log_term[0, idx % 16] = t
+    last = snap_index + n
+    return dataclasses.replace(
+        st,
+        log_term=jnp.asarray(log_term),
+        last=jnp.asarray([last, 0], jnp.int32),
+        committed=jnp.asarray([committed, 0], jnp.int32),
+        applied=jnp.asarray([min(committed, snap_index), 0], jnp.int32),
+        applying=jnp.asarray([min(committed, snap_index), 0], jnp.int32),
+        stabled=jnp.asarray([last if stabled is None else stabled, 0], jnp.int32),
+        snap_index=jnp.asarray([snap_index, 0], jnp.int32),
+        snap_term=jnp.asarray([snap_term, 0], jnp.int32),
+    )
+
+
+def lane0(x):
+    return int(np.asarray(x)[0])
+
+
+def arr2(v0, v1=0):
+    return jnp.asarray([v0, v1], jnp.int32)
+
+
+def ents(terms):
+    """[2, E] entry columns with lane 1 empty."""
+    pad = [0] * (E - len(terms))
+    t = jnp.asarray([list(terms) + pad, [0] * E], jnp.int32)
+    z = jnp.zeros((2, E), jnp.int32)
+    return t, z, z, arr2(len(terms))
+
+
+def terms_of(st):
+    """Extract lane-0 log terms first..last for golden comparison."""
+    out = []
+    for i in range(lane0(st.first_index), lane0(st.last) + 1):
+        out.append(lane0(lg.term_at(st, arr2(i))))
+    return out
+
+
+def test_term_at_bounds():
+    st = mk([1, 2, 3], snap_index=2, snap_term=1)
+    assert lane0(lg.term_at(st, arr2(2))) == 1  # snapshot point known
+    assert lane0(lg.term_at(st, arr2(3))) == 1
+    assert lane0(lg.term_at(st, arr2(5))) == 3
+    assert lane0(lg.term_at(st, arr2(6))) == 0  # unavailable
+    assert lane0(lg.term_at(st, arr2(1))) == 0  # compacted
+
+
+def test_is_up_to_date():
+    st = mk([1, 1, 2])  # last=(3, term 2)
+    cases = [
+        ((4, 3), True),  # higher term wins regardless of index
+        ((2, 3), True),
+        ((3, 2), True),  # same term, same index
+        ((4, 2), True),  # same term, longer
+        ((2, 2), False),  # same term, shorter
+        ((9, 1), False),  # lower term loses
+    ]
+    for (li, t), want in cases:
+        assert bool(np.asarray(lg.is_up_to_date(st, arr2(li), arr2(t)))[0]) == want, (li, t)
+
+
+def test_find_conflict():
+    st = mk([1, 2, 3])
+    et, _, _, _ = ents([2, 3])
+    # matching suffix -> no conflict
+    assert lane0(lg.find_conflict(st, arr2(1), et, arr2(2))) == 0
+    # extends past last -> first new index
+    et, _, _, _ = ents([2, 3, 4, 4])
+    assert lane0(lg.find_conflict(st, arr2(1), et, arr2(4))) == 4
+    # term mismatch inside -> that index
+    et, _, _, _ = ents([1, 4, 4])
+    assert lane0(lg.find_conflict(st, arr2(0), et, arr2(3))) == 2
+
+
+def test_maybe_append_accept_and_reject():
+    # log: terms [1,2,3] committed=1
+    st = mk([1, 2, 3], committed=1)
+    # reject: prev (2, term 3) doesn't match (we have term 2)
+    et, ty, by, n = ents([4])
+    st2, lastnew, ok = lg.maybe_append(st, arr2(2), arr2(3), arr2(3), et, ty, by, n)
+    assert not bool(np.asarray(ok)[0])
+    assert terms_of(st2) == [1, 2, 3]
+    # accept: prev (3, term 3), append term-4 entry, leader commit 4
+    st3, lastnew, ok = lg.maybe_append(st, arr2(3), arr2(3), arr2(4), et, ty, by, n)
+    assert bool(np.asarray(ok)[0]) and lane0(lastnew) == 4
+    assert terms_of(st3) == [1, 2, 3, 4]
+    assert lane0(st3.committed) == 4
+    # lane 1 untouched
+    assert int(np.asarray(st3.last)[1]) == 0
+
+
+def test_maybe_append_truncates_conflict():
+    st = mk([1, 2, 3], committed=1, stabled=3)
+    # prev (1, term 1) with entries [4, 4]: conflict at 2, truncate 2-3
+    et, ty, by, n = ents([4, 4])
+    st2, lastnew, ok = lg.maybe_append(st, arr2(1), arr2(1), arr2(1), et, ty, by, n)
+    assert bool(np.asarray(ok)[0])
+    assert terms_of(st2) == [1, 4, 4]
+    # durable cursor rolled back to the truncation point
+    assert lane0(st2.stabled) == 1
+
+
+def test_maybe_append_subset_noop():
+    st = mk([1, 2, 3], committed=1)
+    # offering entries we already have entirely -> no change, commit advances
+    et, ty, by, n = ents([2])
+    st2, lastnew, ok = lg.maybe_append(st, arr2(1), arr2(1), arr2(2), et, ty, by, n)
+    assert bool(np.asarray(ok)[0]) and lane0(lastnew) == 2
+    assert terms_of(st2) == [1, 2, 3]
+    assert lane0(st2.committed) == 2  # min(leaderCommit=2, lastnewi=2)
+    assert lane0(st2.last) == 3
+
+
+def test_commit_to_clamps_and_flags():
+    st = mk([1, 2, 3], committed=1)
+    st2 = lg.commit_to(st, arr2(2))
+    assert lane0(st2.committed) == 2 and lane0(st2.error_bits) == 0
+    # past last: reference panics (log.go:319-324); we flag + clamp
+    st3 = lg.commit_to(st, arr2(9))
+    assert lane0(st3.committed) == 3
+    assert lane0(st3.error_bits) & lg.ERR_COMMIT_OUT_OF_RANGE
+
+
+def test_stable_to_aba():
+    st = mk([1, 2, 2], stabled=1)
+    # stable ack for (2, term 2) -> advances
+    st2 = lg.stable_to(st, arr2(2), arr2(2))
+    assert lane0(st2.stabled) == 2
+    # stale ack with old term 1 at index 2 (log was truncated+rewritten):
+    # ignored (log_unstable.go:134-160)
+    st3 = lg.stable_to(st, arr2(2), arr2(1))
+    assert lane0(st3.stabled) == 1
+
+
+def test_find_conflict_by_term():
+    # terms: idx1..5 = [2,2,5,5,5], snap at 0
+    st = mk([2, 2, 5, 5, 5])
+    cases = [
+        # (index, term) -> want index
+        ((5, 5), 5),
+        ((5, 4), 2),  # walk below the term-5 block
+        ((5, 2), 2),
+        ((5, 1), 0),
+        ((2, 2), 2),
+        ((9, 9), 9),  # above last: unknown, echo back
+    ]
+    for (i, t), want in cases:
+        got, _ = lg.find_conflict_by_term(st, arr2(i), arr2(t))
+        assert lane0(got) == want, ((i, t), lane0(got), want)
+
+
+def test_find_conflict_by_term_compacted():
+    st = mk([4, 5], snap_index=3, snap_term=3)
+    # below the compaction point: unknown term counts as possible match
+    got, gt = lg.find_conflict_by_term(st, arr2(2), arr2(1))
+    assert lane0(got) == 2 and lane0(gt) == 0
+    # snapshot point term is known
+    got, gt = lg.find_conflict_by_term(st, arr2(3), arr2(3))
+    assert lane0(got) == 3 and lane0(gt) == 3
+
+
+def test_wraparound_append():
+    # Fill beyond W=16 via compaction: indexes 20..25 with snap at 19.
+    st = mk([7] * 6, snap_index=19, snap_term=6)
+    assert lane0(st.last) == 25
+    assert lane0(lg.term_at(st, arr2(25))) == 7
+    et, ty, by, n = ents([8, 8])
+    st2, _, ok = lg.maybe_append(st, arr2(25), arr2(7), arr2(0), et, ty, by, n)
+    assert bool(np.asarray(ok)[0])
+    assert lane0(st2.last) == 27
+    assert lane0(lg.term_at(st2, arr2(27))) == 8
+
+
+def test_window_overflow_flags():
+    st = mk([1] * 16)  # full window, snap=0, last=16
+    et, ty, by, n = ents([1])
+    st2 = lg.append(st, st.last, et, ty, by, n * jnp.asarray([1, 0], jnp.int32))
+    assert lane0(st2.error_bits) & lg.ERR_WINDOW_OVERFLOW
+    assert lane0(st2.last) == 16  # clamped to no-op
+
+
+def test_compact_frees_space():
+    st = mk([1] * 16, committed=8)
+    st = lg.applied_to(st, arr2(8))
+    st2 = lg.compact(st, arr2(8), arr2(1))
+    assert lane0(st2.snap_index) == 8
+    # now appending works again
+    et, ty, by, n = ents([2])
+    st3 = lg.append(st2, st2.last, et, ty, by, n)
+    assert lane0(st3.last) == 17 and lane0(st3.error_bits) == 0
+    assert lane0(lg.term_at(st3, arr2(17))) == 2
+    # compacted index now unknown
+    assert lane0(lg.term_at(st3, arr2(7))) == 0
+
+
+def test_restore_snapshot():
+    st = mk([1, 2, 3], committed=2)
+    mask = jnp.asarray([True, False])
+    st2 = lg.restore_snapshot(st, arr2(10), arr2(4), mask)
+    assert lane0(st2.last) == 10
+    assert lane0(st2.committed) == 10
+    assert lane0(st2.snap_index) == 10
+    assert lane0(lg.term_at(st2, arr2(10))) == 4
+    assert lane0(lg.term_at(st2, arr2(3))) == 0
+    assert int(np.asarray(st2.last)[1]) == 0  # other lane untouched
+
+
+def test_gather_entries():
+    st = mk([1, 2, 3, 4])
+    t, ty, by, valid = lg.gather_entries(st, arr2(2), arr2(2), E)
+    assert np.asarray(t)[0].tolist() == [2, 3, 0, 0]
+    assert np.asarray(valid)[0].tolist() == [True, True, False, False]
