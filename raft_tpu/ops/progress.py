@@ -1,0 +1,194 @@
+"""Per-peer progress + inflights as [N, V] / [N, V, F] elementwise updates.
+
+The reference's per-follower replication FSM (tracker/progress.go:30-98,
+tracker/state.go:20-34) and its ring-buffer flow-control window
+(tracker/inflights.go:28-143) flattened into device tensors, per SURVEY §2.2
+("North star: flatten to device-resident tensors").
+
+All functions take a `sel [N, V]` bool mask naming which (lane, peer-slot)
+cells the operation applies to, so a single call expresses anything from "one
+peer of one lane" to "every peer of every leader" — the batched equivalents of
+the reference's per-Progress method calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from raft_tpu.state import RaftState
+from raft_tpu.types import ProgressState
+
+I32 = jnp.int32
+
+
+def _sel(sel, new, old):
+    return jnp.where(sel, new, old)
+
+
+def reset_state(state: RaftState, sel, to_state) -> RaftState:
+    """reference: tracker/progress.go:100-107 ResetState — clears pause flag,
+    pending snapshot, and the inflight window."""
+    zero_nv = jnp.zeros_like(state.infl_start)
+    return dataclasses.replace(
+        state,
+        pr_state=_sel(sel, jnp.asarray(to_state, I32), state.pr_state),
+        pr_msg_app_flow_paused=_sel(sel, False, state.pr_msg_app_flow_paused),
+        pr_pending_snapshot=_sel(sel, 0, state.pr_pending_snapshot),
+        infl_start=_sel(sel, zero_nv, state.infl_start),
+        infl_count=_sel(sel, zero_nv, state.infl_count),
+        infl_total_bytes=_sel(sel, zero_nv, state.infl_total_bytes),
+    )
+
+
+def become_probe(state: RaftState, sel) -> RaftState:
+    """reference: tracker/progress.go:109-123 — from Snapshot, resume probing
+    above the pending snapshot; otherwise from Match+1."""
+    from_snap = sel & (state.pr_state == ProgressState.SNAPSHOT)
+    next_ = jnp.where(
+        from_snap,
+        jnp.maximum(state.pr_match + 1, state.pr_pending_snapshot + 1),
+        state.pr_match + 1,
+    )
+    state = reset_state(state, sel, ProgressState.PROBE)
+    return dataclasses.replace(state, pr_next=_sel(sel, next_, state.pr_next))
+
+
+def become_replicate(state: RaftState, sel) -> RaftState:
+    """reference: tracker/progress.go:125-129."""
+    state = reset_state(state, sel, ProgressState.REPLICATE)
+    return dataclasses.replace(
+        state, pr_next=_sel(sel, state.pr_match + 1, state.pr_next)
+    )
+
+
+def become_snapshot(state: RaftState, sel, snapshot_index) -> RaftState:
+    """reference: tracker/progress.go:131-136."""
+    state = reset_state(state, sel, ProgressState.SNAPSHOT)
+    return dataclasses.replace(
+        state,
+        pr_pending_snapshot=_sel(sel, snapshot_index, state.pr_pending_snapshot),
+    )
+
+
+def inflights_full(state: RaftState):
+    """[N, V] bool. reference: tracker/inflights.go:129-133."""
+    f = state.infl_index.shape[-1]
+    cap_hit = state.infl_count >= f
+    max_bytes = state.cfg.max_inflight_bytes[:, None]
+    bytes_hit = (max_bytes != 0) & (state.infl_total_bytes >= max_bytes)
+    return cap_hit | bytes_hit
+
+
+def inflights_add(state: RaftState, sel, index, bytes_) -> RaftState:
+    """Record one in-flight MsgApp (index, bytes) for selected cells.
+    reference: tracker/inflights.go:61-80. Full cells are clamped to no-ops
+    (the reference panics; our callers gate on inflights_full first)."""
+    f = state.infl_index.shape[-1]
+    sel = sel & ~inflights_full(state)
+    pos = (state.infl_start + state.infl_count) % f  # [N, V]
+    onehot = jnp.arange(f, dtype=I32)[None, None, :] == pos[..., None]  # [N,V,F]
+    put = sel[..., None] & onehot
+    return dataclasses.replace(
+        state,
+        infl_index=jnp.where(put, index[..., None], state.infl_index),
+        infl_bytes=jnp.where(put, bytes_[..., None], state.infl_bytes),
+        infl_count=_sel(sel, state.infl_count + 1, state.infl_count),
+        infl_total_bytes=_sel(
+            sel, state.infl_total_bytes + bytes_, state.infl_total_bytes
+        ),
+    )
+
+
+def inflights_free_le(state: RaftState, sel, to) -> RaftState:
+    """Free all inflights with index <= to. reference:
+    tracker/inflights.go:97-127. The ring holds a monotonic index sequence, so
+    the freed set is a prefix: count the live positions with index <= to."""
+    f = state.infl_index.shape[-1]
+    k = jnp.arange(f, dtype=I32)[None, None, :]
+    live = k < state.infl_count[..., None]  # ring order positions
+    pos = (state.infl_start[..., None] + k) % f  # physical slot of ring pos k
+    idx_k = jnp.take_along_axis(state.infl_index, pos, axis=-1)
+    byt_k = jnp.take_along_axis(state.infl_bytes, pos, axis=-1)
+    freed = live & (idx_k <= to[..., None])
+    n_free = jnp.sum(freed.astype(I32), axis=-1)
+    b_free = jnp.sum(jnp.where(freed, byt_k, 0), axis=-1)
+    new_count = state.infl_count - n_free
+    new_start = jnp.where(new_count == 0, 0, (state.infl_start + n_free) % f)
+    return dataclasses.replace(
+        state,
+        infl_count=_sel(sel, new_count, state.infl_count),
+        infl_start=_sel(sel, new_start, state.infl_start),
+        infl_total_bytes=_sel(
+            sel, state.infl_total_bytes - b_free, state.infl_total_bytes
+        ),
+    )
+
+
+def update_on_entries_send(state: RaftState, sel, n_entries, bytes_) -> RaftState:
+    """Optimistic Next bump + inflight add when a MsgApp is emitted.
+    reference: tracker/progress.go:139-164."""
+    repl = sel & (state.pr_state == ProgressState.REPLICATE)
+    probe = sel & (state.pr_state == ProgressState.PROBE)
+    sending = n_entries > 0
+    last = state.pr_next + n_entries - 1
+    state = inflights_add(state, repl & sending, last, bytes_)
+    return dataclasses.replace(
+        state,
+        pr_next=_sel(repl & sending, last + 1, state.pr_next),
+        pr_msg_app_flow_paused=jnp.where(
+            repl,
+            inflights_full(state),
+            jnp.where(
+                probe & sending, True, state.pr_msg_app_flow_paused
+            ),
+        ),
+    )
+
+
+def maybe_update(state: RaftState, sel, n) -> tuple[RaftState, jnp.ndarray]:
+    """Ack from follower: raise Match/Next. Returns the [N, V] updated mask.
+    reference: tracker/progress.go:167-177."""
+    updated = sel & (state.pr_match < n)
+    state = dataclasses.replace(
+        state,
+        pr_match=_sel(updated, n, state.pr_match),
+        pr_msg_app_flow_paused=_sel(updated, False, state.pr_msg_app_flow_paused),
+        pr_next=_sel(sel, jnp.maximum(state.pr_next, n + 1), state.pr_next),
+    )
+    return state, updated
+
+
+def maybe_decr_to(
+    state: RaftState, sel, rejected, match_hint
+) -> tuple[RaftState, jnp.ndarray]:
+    """Rejection from follower: lower Next (using the follower's hint), unless
+    the rejection is stale. Returns the [N, V] changed mask.
+    reference: tracker/progress.go:186-217."""
+    repl = state.pr_state == ProgressState.REPLICATE
+    # Replicate: genuine iff rejected > match; Next snaps to Match+1.
+    repl_ok = sel & repl & (rejected > state.pr_match)
+    # Probe/Snapshot: genuine iff rejected == Next-1 (probes go one at a time).
+    probe_ok = sel & ~repl & (state.pr_next - 1 == rejected)
+    new_next = jnp.where(
+        repl_ok,
+        state.pr_match + 1,
+        jnp.maximum(jnp.minimum(rejected, match_hint + 1), 1),
+    )
+    changed = repl_ok | probe_ok
+    state = dataclasses.replace(
+        state,
+        pr_next=_sel(changed, new_next, state.pr_next),
+        pr_msg_app_flow_paused=_sel(probe_ok, False, state.pr_msg_app_flow_paused),
+    )
+    return state, changed
+
+
+def is_paused(state: RaftState):
+    """[N, V] bool. reference: tracker/progress.go:219-236."""
+    return jnp.where(
+        state.pr_state == ProgressState.SNAPSHOT,
+        True,
+        state.pr_msg_app_flow_paused,
+    )

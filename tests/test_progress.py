@@ -1,0 +1,181 @@
+"""Progress/inflights kernel tests (re-derived from the reference's unit
+tables: tracker/progress_test.go:211, tracker/inflights_test.go:261)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import Shape
+from raft_tpu.ops import progress as pg
+from raft_tpu.state import init_state
+from raft_tpu.types import ProgressState
+
+SHAPE = Shape(n_lanes=2, max_peers=4, log_window=16, max_inflight=4)
+
+
+def mk():
+    ids = np.array([1, 1], np.int32)
+    peers = np.zeros((2, 4), np.int32)
+    peers[:, 0] = 1
+    peers[:, 1] = 2
+    peers[:, 2] = 3
+    return init_state(SHAPE, ids, peers)
+
+
+def cell(x, lane=0, slot=1):
+    return np.asarray(x)[lane, slot].item()
+
+
+def sel_cell(lane=0, slot=1):
+    m = np.zeros((2, 4), bool)
+    m[lane, slot] = True
+    return jnp.asarray(m)
+
+
+def nv(val):
+    return jnp.full((2, 4), val, jnp.int32)
+
+
+def test_become_probe_from_replicate():
+    st = mk()
+    sel = sel_cell()
+    st = dataclasses.replace(st, pr_match=nv(5), pr_next=nv(10))
+    st = pg.become_replicate(st, sel)
+    assert cell(st.pr_state) == ProgressState.REPLICATE
+    assert cell(st.pr_next) == 6
+    st = pg.become_probe(st, sel)
+    assert cell(st.pr_state) == ProgressState.PROBE
+    assert cell(st.pr_next) == 6
+    # untouched cell keeps its prior values
+    assert cell(st.pr_state, slot=2) == ProgressState.PROBE
+    assert cell(st.pr_next, slot=2) == 10
+
+
+def test_become_probe_from_snapshot_resumes_past_snapshot():
+    # reference: tracker/progress_test.go BecomeProbe w/ pending snapshot
+    st = mk()
+    sel = sel_cell()
+    st = dataclasses.replace(st, pr_match=nv(1))
+    st = pg.become_snapshot(st, sel, nv(10))
+    assert cell(st.pr_state) == ProgressState.SNAPSHOT
+    assert cell(st.pr_pending_snapshot) == 10
+    st = pg.become_probe(st, sel)
+    assert cell(st.pr_next) == 11
+    assert cell(st.pr_pending_snapshot) == 0
+
+
+def test_maybe_update():
+    st = mk()
+    sel = sel_cell()
+    st = dataclasses.replace(st, pr_match=nv(3), pr_next=nv(5))
+    st, upd = pg.maybe_update(st, sel, nv(2))  # stale ack
+    assert not upd[0, 1]
+    assert cell(st.pr_match) == 3 and cell(st.pr_next) == 5
+    st, upd = pg.maybe_update(st, sel, nv(7))
+    assert bool(upd[0, 1])
+    assert cell(st.pr_match) == 7 and cell(st.pr_next) == 8
+
+
+def test_maybe_decr_to_replicate():
+    st = mk()
+    sel = sel_cell()
+    st = dataclasses.replace(
+        st, pr_match=nv(5), pr_next=nv(10), pr_state=nv(ProgressState.REPLICATE)
+    )
+    # stale: rejected <= match
+    st, ch = pg.maybe_decr_to(st, sel, nv(4), nv(0))
+    assert not ch[0, 1] and cell(st.pr_next) == 10
+    # genuine: snap back to match+1
+    st, ch = pg.maybe_decr_to(st, sel, nv(9), nv(0))
+    assert bool(ch[0, 1]) and cell(st.pr_next) == 6
+
+
+def test_maybe_decr_to_probe():
+    st = mk()
+    sel = sel_cell()
+    st = dataclasses.replace(st, pr_next=nv(10))
+    # stale: rejected != next-1
+    st, ch = pg.maybe_decr_to(st, sel, nv(5), nv(3))
+    assert not ch[0, 1] and cell(st.pr_next) == 10
+    # genuine: use the hint
+    st, ch = pg.maybe_decr_to(st, sel, nv(9), nv(3))
+    assert bool(ch[0, 1]) and cell(st.pr_next) == 4
+    # hint can never push next below 1
+    st2 = dataclasses.replace(mk(), pr_next=nv(1))
+    st2, ch = pg.maybe_decr_to(st2, sel, nv(0), nv(0))
+    assert cell(st2.pr_next) == 1
+
+
+def test_inflights_ring():
+    # reference: tracker/inflights_test.go Add/FreeLE rotation cases
+    st = mk()
+    sel = sel_cell()
+    for i in [1, 2, 3, 4]:  # fill to capacity F=4
+        st = pg.inflights_add(st, sel, nv(i), nv(10 * i))
+    assert cell(st.infl_count) == 4
+    assert cell(st.infl_total_bytes) == 100
+    assert bool(pg.inflights_full(st)[0, 1])
+    # add beyond capacity is clamped (reference panics)
+    st = pg.inflights_add(st, sel, nv(5), nv(50))
+    assert cell(st.infl_count) == 4
+    # free prefix <= 2
+    st = pg.inflights_free_le(st, sel, nv(2))
+    assert cell(st.infl_count) == 2
+    assert cell(st.infl_start) == 2
+    assert cell(st.infl_total_bytes) == 70
+    # wrap around: add 5, 6 at physical slots 0,1
+    st = pg.inflights_add(st, sel, nv(5), nv(1))
+    st = pg.inflights_add(st, sel, nv(6), nv(1))
+    assert cell(st.infl_count) == 4
+    # free below window start: no-op
+    st2 = pg.inflights_free_le(st, sel, nv(2))
+    assert cell(st2.infl_count) == 4
+    # free everything resets start to 0
+    st3 = pg.inflights_free_le(st, sel, nv(6))
+    assert cell(st3.infl_count) == 0
+    assert cell(st3.infl_start) == 0
+    assert cell(st3.infl_total_bytes) == 0
+
+
+def test_inflights_byte_limit():
+    st = mk()
+    sel = sel_cell()
+    st = dataclasses.replace(
+        st, cfg=dataclasses.replace(st.cfg, max_inflight_bytes=jnp.asarray([25, 0], jnp.int32))
+    )
+    st = pg.inflights_add(st, sel, nv(1), nv(20))
+    assert not bool(pg.inflights_full(st)[0, 1])
+    st = pg.inflights_add(st, sel, nv(2), nv(10))  # soft limit: accepted
+    assert cell(st.infl_count) == 2
+    assert bool(pg.inflights_full(st)[0, 1])
+
+
+def test_update_on_entries_send_replicate():
+    st = mk()
+    sel = sel_cell()
+    st = dataclasses.replace(
+        st, pr_next=nv(5), pr_state=nv(ProgressState.REPLICATE)
+    )
+    st = pg.update_on_entries_send(st, sel, nv(3), nv(30))
+    assert cell(st.pr_next) == 8  # optimistic bump
+    assert cell(st.infl_count) == 1
+    assert cell(st.infl_index, 0, 1) == 7  # last sent index tracked
+    assert not bool(st.pr_msg_app_flow_paused[0, 1])
+
+
+def test_update_on_entries_send_probe_pauses():
+    st = mk()
+    sel = sel_cell()
+    st = pg.update_on_entries_send(st, sel, nv(1), nv(10))
+    assert bool(st.pr_msg_app_flow_paused[0, 1])
+    assert cell(st.pr_next) == 1  # no optimistic bump in probe
+    assert cell(st.infl_count) == 0
+    assert bool(pg.is_paused(st)[0, 1])
+
+
+def test_is_paused_snapshot():
+    st = mk()
+    st = pg.become_snapshot(st, sel_cell(), nv(7))
+    assert bool(pg.is_paused(st)[0, 1])
+    assert not bool(pg.is_paused(st)[0, 2])
