@@ -160,7 +160,7 @@ def test_update_on_entries_send_replicate():
     st = pg.update_on_entries_send(st, sel, nv(3), nv(30))
     assert cell(st.pr_next) == 8  # optimistic bump
     assert cell(st.infl_count) == 1
-    assert cell(st.infl_index, 0, 1) == 7  # last sent index tracked
+    assert np.asarray(st.infl_index)[0, 1, 0] == 7  # last sent index tracked
     assert not bool(st.pr_msg_app_flow_paused[0, 1])
 
 
