@@ -1,0 +1,267 @@
+"""In-device multi-group cluster: lanes = groups x voters, message delivery
+as a batched sort/gather permutation.
+
+The reference leaves transport to the application (README.md:10-14) and its
+tests move messages between in-process state machines synchronously
+(raft_test.go:4844 newNetwork). Here the same role is played by a device-side
+router: every round, all outbox messages [N, S] are flattened, keyed by
+destination lane, sorted, and re-gathered into per-lane inboxes [N, M_in] —
+i.e. "delivery" is one all-to-all permutation of message tensors, exactly the
+shape that pjit/shard_map turns into ICI collectives when the lane axis is
+sharded (SURVEY §2.3, §5.8).
+
+Faithful ordering contract (doc.go:75-91): messages emitted in round r are
+delivered in round r+1, *after* the emitting lane's unstable entries have
+been marked durable at the end of round r (the synchronous persist). The
+self-addressed after-append messages (outbox slot V) ride the same delay,
+which implements the reference's msgsAfterAppend/Advance rule.
+
+Inside a round the queued messages are consumed by a lax.scan over inbox
+slots — the step kernel compiles once and is reused for every slot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import Shape
+from raft_tpu.messages import MsgBatch, empty_batch
+from raft_tpu.ops import log as lg
+from raft_tpu.ops import step as stepmod
+from raft_tpu.state import RaftState, init_state, make_lane_config
+from raft_tpu.types import MessageType as MT
+
+I32 = jnp.int32
+
+
+def route(
+    out: MsgBatch,
+    src_group: jnp.ndarray,
+    lane_of: jnp.ndarray,
+    m_in: int,
+    drop_mask: jnp.ndarray | None = None,
+) -> tuple[MsgBatch, jnp.ndarray]:
+    """Deliver outbox messages to per-lane inboxes.
+
+    out: [N, S] message slots emitted this round.
+    src_group: [N] group id of each lane.
+    lane_of: [G, max_id+1] lane index for (group, raft id); -1 if absent.
+    drop_mask: optional [N, S] bool — drop these messages (fault injection,
+      the analog of rafttest/network.go:122-144 drop/disconnect).
+
+    Returns (inbox [N, m_in], n_dropped_overflow).
+    """
+    n, s = out.type.shape
+    k = n * s
+
+    flat = jax.tree.map(lambda x: x.reshape((k,) + x.shape[2:]), out)
+    src_lane = jnp.repeat(jnp.arange(n, dtype=I32), s)
+    group = src_group[src_lane]
+    valid = flat.type != MT.MSG_NONE
+    if drop_mask is not None:
+        valid = valid & ~drop_mask.reshape(k)
+    to = jnp.clip(flat.to, 0, lane_of.shape[1] - 1)
+    dst = jnp.where(valid, lane_of[group, to], -1)
+    valid = valid & (dst >= 0)
+
+    # stable sort by destination; invalid messages sort to the end
+    key = jnp.where(valid, dst, n)
+    order = jnp.argsort(key, stable=True)
+    sorted_dst = key[order]
+    flat = jax.tree.map(lambda x: x[order], flat)
+
+    # segment of lane i = [searchsorted(i), searchsorted(i+1))
+    lanes = jnp.arange(n, dtype=I32)
+    starts = jnp.searchsorted(sorted_dst, lanes)
+    ends = jnp.searchsorted(sorted_dst, lanes + 1)
+    count = ends - starts
+    dropped = jnp.sum(jnp.clip(count - m_in, 0))
+
+    j = jnp.arange(m_in, dtype=I32)[None, :]
+    pos = jnp.clip(starts[:, None] + j, 0, k - 1)
+    ok = j < count[:, None]
+    inbox = jax.tree.map(lambda x: x[pos], flat)
+    inbox = dataclasses.replace(
+        inbox, type=jnp.where(ok, inbox.type, jnp.int32(MT.MSG_NONE))
+    )
+    return inbox, dropped
+
+
+def scan_step(state: RaftState, inbox: MsgBatch) -> tuple[RaftState, MsgBatch]:
+    """Consume inbox [N, M] serially (matching the reference's one-message-
+    at-a-time Step contract) via lax.scan; returns all emissions [N, M*S]."""
+    xs = jax.tree.map(lambda x: jnp.moveaxis(x, 1, 0), inbox)
+
+    def body(st, msg):
+        st, out = stepmod.step(st, msg)
+        return st, out
+
+    state, outs = jax.lax.scan(body, state, xs)
+    m = inbox.type.shape[1]
+    n = inbox.type.shape[0]
+    out_all = jax.tree.map(
+        lambda x: jnp.moveaxis(x, 0, 1).reshape((n, m * x.shape[2]) + x.shape[3:]),
+        outs,
+    )
+    return state, out_all
+
+
+@partial(jax.jit, static_argnames=("m_in", "do_tick"))
+def cluster_round(
+    state: RaftState,
+    inbox: MsgBatch,
+    group_of,
+    lane_of,
+    *,
+    m_in: int,
+    do_tick: bool,
+) -> tuple[RaftState, MsgBatch, jnp.ndarray]:
+    """One synchronous round: [tick ->] step queued messages -> sync persist
+    -> auto-apply -> route emissions for next round."""
+    e = inbox.ent_term.shape[-1]
+    if do_tick:
+        state, local = stepmod.tick(state, e)
+        inbox = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=1), local, inbox
+        )
+    state, out_all = scan_step(state, inbox)
+    # synchronous durability: everything appended this round is persisted
+    # before any message emitted this round is delivered (doc.go:79-86)
+    state = dataclasses.replace(state, stabled=state.last)
+    # auto-apply committed entries (the trivial test state machine)
+    applied_bytes = _bytes_between(state, state.applied, state.committed)
+    state = lg.applied_to(state, state.committed)
+    state = dataclasses.replace(
+        state,
+        uncommitted_size=jnp.clip(state.uncommitted_size - applied_bytes, 0),
+    )
+    nxt, dropped = route(out_all, group_of, lane_of, m_in)
+    return state, nxt, dropped
+
+
+def _bytes_between(state: RaftState, lo, hi):
+    """Sum of payload bytes of entries in (lo, hi]."""
+    idx, valid = lg.window_indexes(state)
+    m = valid & (idx > lo[:, None]) & (idx <= hi[:, None])
+    return jnp.sum(jnp.where(m, state.log_bytes, 0), axis=1)
+
+
+class Cluster:
+    """G raft groups x V voters, all resident in one lane batch.
+
+    The minimum end-to-end slice of SURVEY §7 stage 6: host loop = {tick
+    kernel, in-device routing, step kernel, sync persist}, with entry
+    payloads host-side.
+    """
+
+    def __init__(
+        self,
+        n_groups: int,
+        n_voters: int,
+        shape: Shape | None = None,
+        seed: int = 1,
+        **cfg_overrides,
+    ):
+        self.g, self.v = n_groups, n_voters
+        n = n_groups * n_voters
+        self.shape = shape or Shape(n_lanes=n, max_peers=max(4, n_voters))
+        if self.shape.n_lanes != n:
+            raise ValueError("shape.n_lanes must equal groups*voters")
+        ids = np.tile(np.arange(1, n_voters + 1, dtype=np.int32), n_groups)
+        peers = np.zeros((n, self.shape.v), np.int32)
+        peers[:, :n_voters] = np.arange(1, n_voters + 1, dtype=np.int32)[None, :]
+        cfg = make_lane_config(self.shape, **cfg_overrides)
+        self.state = init_state(self.shape, ids, peers, seed=seed, cfg=cfg)
+        self.group_of = jnp.repeat(jnp.arange(n_groups, dtype=I32), n_voters)
+        lane_of = np.full((n_groups, n_voters + 1), -1, np.int32)
+        for g in range(n_groups):
+            for vid in range(1, n_voters + 1):
+                lane_of[g, vid] = g * n_voters + (vid - 1)
+        self.lane_of = jnp.asarray(lane_of)
+        self.m_in = 2 * self.shape.v + 2
+        # pending inbox is host-mutable so tests can inject local messages
+        self._pending = jax.tree.map(
+            lambda x: np.array(x), empty_batch((n, self.m_in), self.shape.max_msg_entries)
+        )
+        self.dropped = 0
+
+    # -- driving ----------------------------------------------------------
+
+    def _do_round(self, do_tick: bool):
+        inbox = jax.tree.map(jnp.asarray, self._pending)
+        self.state, nxt, dropped = cluster_round(
+            self.state,
+            inbox,
+            self.group_of,
+            self.lane_of,
+            m_in=self.m_in,
+            do_tick=do_tick,
+        )
+        self._pending = jax.tree.map(lambda x: np.array(x), nxt)
+        self.dropped += int(dropped)
+
+    def tick(self, n_ticks: int = 1):
+        for _ in range(n_ticks):
+            self._do_round(do_tick=True)
+
+    def run(self, rounds: int = 1):
+        for _ in range(rounds):
+            self._do_round(do_tick=False)
+
+    def has_pending(self) -> bool:
+        return bool((self._pending.type != MT.MSG_NONE).any())
+
+    def settle(self, max_rounds: int = 64):
+        """Run until no messages remain in flight (the reference harness's
+        'stabilize' fixed point, rafttest/interaction_env_handler_stabilize.go:49)."""
+        for _ in range(max_rounds):
+            if not self.has_pending():
+                return
+            self.run(1)
+        raise RuntimeError("cluster did not settle")
+
+    # -- client ops -------------------------------------------------------
+
+    def inject(self, lane: int, **fields):
+        """Queue one locally-delivered message for a lane (MsgHup, MsgProp...).
+        Field names follow MsgBatch; entries passed as ent_* lists."""
+        from raft_tpu.messages import make_msg
+
+        msg = make_msg(self.shape.max_msg_entries, **fields)
+        free = np.nonzero(self._pending.type[lane] == MT.MSG_NONE)[0]
+        if len(free) == 0:
+            raise RuntimeError("no free inbox slot for injection")
+        s = free[0]
+        for f in dataclasses.fields(msg):
+            arr = getattr(self._pending, f.name)
+            arr[lane, s] = np.asarray(getattr(msg, f.name))
+
+    def campaign(self, lane: int):
+        self.inject(lane, type=MT.MSG_HUP, to=int(np.asarray(self.state.id)[lane]))
+
+    def propose(self, lane: int, n_bytes: int = 0):
+        self.inject(
+            lane,
+            type=MT.MSG_PROP,
+            to=int(np.asarray(self.state.id)[lane]),
+            frm=int(np.asarray(self.state.id)[lane]),
+            ent_terms=[0],
+            ent_sizes=[n_bytes],
+        )
+
+    # -- inspection -------------------------------------------------------
+
+    def leader_lanes(self) -> np.ndarray:
+        return np.nonzero(np.asarray(self.state.state) == 2)[0]
+
+    def lanes_of_group(self, g: int) -> slice:
+        return slice(g * self.v, (g + 1) * self.v)
+
+    def check_no_errors(self):
+        bits = np.asarray(self.state.error_bits)
+        assert (bits == 0).all(), f"error_bits set: lanes {np.nonzero(bits)[0].tolist()}"

@@ -212,7 +212,10 @@ def init_state(
     zeros_n = jnp.zeros((n,), I32)
     zeros_nv = jnp.zeros((n, v), I32)
 
-    rng = (np.uint32(seed) * np.uint32(2654435761) + np.arange(n, dtype=np.uint32)) | np.uint32(1)
+    rng = np.asarray(
+        ((seed * 2654435761 + np.arange(n, dtype=np.uint64)) & 0xFFFFFFFF) | 1,
+        np.uint32,
+    )
 
     return RaftState(
         id=jnp.asarray(ids),

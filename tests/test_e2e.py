@@ -1,0 +1,133 @@
+"""End-to-end cluster tests: the TPU analog of the reference's multi-node
+fake-network suite (raft_test.go network fixture + raft_paper_test.go
+clause tests), driven through the in-device router."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import Cluster
+from raft_tpu.types import MessageType as MT, StateType
+
+
+def test_single_group_election():
+    c = Cluster(n_groups=1, n_voters=3)
+    c.campaign(0)  # MsgHup to node 1
+    c.settle()
+    c.check_no_errors()
+    st = np.asarray(c.state.state)
+    assert st[0] == StateType.LEADER
+    assert (st[1:] == StateType.FOLLOWER).all()
+    # all nodes know the leader and share term 1
+    assert np.asarray(c.state.lead).tolist() == [1, 1, 1]
+    assert np.asarray(c.state.term).tolist() == [1, 1, 1]
+    # the leader's empty entry is committed everywhere
+    assert np.asarray(c.state.committed).tolist() == [1, 1, 1]
+
+
+def test_many_groups_elect_in_lockstep():
+    g = 16
+    c = Cluster(n_groups=g, n_voters=3)
+    for i in range(g):
+        c.campaign(i * 3)
+    c.settle()
+    c.check_no_errors()
+    st = np.asarray(c.state.state).reshape(g, 3)
+    assert (st[:, 0] == StateType.LEADER).all()
+    assert (st[:, 1:] == StateType.FOLLOWER).all()
+    assert (np.asarray(c.state.committed) == 1).all()
+
+
+def test_propose_commits_everywhere():
+    c = Cluster(n_groups=4, n_voters=3)
+    for i in range(4):
+        c.campaign(i * 3)
+    c.settle()
+    for i in range(4):
+        c.propose(i * 3, n_bytes=10)
+    c.settle()
+    c.check_no_errors()
+    committed = np.asarray(c.state.committed)
+    assert (committed == 2).all(), committed
+    applied = np.asarray(c.state.applied)
+    assert (applied == 2).all()
+    # log terms agree across each group
+    lt = np.asarray(c.state.log_term)
+    for g in range(4):
+        lanes = c.lanes_of_group(g)
+        assert (lt[lanes] == lt[lanes][0]).all()
+
+
+def test_election_timeout_drives_leaderless_group():
+    # no explicit campaign: randomized timeouts must elect a leader
+    c = Cluster(n_groups=8, n_voters=3, seed=7)
+    for _ in range(60):
+        c.tick()
+        if len(c.leader_lanes()) == 8:
+            break
+    c.settle()
+    c.check_no_errors()
+    st = np.asarray(c.state.state).reshape(8, 3)
+    assert ((st == StateType.LEADER).sum(axis=1) == 1).all(), st
+
+
+def test_heartbeats_maintain_leadership():
+    c = Cluster(n_groups=1, n_voters=3)
+    c.campaign(0)
+    c.settle()
+    for _ in range(25):  # > election timeout worth of ticks
+        c.tick()
+    c.settle()
+    c.check_no_errors()
+    assert np.asarray(c.state.state)[0] == StateType.LEADER
+    assert np.asarray(c.state.term).tolist() == [1, 1, 1]
+
+
+def test_reelection_after_leader_partition():
+    c = Cluster(n_groups=1, n_voters=3)
+    c.campaign(0)
+    c.settle()
+    # "partition" the leader: force node 2 to campaign at a higher term
+    c.campaign(1)
+    c.settle()
+    c.check_no_errors()
+    st = np.asarray(c.state.state)
+    assert st[1] == StateType.LEADER
+    assert np.asarray(c.state.term)[1] == 2
+    # old leader stepped down
+    assert st[0] == StateType.FOLLOWER
+
+
+def test_log_replication_catches_up_lagging_follower():
+    c = Cluster(n_groups=1, n_voters=3)
+    c.campaign(0)
+    c.settle()
+    for _ in range(5):
+        c.propose(0, n_bytes=4)
+    c.settle()
+    c.check_no_errors()
+    assert np.asarray(c.state.committed).tolist() == [6, 6, 6]
+    assert np.asarray(c.state.last).tolist() == [6, 6, 6]
+
+
+def test_proposal_to_follower_is_forwarded():
+    c = Cluster(n_groups=1, n_voters=3)
+    c.campaign(0)
+    c.settle()
+    c.propose(1, n_bytes=4)  # follower lane
+    c.settle()
+    c.check_no_errors()
+    assert np.asarray(c.state.committed).tolist() == [2, 2, 2]
+
+
+def test_five_voters():
+    c = Cluster(n_groups=2, n_voters=5)
+    c.campaign(0)
+    c.campaign(5)
+    c.settle()
+    c.propose(0, n_bytes=8)
+    c.propose(5, n_bytes=8)
+    c.settle()
+    c.check_no_errors()
+    assert (np.asarray(c.state.committed) == 2).all()
+    st = np.asarray(c.state.state).reshape(2, 5)
+    assert (st[:, 0] == StateType.LEADER).all()
