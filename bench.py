@@ -1,0 +1,85 @@
+"""North-star benchmark: raft groups x ticks per second on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload = BASELINE.json config 5 in spirit: many independent 3-voter groups,
+election + steady-state replication with randomized timeouts. Every round is
+one tick over all groups plus a full step of all queued messages, with
+delivery as an in-device permutation. Everything stays device-resident; the
+host only sequences rounds (donated buffers, no host mirrors).
+
+`vs_baseline` is measured against the BASELINE.md target of 1M groups*ticks/s
+(the reference publishes no numbers; see BASELINE.md for the Go harnesses).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from raft_tpu.cluster import Cluster, cluster_round
+
+    platform = jax.devices()[0].platform
+    n_groups = int(
+        os.environ.get("BENCH_GROUPS", 16384 if platform == "tpu" else 512)
+    )
+    n_iters = int(os.environ.get("BENCH_ITERS", 10))
+    n_voters = 3
+    c = Cluster(n_groups, n_voters, seed=42)
+
+    # NOTE: no donate_argnums — buffer donation trips INVALID_ARGUMENT on the
+    # tunneled (axon) TPU backend
+    round_fn = jax.jit(
+        partial(cluster_round.__wrapped__, m_in=c.m_in, do_tick=True)
+    )
+
+    state = c.state
+    pending = jax.tree.map(jnp.asarray, c._pending)
+    group_of, lane_of = c.group_of, c.lane_of
+
+    # warmup/compile + leader elections
+    t0 = time.perf_counter()
+    state, pending, dropped = round_fn(state, pending, group_of, lane_of)
+    jax.block_until_ready(state.term)
+    compile_s = time.perf_counter() - t0
+    for _ in range(25):
+        state, pending, dropped = round_fn(state, pending, group_of, lane_of)
+    jax.block_until_ready(state.term)
+
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, pending, dropped = round_fn(state, pending, group_of, lane_of)
+    jax.block_until_ready(state.term)
+    dt = time.perf_counter() - t0
+
+    n_leaders = int(jnp.sum(state.state == 2))
+    groups_ticks_per_sec = n_groups * n_iters / dt
+    target = 1_000_000.0
+    print(
+        json.dumps(
+            {
+                "metric": "raft_groups_ticks_per_sec",
+                "value": round(groups_ticks_per_sec, 1),
+                "unit": "groups*ticks/s",
+                "vs_baseline": round(groups_ticks_per_sec / target, 4),
+                "extra": {
+                    "groups": n_groups,
+                    "leaders_elected": n_leaders,
+                    "round_ms": round(1000 * dt / n_iters, 2),
+                    "compile_s": round(compile_s, 1),
+                    "platform": platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,189 @@
+"""Threaded event-loop Node API — the reference's goroutine/channel layer
+(reference: node.go:132-243 Node interface, 271-289 StartNode, 343-454 run).
+
+One Python thread per `NodeHost` owns the (thread-unsafe) `RawNodeBatch`
+exactly like the reference's `node.run()` goroutine owns the RawNode; every
+interaction crosses a queue, mirroring the reference's channel set
+(propc/recvc/tickc/readyc/advancec/confc, node.go:297-310). All lanes of the
+batch share one loop thread — the batched analog of "multinode which can host
+multiple raft groups" (reference: raft.go:244-246).
+
+The app-facing contract is the reference's (doc.go:69-145): take a Ready,
+persist + send + apply, then Advance. `Node.ready()` blocks like `<-n.Ready()`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Callable
+
+from raft_tpu.api.rawnode import Message, RawNodeBatch, Ready
+from raft_tpu.types import MessageType as MT
+
+
+@dataclasses.dataclass
+class _Op:
+    kind: str
+    lane: int
+    payload: object = None
+    done: threading.Event | None = None
+    result: object = None
+    error: Exception | None = None
+
+
+class NodeHost:
+    """Owns the batch + loop thread; hands out per-lane `Node` views."""
+
+    def __init__(self, batch: RawNodeBatch):
+        self.batch = batch
+        self._ops: queue.Queue[_Op] = queue.Queue()
+        self._ready_q: list[queue.Queue[Ready]] = [
+            queue.Queue(maxsize=1) for _ in range(batch.shape.n)
+        ]
+        self._advance_pending = [False] * batch.shape.n
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def node(self, lane: int) -> "Node":
+        return Node(self, lane)
+
+    def stop(self):
+        self._stop.set()
+        self._ops.put(_Op("noop", 0))
+        self._thread.join(timeout=10)
+
+    # -- loop (reference: node.go:343-454) ---------------------------------
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                op = self._ops.get(timeout=0.01)
+            except queue.Empty:
+                op = None
+            if op is not None:
+                self._handle(op)
+            # surface Readys for lanes that want them (readyc select arm)
+            for lane in range(self.batch.shape.n):
+                if self._advance_pending[lane]:
+                    continue
+                if not self._ready_q[lane].empty():
+                    continue
+                if self.batch.has_ready(lane):
+                    rd = self.batch.ready(lane)
+                    self._advance_pending[lane] = True
+                    self._ready_q[lane].put(rd)
+
+    def _handle(self, op: _Op):
+        b = self.batch
+        try:
+            if op.kind == "tick":
+                b.tick(op.lane)
+            elif op.kind == "propose":
+                b.propose(op.lane, op.payload)
+            elif op.kind == "propose_cc":
+                data, v2 = op.payload
+                b.propose_conf_change(op.lane, data, v2=v2)
+            elif op.kind == "step":
+                b.step(op.lane, op.payload)
+            elif op.kind == "advance":
+                b.advance(op.lane)
+                self._advance_pending[op.lane] = False
+            elif op.kind == "campaign":
+                b.campaign(op.lane)
+            elif op.kind == "apply_cc":
+                op.result = b.apply_conf_change(op.lane, op.payload)
+            elif op.kind == "transfer":
+                b.transfer_leadership(op.lane, op.payload)
+            elif op.kind == "read_index":
+                b.read_index(op.lane, op.payload)
+            elif op.kind == "report_unreachable":
+                b.report_unreachable(op.lane, op.payload)
+            elif op.kind == "report_snapshot":
+                peer, ok = op.payload
+                b.report_snapshot(op.lane, peer, ok)
+            elif op.kind == "status":
+                op.result = b.status(op.lane)
+            elif op.kind == "compact":
+                idx, data = op.payload
+                b.compact(op.lane, idx, data)
+        except Exception as e:  # surface to caller when waiting
+            op.error = e
+        finally:
+            if op.done is not None:
+                op.done.set()
+
+    def _submit(self, kind, lane, payload=None, wait=False):
+        op = _Op(kind, lane, payload, threading.Event() if wait else None)
+        self._ops.put(op)
+        if wait:
+            # no timeout: first XLA compiles can take minutes; the loop
+            # thread always sets done (or the host is stopped)
+            while not op.done.wait(timeout=1.0):
+                if self._stop.is_set():
+                    raise RuntimeError("node host stopped")
+            if op.error is not None:
+                raise op.error
+            return op.result
+        return None
+
+
+class Node:
+    """Per-lane async handle (reference: node.go:132-243)."""
+
+    def __init__(self, host: NodeHost, lane: int):
+        self.host = host
+        self.lane = lane
+
+    def tick(self):
+        self.host._submit("tick", self.lane)
+
+    def campaign(self):
+        self.host._submit("campaign", self.lane)
+
+    def propose(self, data: bytes, wait: bool = False):
+        self.host._submit("propose", self.lane, data, wait=wait)
+
+    def propose_conf_change(self, data: bytes, v2: bool = False, wait: bool = False):
+        self.host._submit("propose_cc", self.lane, (data, v2), wait=wait)
+
+    def step(self, msg: Message):
+        if msg.type in (int(MT.MSG_HUP), int(MT.MSG_BEAT)):
+            raise ValueError("cannot step raft local message")
+        self.host._submit("step", self.lane, msg)
+
+    def ready(self, timeout: float | None = None) -> Ready:
+        """Blocking receive, like `<-n.Ready()` (reference: node.go:547)."""
+        return self.host._ready_q[self.lane].get(timeout=timeout)
+
+    def has_ready(self) -> bool:
+        return not self.host._ready_q[self.lane].empty()
+
+    def advance(self):
+        self.host._submit("advance", self.lane)
+
+    def apply_conf_change(self, cc):
+        return self.host._submit("apply_cc", self.lane, cc, wait=True)
+
+    def transfer_leadership(self, transferee: int):
+        self.host._submit("transfer", self.lane, transferee)
+
+    def read_index(self, ctx: int):
+        self.host._submit("read_index", self.lane, ctx)
+
+    def report_unreachable(self, peer: int):
+        self.host._submit("report_unreachable", self.lane, peer)
+
+    def report_snapshot(self, peer: int, ok: bool):
+        self.host._submit("report_snapshot", self.lane, (peer, ok))
+
+    def status(self) -> dict:
+        return self.host._submit("status", self.lane, wait=True)
+
+    def compact(self, to_index: int, data: bytes = b""):
+        self.host._submit("compact", self.lane, (to_index, data), wait=True)
+
+    def stop(self):
+        self.host.stop()

@@ -1,0 +1,894 @@
+"""Host-side RawNode facade over the batched device engine.
+
+The reference's `RawNode` (reference: rawnode.go:34-559) is a thread-unsafe,
+allocation-light driver around one `raft` state machine: the application calls
+`Step/Propose/Tick`, collects a `Ready` bundle (reference: node.go:52-115),
+persists + sends, then calls `Advance`. Here the same contract is exposed
+per *lane* of the batched engine: a `RawNodeBatch` hosts N raft nodes in one
+device-resident `RaftState`, and `RawNode(batch, lane)` is the familiar
+single-node view.
+
+The device holds all algorithmic state (terms, votes, progress, log window of
+(term, type, size) columns); entry *payloads* live host-side in an
+`EntryStore` keyed by (lane, index), mirroring SURVEY §7's state layout. The
+Ready/Advance cycle is faithful to the sync-mode contract (reference:
+doc.go:69-145):
+
+  - `Ready.entries` = the unstable tail (stabled, last] to persist;
+  - `Ready.committed_entries` = (applied, committed] to apply;
+  - `Ready.messages` = peer-addressed emissions, valid to send only after
+    the entries/HardState in the same Ready are durable;
+  - after-append self-messages (reference: msgsAfterAppend, raft.go:534-580)
+    are held back and stepped during `advance()`, exactly like
+    `RawNode.acceptReady`/`Advance` (reference: rawnode.go:404-440, 479-491).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import Shape
+from raft_tpu.messages import MsgBatch, empty_batch
+from raft_tpu.ops import step as stepmod
+from raft_tpu.state import LaneConfig, RaftState, init_state, make_lane_config
+from raft_tpu.types import EntryType, MessageType as MT, ProgressState, StateType
+
+I32 = jnp.int32
+
+
+# --------------------------------------------------------------------------
+# host-level data model (the raftpb analog)
+
+
+@dataclasses.dataclass
+class Entry:
+    """reference: raftpb/raft.proto:21-26."""
+
+    term: int = 0
+    index: int = 0
+    type: int = int(EntryType.ENTRY_NORMAL)
+    data: bytes = b""
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """reference: raftpb/raft.proto:27-39 (data + metadata)."""
+
+    index: int = 0
+    term: int = 0
+    data: bytes = b""
+    voters: tuple = ()
+    learners: tuple = ()
+    voters_outgoing: tuple = ()
+    learners_next: tuple = ()
+    auto_leave: bool = False
+
+
+@dataclasses.dataclass
+class Message:
+    """Host-level raftpb.Message (reference: raftpb/raft.proto:71-108)."""
+
+    type: int
+    to: int = 0
+    frm: int = 0
+    term: int = 0
+    log_term: int = 0
+    index: int = 0
+    commit: int = 0
+    reject: bool = False
+    reject_hint: int = 0
+    context: int = 0
+    entries: list = dataclasses.field(default_factory=list)
+    snapshot: Snapshot | None = None
+
+
+@dataclasses.dataclass
+class HardState:
+    """reference: raftpb/raft.proto:110-114."""
+
+    term: int = 0
+    vote: int = 0
+    commit: int = 0
+
+    def is_empty(self) -> bool:
+        return self == HardState()
+
+
+@dataclasses.dataclass
+class SoftState:
+    """reference: node.go:35-43."""
+
+    lead: int = 0
+    raft_state: int = int(StateType.FOLLOWER)
+
+
+@dataclasses.dataclass
+class Ready:
+    """reference: node.go:52-115."""
+
+    soft_state: SoftState | None = None
+    hard_state: HardState | None = None
+    entries: list = dataclasses.field(default_factory=list)
+    committed_entries: list = dataclasses.field(default_factory=list)
+    messages: list = dataclasses.field(default_factory=list)
+    snapshot: Snapshot | None = None
+    read_states: list = dataclasses.field(default_factory=list)
+    must_sync: bool = False
+
+    def contains_updates(self) -> bool:
+        return bool(
+            self.soft_state
+            or (self.hard_state and not self.hard_state.is_empty())
+            or self.entries
+            or self.committed_entries
+            or self.messages
+            or self.snapshot
+            or self.read_states
+        )
+
+
+@dataclasses.dataclass
+class ReadState:
+    """reference: read_only.go:24-27."""
+
+    index: int
+    request_ctx: int
+
+
+class EntryStore:
+    """Host-side payload store: (lane, index) -> (term, type, data).
+
+    The columnar half of the reference's MemoryStorage (storage.go:98-310) —
+    the device keeps (term, type, size) columns; this keeps the bytes.
+    """
+
+    def __init__(self, n_lanes: int):
+        self._d: list[dict[int, tuple[int, int, bytes]]] = [
+            {} for _ in range(n_lanes)
+        ]
+        self._snap: list[Snapshot | None] = [None] * n_lanes
+
+    def put(self, lane: int, e: Entry):
+        self._d[lane][e.index] = (e.term, e.type, e.data)
+
+    def get(self, lane: int, index: int, term: int) -> tuple[int, bytes]:
+        rec = self._d[lane].get(index)
+        if rec is None or (term and rec[0] != term):
+            return (0, b"")
+        return (rec[1], rec[2])
+
+    def truncate_from(self, lane: int, index: int):
+        d = self._d[lane]
+        for i in [i for i in d if i >= index]:
+            del d[i]
+
+    def compact_below(self, lane: int, index: int):
+        d = self._d[lane]
+        for i in [i for i in d if i < index]:
+            del d[i]
+
+    def set_snapshot(self, lane: int, snap: Snapshot | None):
+        self._snap[lane] = snap
+
+    def snapshot(self, lane: int) -> Snapshot | None:
+        return self._snap[lane]
+
+
+# --------------------------------------------------------------------------
+# MsgBatch <-> Message conversion
+
+
+_MSG_SCALARS = (
+    ("type", "type"),
+    ("to", "to"),
+    ("frm", "frm"),
+    ("term", "term"),
+    ("log_term", "log_term"),
+    ("index", "index"),
+    ("commit", "commit"),
+    ("reject", "reject"),
+    ("reject_hint", "reject_hint"),
+    ("context", "context"),
+)
+
+
+def _msg_to_row(msg: Message, e: int) -> dict:
+    row = {b: getattr(msg, h) for h, b in _MSG_SCALARS}
+    ents = msg.entries[:e]
+    row["n_ents"] = len(ents)
+    row["ent_term"] = [x.term for x in ents] + [0] * (e - len(ents))
+    row["ent_type"] = [x.type for x in ents] + [0] * (e - len(ents))
+    row["ent_bytes"] = [len(x.data) for x in ents] + [0] * (e - len(ents))
+    snap = msg.snapshot
+    row["snap_index"] = snap.index if snap else 0
+    row["snap_term"] = snap.term if snap else 0
+    row["vote"] = 0
+    return row
+
+
+class _StateView:
+    """Cached numpy view of the device state, refreshed after kernel calls."""
+
+    def __init__(self):
+        self._cache = None
+        self._state = None
+
+    def refresh(self, state: RaftState):
+        self._state = state
+        self._cache = {}
+
+    def __getattr__(self, name):
+        if self._cache is None:
+            raise AttributeError(name)
+        if name not in self._cache:
+            self._cache[name] = np.asarray(getattr(self._state, name))
+        return self._cache[name]
+
+
+# --------------------------------------------------------------------------
+
+
+class RawNodeBatch:
+    """N RawNodes resident in one device batch."""
+
+    def __init__(
+        self,
+        shape: Shape,
+        ids: Iterable[int],
+        peers: np.ndarray,
+        learners: np.ndarray | None = None,
+        seed: int = 1,
+        cfg: LaneConfig | None = None,
+        **cfg_overrides,
+    ):
+        self.shape = shape
+        n = shape.n
+        if cfg is None:
+            cfg = make_lane_config(shape, **cfg_overrides)
+        self.state = init_state(
+            shape, np.asarray(list(ids), np.int32), peers, learners, seed=seed, cfg=cfg
+        )
+        # C++ payload arena when buildable; Python EntryStore otherwise
+        from raft_tpu.runtime.native import make_payload_store
+
+        self.store = make_payload_store(n)
+        self.view = _StateView()
+        self.view.refresh(self.state)
+        self._msgs: list[list[Message]] = [[] for _ in range(n)]
+        self._after_append: list[list[Message]] = [[] for _ in range(n)]
+        self._prev_hs = [HardState() for _ in range(n)]
+        self._prev_ss = [SoftState() for _ in range(n)]
+        self._read_states: list[list[ReadState]] = [[] for _ in range(n)]
+        e = shape.max_msg_entries
+        self._step_fn = jax.jit(partial(stepmod.step, max_entries=e))
+        self._tick_fn = jax.jit(lambda s, m: stepmod.tick(s, e, m))
+        self._post_cc_fn = jax.jit(partial(stepmod.post_conf_change, max_entries=e))
+
+    # -- kernel plumbing ---------------------------------------------------
+
+    def _inbox_one(self, lane: int, msg: Message) -> MsgBatch:
+        n, e = self.shape.n, self.shape.max_msg_entries
+        base = empty_batch((n,), e)
+        row = _msg_to_row(msg, e)
+        upd = {}
+        for f in dataclasses.fields(base):
+            arr = getattr(base, f.name)
+            val = jnp.asarray(row[f.name], arr.dtype)
+            upd[f.name] = arr.at[lane].set(val)
+        return MsgBatch(**upd)
+
+    def _collect_out(self, out: MsgBatch, exclude_lane_msgs: bool = False):
+        """Move kernel emissions into per-lane host queues."""
+        v = self.shape.v
+        types = np.asarray(out.type)
+        hot = np.nonzero(types != int(MT.MSG_NONE))
+        if len(hot[0]) == 0:
+            return
+        cols = {name: np.asarray(getattr(out, name)) for name in (
+            "type", "to", "frm", "term", "log_term", "index", "commit",
+            "reject", "reject_hint", "context", "n_ents", "ent_term",
+            "ent_type", "ent_bytes", "snap_index", "snap_term",
+        )}
+        for lane, slot in zip(*hot):
+            lane, slot = int(lane), int(slot)
+            m = Message(
+                type=int(cols["type"][lane, slot]),
+                to=int(cols["to"][lane, slot]),
+                frm=int(cols["frm"][lane, slot]),
+                term=int(cols["term"][lane, slot]),
+                log_term=int(cols["log_term"][lane, slot]),
+                index=int(cols["index"][lane, slot]),
+                commit=int(cols["commit"][lane, slot]),
+                reject=bool(cols["reject"][lane, slot]),
+                reject_hint=int(cols["reject_hint"][lane, slot]),
+                context=int(cols["context"][lane, slot]),
+            )
+            ne = int(cols["n_ents"][lane, slot])
+            if ne:
+                base_index = m.index
+                for k in range(ne):
+                    term = int(cols["ent_term"][lane, slot, k])
+                    idx = base_index + 1 + k
+                    etype, data = self.store.get(lane, idx, term)
+                    m.entries.append(
+                        Entry(
+                            term=term,
+                            index=idx,
+                            type=int(cols["ent_type"][lane, slot, k]),
+                            data=data,
+                        )
+                    )
+            si = int(cols["snap_index"][lane, slot])
+            if m.type == int(MT.MSG_SNAP):
+                snap = self.store.snapshot(lane)
+                m.snapshot = Snapshot(
+                    index=si,
+                    term=int(cols["snap_term"][lane, slot]),
+                    data=snap.data if snap and snap.index == si else b"",
+                    voters=self.peer_ids(lane, voters=True),
+                    learners=self.peer_ids(lane, learners=True),
+                )
+            if slot == v or m.to == int(self.view.id[lane]):
+                # self-addressed (after-append acks, own ReadIndex responses):
+                # stepped at Advance, never surfaced in Ready.messages
+                self._after_append[lane].append(m)
+            else:
+                self._msgs[lane].append(m)
+
+    def _run_step(self, lane: int, msg: Message):
+        """One kernel invocation with a single hot lane; payload bookkeeping."""
+        old_last = int(self.view.last[lane])
+        old_term = int(self.view.term[lane])
+        inbox = self._inbox_one(lane, msg)
+        self.state, out = self._step_fn(self.state, inbox)
+        self.view.refresh(self.state)
+        # payloads first: fan-out messages emitted by this same step resolve
+        # their entry bytes from the store
+        self._store_accepted_payloads(lane, msg, old_last, old_term)
+        self._collect_out(out)
+
+    def _store_accepted_payloads(
+        self, lane: int, msg: Message, old_last: int, old_term: int
+    ):
+        if not msg.entries:
+            return
+        w = self.shape.w
+        log_term = self.view.log_term[lane]
+        log_type = self.view.log_type[lane]
+        last = int(self.view.last[lane])
+        cur_term = int(self.view.term[lane])
+        if msg.type == int(MT.MSG_PROP):
+            # device stamped entries with the lane's current term at old_last+
+            for k, e in enumerate(msg.entries):
+                idx = old_last + 1 + k
+                if idx <= last and int(log_term[idx & (w - 1)]) == cur_term:
+                    self.store.put(
+                        lane,
+                        Entry(cur_term, idx, int(log_type[idx & (w - 1)]), e.data),
+                    )
+        else:  # MsgApp
+            for e in msg.entries:
+                if e.index <= last and int(log_term[e.index & (w - 1)]) == e.term:
+                    self.store.put(lane, Entry(e.term, e.index, e.type, e.data))
+
+    # -- public API (the RawNode method set, reference rawnode.go) ---------
+
+    def step(self, lane: int, msg: Message):
+        """reference: rawnode.go:108-125 (rejects local message types)."""
+        if msg.type in (int(MT.MSG_HUP), int(MT.MSG_BEAT)) or msg.type in (
+            int(MT.MSG_STORAGE_APPEND),
+            int(MT.MSG_STORAGE_APPLY),
+        ):
+            raise ValueError(f"cannot step raft local message {msg.type}")
+        self._run_step(lane, msg)
+        if msg.type == int(MT.MSG_SNAP) and msg.snapshot is not None:
+            snap = msg.snapshot
+            if int(self.view.pending_snap_index[lane]) == snap.index:
+                # restore accepted on device: adopt the snapshot's ConfState
+                # (reference: raft.go:1835-1850 restore -> switchToConfig)
+                # and the payload state host-side
+                from raft_tpu import confchange as ccm
+
+                cs = ccm.ConfState(
+                    voters=tuple(snap.voters),
+                    learners=tuple(snap.learners),
+                    voters_outgoing=tuple(snap.voters_outgoing),
+                    learners_next=tuple(snap.learners_next),
+                    auto_leave=snap.auto_leave,
+                )
+                cfg, trk = ccm.restore(cs, last_index=snap.index)
+                self._write_tracker(lane, cfg, trk)
+                self.store.set_snapshot(lane, snap)
+                self.store.compact_below(lane, snap.index + 1)
+
+    def campaign(self, lane: int):
+        self._run_step(lane, Message(type=int(MT.MSG_HUP), to=self.id_of(lane)))
+
+    def propose(self, lane: int, data: bytes):
+        nid = self.id_of(lane)
+        self._run_step(
+            lane,
+            Message(
+                type=int(MT.MSG_PROP), to=nid, frm=nid, entries=[Entry(data=data)]
+            ),
+        )
+
+    def propose_conf_change(self, lane: int, cc_data: bytes, v2: bool = False):
+        nid = self.id_of(lane)
+        t = EntryType.ENTRY_CONF_CHANGE_V2 if v2 else EntryType.ENTRY_CONF_CHANGE
+        self._run_step(
+            lane,
+            Message(
+                type=int(MT.MSG_PROP),
+                to=nid,
+                frm=nid,
+                entries=[Entry(type=int(t), data=cc_data)],
+            ),
+        )
+
+    def transfer_leadership(self, lane: int, transferee: int):
+        self._run_step(
+            lane,
+            Message(
+                type=int(MT.MSG_TRANSFER_LEADER),
+                to=self.id_of(lane),
+                frm=transferee,
+            ),
+        )
+
+    def forget_leader(self, lane: int):
+        self._run_step(lane, Message(type=int(MT.MSG_FORGET_LEADER), to=self.id_of(lane)))
+
+    def report_unreachable(self, lane: int, peer: int):
+        self._run_step(
+            lane, Message(type=int(MT.MSG_UNREACHABLE), to=self.id_of(lane), frm=peer)
+        )
+
+    def report_snapshot(self, lane: int, peer: int, ok: bool):
+        self._run_step(
+            lane,
+            Message(
+                type=int(MT.MSG_SNAP_STATUS),
+                to=self.id_of(lane),
+                frm=peer,
+                reject=not ok,
+            ),
+        )
+
+    def read_index(self, lane: int, ctx: int):
+        nid = self.id_of(lane)
+        self._run_step(
+            lane, Message(type=int(MT.MSG_READ_INDEX), to=nid, frm=nid, context=ctx)
+        )
+
+    def tick(self, lane: int):
+        """reference: rawnode.go:69-73 + raft.go:823-862: tick fires local
+        messages which are immediately stepped."""
+        n = self.shape.n
+        mask = jnp.zeros((n,), bool).at[lane].set(True)
+        self.state, local = self._tick_fn(self.state, mask)
+        self.view.refresh(self.state)
+        lt = np.asarray(local.type)
+        for s in range(lt.shape[1]):
+            t = int(lt[lane, s])
+            if t != int(MT.MSG_NONE):
+                self._run_step(lane, Message(type=t, to=self.id_of(lane)))
+
+    # -- Ready/Advance (reference: rawnode.go:141-200, 404-491) ------------
+
+    def has_ready(self, lane: int) -> bool:
+        # pending after-append self-messages require an accept/advance cycle
+        # to be delivered (reference rawnode.go:450-472 checks msgsAfterAppend)
+        if self._after_append[lane]:
+            return True
+        return self.ready(lane, peek=True).contains_updates()
+
+    def ready(self, lane: int, peek: bool = False) -> Ready:
+        v = self.view
+        rd = Ready()
+        term, vote, commit = (
+            int(v.term[lane]),
+            int(v.vote[lane]),
+            int(v.committed[lane]),
+        )
+        hs = HardState(term, vote, commit)
+        if hs != self._prev_hs[lane] and not hs.is_empty():
+            rd.hard_state = hs
+        ss = SoftState(int(v.lead[lane]), int(v.state[lane]))
+        if ss != self._prev_ss[lane]:
+            rd.soft_state = ss
+        w = self.shape.w
+        for i in range(int(v.stabled[lane]) + 1, int(v.last[lane]) + 1):
+            t = int(v.log_term[lane, i & (w - 1)])
+            etype, data = self.store.get(lane, i, t)
+            rd.entries.append(Entry(t, i, int(v.log_type[lane, i & (w - 1)]), data))
+        # pending snapshot to persist (reference Ready.Snapshot)
+        psi = int(v.pending_snap_index[lane])
+        if psi:
+            snap = self.store.snapshot(lane)
+            rd.snapshot = snap if snap and snap.index == psi else Snapshot(
+                index=psi, term=int(v.pending_snap_term[lane])
+            )
+        # committed entries (applied, committed], byte-paginated (log.go:216-240)
+        budget = int(np.asarray(self.state.cfg.max_committed_size_per_ready[lane]))
+        lo, hi = int(v.applied[lane]) + 1, commit
+        if psi:
+            hi = lo - 1  # snapshot must be applied first
+        for i in range(lo, hi + 1):
+            t = int(v.log_term[lane, i & (w - 1)])
+            etype, data = self.store.get(lane, i, t)
+            ent = Entry(t, i, int(v.log_type[lane, i & (w - 1)]), data)
+            rd.committed_entries.append(ent)
+            budget -= len(data)
+            if budget <= 0:
+                break
+        rd.messages = list(self._msgs[lane])
+        # drain the device-side ReadState ring (reference: raft.go:371)
+        nrs = int(v.rs_count[lane])
+        rd.read_states = [
+            ReadState(index=int(v.rs_index[lane, r]), request_ctx=int(v.rs_ctx[lane, r]))
+            for r in range(nrs)
+        ] + list(self._read_states[lane])
+        rd.must_sync = bool(
+            rd.entries
+            or (rd.hard_state and (term != self._prev_hs[lane].term or vote != self._prev_hs[lane].vote))
+            or rd.snapshot
+        )
+        if not peek:
+            # acceptReady (reference rawnode.go:404-440)
+            if rd.hard_state:
+                self._prev_hs[lane] = rd.hard_state
+            if rd.soft_state:
+                self._prev_ss[lane] = rd.soft_state
+            self._msgs[lane] = []
+            self._read_states[lane] = []
+            if nrs:
+                self.state = dataclasses.replace(
+                    self.state, rs_count=self.state.rs_count.at[lane].set(0)
+                )
+                self.view.refresh(self.state)
+            self._accepted = getattr(self, "_accepted", {})
+            self._accepted[lane] = rd
+        return rd
+
+    def advance(self, lane: int):
+        """reference: rawnode.go:479-491 — ack storage, then deliver the
+        after-append self-messages."""
+        rd = getattr(self, "_accepted", {}).pop(lane, None)
+        if rd is None:
+            return
+        v = self.view
+        nid = self.id_of(lane)
+        if rd.snapshot and rd.snapshot.index:
+            self._run_step(
+                lane,
+                Message(
+                    type=int(MT.MSG_STORAGE_APPEND_RESP),
+                    to=nid,
+                    snapshot=rd.snapshot,
+                ),
+            )
+        if rd.entries:
+            last = rd.entries[-1]
+            self._run_step(
+                lane,
+                Message(
+                    type=int(MT.MSG_STORAGE_APPEND_RESP),
+                    to=nid,
+                    index=last.index,
+                    log_term=last.term,
+                ),
+            )
+        if rd.committed_entries:
+            last = rd.committed_entries[-1]
+            nbytes = sum(len(e.data) for e in rd.committed_entries)
+            self._run_step(
+                lane,
+                Message(
+                    type=int(MT.MSG_STORAGE_APPLY_RESP),
+                    to=nid,
+                    index=last.index,
+                    commit=nbytes,
+                ),
+            )
+        pending = self._after_append[lane]
+        self._after_append[lane] = []
+        for m in pending:
+            self._run_step(lane, m)
+        # auto-leave: leader proposes the empty V2 leave once the joint entry
+        # is applied (reference: raft.go:717-745 appliedTo)
+        v = self.view
+        if (
+            bool(v.auto_leave[lane])
+            and int(v.applied[lane]) >= int(v.pending_conf_index[lane])
+            and int(v.state[lane]) == int(StateType.LEADER)
+            and int(v.lead_transferee[lane]) == 0
+        ):
+            self.propose_conf_change(lane, b"", v2=True)
+
+    # -- snapshot/compaction (reference: storage.go:227-272) ---------------
+
+    def compact(self, lane: int, to_index: int, data: bytes = b""):
+        """App-driven compaction: CreateSnapshot(to_index, data) + Compact
+        (reference: storage.go:227-272). to_index must be <= applied."""
+        v = self.view
+        if to_index > int(v.applied[lane]):
+            raise ValueError("cannot compact beyond applied")
+        if to_index <= int(v.snap_index[lane]):
+            return
+        w = self.shape.w
+        term = int(v.log_term[lane, to_index & (w - 1)])
+        from raft_tpu.ops import log as lg
+
+        mask_idx = jnp.zeros((self.shape.n,), I32).at[lane].set(to_index)
+        mask_term = jnp.zeros((self.shape.n,), I32).at[lane].set(term)
+        self.state = lg.compact(self.state, mask_idx, mask_term)
+        self.view.refresh(self.state)
+        self.store.compact_below(lane, to_index + 1)
+        self.store.set_snapshot(
+            lane,
+            Snapshot(
+                index=to_index,
+                term=term,
+                data=data,
+                voters=self.peer_ids(lane, voters=True),
+                learners=self.peer_ids(lane, learners=True),
+            ),
+        )
+
+    # -- conf changes (reference: raft.go:1888-1970, node.go ApplyConfChange)
+
+    def _extract_tracker(self, lane: int):
+        from raft_tpu import confchange as ccm
+
+        v = self.view
+        cfg = ccm.TrackerConfig(auto_leave=bool(v.auto_leave[lane]))
+        trk: dict[int, ccm.Progress] = {}
+        for j in range(self.shape.v):
+            nid = int(v.prs_id[lane, j])
+            if not nid:
+                continue
+            if v.voters_in[lane, j]:
+                cfg.voters_in.add(nid)
+            if v.voters_out[lane, j]:
+                cfg.voters_out.add(nid)
+            if v.learners[lane, j]:
+                cfg.learners.add(nid)
+            if v.learners_next[lane, j]:
+                cfg.learners_next.add(nid)
+            trk[nid] = ccm.Progress(
+                match=int(v.pr_match[lane, j]),
+                next=int(v.pr_next[lane, j]),
+                state=int(v.pr_state[lane, j]),
+                is_learner=bool(v.learners[lane, j]),
+                recent_active=bool(v.pr_recent_active[lane, j]),
+                msg_app_flow_paused=bool(v.pr_msg_app_flow_paused[lane, j]),
+                pending_snapshot=int(v.pr_pending_snapshot[lane, j]),
+            )
+        return cfg, trk
+
+    def _write_tracker(self, lane: int, cfg, trk):
+        """Install (cfg, trk) into the lane's membership/progress rows.
+        Surviving ids keep their slots (so untouched progress — including
+        inflight windows — carries over); removed slots are cleared; new ids
+        land in free slots."""
+        v = self.shape.v
+        view = self.view
+        cur = [int(view.prs_id[lane, j]) for j in range(v)]
+        ids = set(trk)
+        if len(ids) > v:
+            raise ValueError(f"config needs {len(ids)} slots, capacity {v}")
+        slot_of: dict[int, int] = {}
+        for j, nid in enumerate(cur):
+            if nid and nid in ids:
+                slot_of[nid] = j
+        free = [j for j in range(v) if cur[j] not in ids or not cur[j]]
+        for nid in sorted(ids - set(slot_of)):
+            slot_of[nid] = free.pop(0)
+
+        import numpy as np_
+
+        prs_id = np_.zeros((v,), np_.int32)
+        m_in = np_.zeros((v,), bool)
+        m_out = np_.zeros((v,), bool)
+        m_l = np_.zeros((v,), bool)
+        m_ln = np_.zeros((v,), bool)
+        pr_match = np_.zeros((v,), np_.int32)
+        pr_next = np_.ones((v,), np_.int32)
+        pr_state = np_.zeros((v,), np_.int32)
+        pr_ra = np_.zeros((v,), bool)
+        pr_paused = np_.zeros((v,), bool)
+        pr_psnap = np_.zeros((v,), np_.int32)
+        for nid, j in slot_of.items():
+            pr = trk[nid]
+            prs_id[j] = nid
+            m_in[j] = nid in cfg.voters_in
+            m_out[j] = nid in cfg.voters_out
+            m_l[j] = nid in cfg.learners
+            m_ln[j] = nid in cfg.learners_next
+            pr_match[j] = pr.match
+            pr_next[j] = pr.next
+            pr_state[j] = pr.state
+            pr_ra[j] = pr.recent_active
+            pr_paused[j] = pr.msg_app_flow_paused
+            pr_psnap[j] = pr.pending_snapshot
+
+        nid_self = self.id_of(lane)
+        st = self.state
+        st = dataclasses.replace(
+            st,
+            prs_id=st.prs_id.at[lane].set(prs_id),
+            voters_in=st.voters_in.at[lane].set(m_in),
+            voters_out=st.voters_out.at[lane].set(m_out),
+            learners=st.learners.at[lane].set(m_l),
+            learners_next=st.learners_next.at[lane].set(m_ln),
+            auto_leave=st.auto_leave.at[lane].set(cfg.auto_leave),
+            pr_match=st.pr_match.at[lane].set(pr_match),
+            pr_next=st.pr_next.at[lane].set(pr_next),
+            pr_state=st.pr_state.at[lane].set(pr_state),
+            pr_recent_active=st.pr_recent_active.at[lane].set(pr_ra),
+            pr_msg_app_flow_paused=st.pr_msg_app_flow_paused.at[lane].set(pr_paused),
+            pr_pending_snapshot=st.pr_pending_snapshot.at[lane].set(pr_psnap),
+            is_learner=st.is_learner.at[lane].set(nid_self in cfg.learners),
+        )
+        self.state = st
+        self.view.refresh(st)
+
+    def apply_conf_change(self, lane: int, cc) -> "object":
+        """Apply a committed conf change; returns the resulting ConfState
+        (reference: raft.go:1888-1970 applyConfChange/switchToConfig)."""
+        from raft_tpu import confchange as ccm
+
+        cc2 = cc.as_v2()
+        cfg0, trk0 = self._extract_tracker(lane)
+        last = int(self.view.last[lane])
+        ch = ccm.Changer(cfg0, trk0, last)
+        if cc2.leave_joint():
+            cfg, trk = ch.leave_joint()
+        else:
+            auto_leave, use_joint = cc2.enter_joint()
+            if use_joint:
+                cfg, trk = ch.enter_joint(auto_leave, cc2.changes)
+            else:
+                cfg, trk = ch.simple(cc2.changes)
+        self._write_tracker(lane, cfg, trk)
+
+        nid = self.id_of(lane)
+        removed_or_learner = nid not in cfg.voters_in | cfg.voters_out
+        step_down = bool(
+            np.asarray(self.state.cfg.step_down_on_removal[lane])
+        ) and (removed_or_learner or nid in cfg.learners)
+        st = self.state
+        if step_down and int(self.view.state[lane]) == int(StateType.LEADER):
+            # becomeFollower(term, None) at unchanged term (raft.go:1930-1936)
+            st = dataclasses.replace(
+                st,
+                state=st.state.at[lane].set(int(StateType.FOLLOWER)),
+                lead=st.lead.at[lane].set(0),
+                lead_transferee=st.lead_transferee.at[lane].set(0),
+                election_elapsed=st.election_elapsed.at[lane].set(0),
+            )
+            self.state = st
+            self.view.refresh(st)
+        # leader follow-ups on device (commit under new quorum / probe newcomers)
+        mask = jnp.zeros((self.shape.n,), bool).at[lane].set(True)
+        self.state, out = self._post_cc_fn(self.state, mask)
+        self.view.refresh(self.state)
+        self._collect_out(out)
+        return ccm.conf_state(cfg)
+
+    # -- introspection -----------------------------------------------------
+
+    def id_of(self, lane: int) -> int:
+        return int(self.view.id[lane])
+
+    def peer_ids(self, lane: int, voters=False, learners=False) -> tuple:
+        v = self.view
+        ids = v.prs_id[lane]
+        if voters:
+            m = v.voters_in[lane]
+        elif learners:
+            m = v.learners[lane]
+        else:
+            m = ids != 0
+        return tuple(int(x) for x in np.sort(ids[m & (ids != 0)]))
+
+    def basic_status(self, lane: int) -> dict:
+        """reference: status.go:26-42."""
+        v = self.view
+        return {
+            "id": self.id_of(lane),
+            "term": int(v.term[lane]),
+            "vote": int(v.vote[lane]),
+            "commit": int(v.committed[lane]),
+            "lead": int(v.lead[lane]),
+            "raft_state": StateType(int(v.state[lane])).name,
+            "applied": int(v.applied[lane]),
+            "lead_transferee": int(v.lead_transferee[lane]),
+        }
+
+    def status(self, lane: int) -> dict:
+        """reference: status.go:44-76 — adds config + progress when leader."""
+        st = self.basic_status(lane)
+        v = self.view
+        st["config"] = {
+            "voters": self.peer_ids(lane, voters=True),
+            "voters_outgoing": tuple(
+                int(x)
+                for x in np.sort(v.prs_id[lane][v.voters_out[lane]])
+                if x
+            ),
+            "learners": self.peer_ids(lane, learners=True),
+            "auto_leave": bool(v.auto_leave[lane]),
+        }
+        if int(v.state[lane]) == int(StateType.LEADER):
+            prog = {}
+            for j in range(self.shape.v):
+                pid = int(v.prs_id[lane, j])
+                if not pid:
+                    continue
+                prog[pid] = {
+                    "match": int(v.pr_match[lane, j]),
+                    "next": int(v.pr_next[lane, j]),
+                    "state": ProgressState(int(v.pr_state[lane, j])).name,
+                    "paused": bool(v.pr_msg_app_flow_paused[lane, j]),
+                    "pending_snapshot": int(v.pr_pending_snapshot[lane, j]),
+                    "recent_active": bool(v.pr_recent_active[lane, j]),
+                    "is_learner": bool(v.learners[lane, j]),
+                }
+            st["progress"] = prog
+        return st
+
+
+class RawNode:
+    """Single-node view onto one lane of a RawNodeBatch — the reference's
+    `RawNode` API shape (reference: rawnode.go:34-66)."""
+
+    def __init__(self, batch: RawNodeBatch, lane: int):
+        self.batch = batch
+        self.lane = lane
+
+    def tick(self):
+        self.batch.tick(self.lane)
+
+    def campaign(self):
+        self.batch.campaign(self.lane)
+
+    def propose(self, data: bytes):
+        self.batch.propose(self.lane, data)
+
+    def step(self, msg: Message):
+        self.batch.step(self.lane, msg)
+
+    def has_ready(self) -> bool:
+        return self.batch.has_ready(self.lane)
+
+    def ready(self) -> Ready:
+        return self.batch.ready(self.lane)
+
+    def advance(self):
+        self.batch.advance(self.lane)
+
+    def status(self) -> dict:
+        return self.batch.status(self.lane)
+
+    def basic_status(self) -> dict:
+        return self.batch.basic_status(self.lane)
+
+    def transfer_leadership(self, transferee: int):
+        self.batch.transfer_leadership(self.lane, transferee)
+
+    def report_unreachable(self, peer: int):
+        self.batch.report_unreachable(self.lane, peer)
+
+    def report_snapshot(self, peer: int, ok: bool):
+        self.batch.report_snapshot(self.lane, peer, ok)
+
+    def read_index(self, ctx: int):
+        self.batch.read_index(self.lane, ctx)

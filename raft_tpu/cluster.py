@@ -34,7 +34,7 @@ from raft_tpu.messages import MsgBatch, empty_batch
 from raft_tpu.ops import log as lg
 from raft_tpu.ops import step as stepmod
 from raft_tpu.state import RaftState, init_state, make_lane_config
-from raft_tpu.types import MessageType as MT
+from raft_tpu.types import MessageType as MT, StateType
 
 I32 = jnp.int32
 
@@ -45,6 +45,7 @@ def route(
     lane_of: jnp.ndarray,
     m_in: int,
     drop_mask: jnp.ndarray | None = None,
+    lane_offset=0,
 ) -> tuple[MsgBatch, jnp.ndarray]:
     """Deliver outbox messages to per-lane inboxes.
 
@@ -53,6 +54,9 @@ def route(
     lane_of: [G, max_id+1] lane index for (group, raft id); -1 if absent.
     drop_mask: optional [N, S] bool — drop these messages (fault injection,
       the analog of rafttest/network.go:122-144 drop/disconnect).
+    lane_offset: subtracted from lane_of's (global) lane numbers — inside a
+      shard_map shard, pass axis_index * lanes_per_shard so delivery targets
+      local rows (groups never span shards, so every destination is local).
 
     Returns (inbox [N, m_in], n_dropped_overflow).
     """
@@ -66,8 +70,8 @@ def route(
     if drop_mask is not None:
         valid = valid & ~drop_mask.reshape(k)
     to = jnp.clip(flat.to, 0, lane_of.shape[1] - 1)
-    dst = jnp.where(valid, lane_of[group, to], -1)
-    valid = valid & (dst >= 0)
+    dst = jnp.where(valid, lane_of[group, to] - lane_offset, -1)
+    valid = valid & (dst >= 0) & (dst < n)
 
     # stable sort by destination; invalid messages sort to the end
     key = jnp.where(valid, dst, n)
@@ -257,11 +261,13 @@ class Cluster:
     # -- inspection -------------------------------------------------------
 
     def leader_lanes(self) -> np.ndarray:
-        return np.nonzero(np.asarray(self.state.state) == 2)[0]
+        return np.nonzero(np.asarray(self.state.state) == int(StateType.LEADER))[0]
 
     def lanes_of_group(self, g: int) -> slice:
         return slice(g * self.v, (g + 1) * self.v)
 
-    def check_no_errors(self):
+    def check_no_errors(self, allow_drops: bool = False):
         bits = np.asarray(self.state.error_bits)
         assert (bits == 0).all(), f"error_bits set: lanes {np.nonzero(bits)[0].tolist()}"
+        if not allow_drops:
+            assert self.dropped == 0, f"{self.dropped} messages dropped on inbox overflow"

@@ -1,0 +1,392 @@
+"""Joint-consensus membership-change engine (host side).
+
+Conf changes are the reference's rare path and stay on host per SURVEY §7
+("keep genuinely rare paths on host"): the committed ConfChange entry is
+decoded here, the lane's tracker state is pulled off-device, transformed by a
+faithful port of the reference `Changer` semantics (reference:
+confchange/confchange.go:51-332), and written back as one row update.
+
+Also provides:
+- the V1/V2 conf-change data model + byte encoding (the raftpb analog —
+  reference: raftpb/raft.proto:152-214, raftpb/confchange.go:27-155). The
+  encoding is this engine's own compact struct packing (payload bytes are
+  opaque to the reference algorithm, so wire compatibility is not required);
+- `restore()` — replay a ConfState onto an empty config (reference:
+  confchange/restore.go:26-155);
+- the "v1 l2 r3 u4" text DSL used throughout the reference's tests
+  (reference: raftpb/confchange.go:121-155).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+
+
+class ConfChangeType(enum.IntEnum):
+    # reference: raftpb/raft.proto:166-171
+    ADD_NODE = 0
+    REMOVE_NODE = 1
+    UPDATE_NODE = 2
+    ADD_LEARNER_NODE = 3
+
+
+class ConfChangeTransition(enum.IntEnum):
+    # reference: raftpb/raft.proto:152-165
+    AUTO = 0
+    JOINT_IMPLICIT = 1
+    JOINT_EXPLICIT = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfChangeSingle:
+    # reference: raftpb/raft.proto:187-190
+    type: int
+    node_id: int
+
+
+@dataclasses.dataclass
+class ConfChange:
+    """V1 single-step change (reference: raftpb/raft.proto:173-185)."""
+
+    type: int = int(ConfChangeType.ADD_NODE)
+    node_id: int = 0
+    context: bytes = b""
+
+    def as_v2(self) -> "ConfChangeV2":
+        return ConfChangeV2(
+            changes=[ConfChangeSingle(self.type, self.node_id)],
+            context=self.context,
+        )
+
+
+@dataclasses.dataclass
+class ConfChangeV2:
+    """reference: raftpb/raft.proto:192-214."""
+
+    transition: int = int(ConfChangeTransition.AUTO)
+    changes: list = dataclasses.field(default_factory=list)
+    context: bytes = b""
+
+    def as_v2(self) -> "ConfChangeV2":
+        return self
+
+    def enter_joint(self) -> tuple[bool, bool]:
+        """(auto_leave, use_joint). reference: raftpb/confchange.go:82-104."""
+        if self.transition != ConfChangeTransition.AUTO or len(self.changes) > 1:
+            auto_leave = self.transition in (
+                ConfChangeTransition.AUTO,
+                ConfChangeTransition.JOINT_IMPLICIT,
+            )
+            return auto_leave, True
+        return False, False
+
+    def leave_joint(self) -> bool:
+        """reference: raftpb/confchange.go:106-112."""
+        return self.transition == ConfChangeTransition.AUTO and not self.changes
+
+
+# -- byte encoding (engine-native, not protobuf) ---------------------------
+
+_V1_MAGIC = 0xC1
+_V2_MAGIC = 0xC2
+
+
+def encode(cc: ConfChange | ConfChangeV2) -> bytes:
+    if isinstance(cc, ConfChange):
+        return struct.pack("<BBi", _V1_MAGIC, cc.type, cc.node_id) + cc.context
+    b = struct.pack("<BBH", _V2_MAGIC, cc.transition, len(cc.changes))
+    for ch in cc.changes:
+        b += struct.pack("<Bi", ch.type, ch.node_id)
+    return b + cc.context
+
+
+def decode(data: bytes) -> ConfChange | ConfChangeV2:
+    if not data:
+        # empty V2 payload = leave-joint (reference: raftpb/confchange.go:106)
+        return ConfChangeV2()
+    magic = data[0]
+    if magic == _V1_MAGIC:
+        _, t, nid = struct.unpack_from("<BBi", data)
+        return ConfChange(type=t, node_id=nid, context=data[6:])
+    if magic == _V2_MAGIC:
+        _, tr, n = struct.unpack_from("<BBH", data)
+        off = 4
+        changes = []
+        for _ in range(n):
+            t, nid = struct.unpack_from("<Bi", data, off)
+            off += 5
+            changes.append(ConfChangeSingle(t, nid))
+        return ConfChangeV2(transition=tr, changes=changes, context=data[off:])
+    raise ValueError(f"bad conf-change payload: {data[:8]!r}")
+
+
+def conf_changes_from_string(s: str) -> list[ConfChangeSingle]:
+    """reference: raftpb/confchange.go:121-155 — "v1 l2 r3 u4"."""
+    ops = {
+        "v": ConfChangeType.ADD_NODE,
+        "l": ConfChangeType.ADD_LEARNER_NODE,
+        "r": ConfChangeType.REMOVE_NODE,
+        "u": ConfChangeType.UPDATE_NODE,
+    }
+    out = []
+    for tok in s.split():
+        if tok[0] not in ops:
+            raise ValueError(f"unknown conf-change op {tok!r}")
+        out.append(ConfChangeSingle(int(ops[tok[0]]), int(tok[1:])))
+    return out
+
+
+# -- tracker-side model ----------------------------------------------------
+
+
+@dataclasses.dataclass
+class TrackerConfig:
+    """reference: tracker/tracker.go:27-78."""
+
+    voters_in: set = dataclasses.field(default_factory=set)
+    voters_out: set = dataclasses.field(default_factory=set)
+    learners: set = dataclasses.field(default_factory=set)
+    learners_next: set = dataclasses.field(default_factory=set)
+    auto_leave: bool = False
+
+    @property
+    def joint(self) -> bool:
+        return bool(self.voters_out)
+
+    def clone(self) -> "TrackerConfig":
+        return TrackerConfig(
+            set(self.voters_in),
+            set(self.voters_out),
+            set(self.learners),
+            set(self.learners_next),
+            self.auto_leave,
+        )
+
+
+@dataclasses.dataclass
+class Progress:
+    """Host mirror of one [lane, slot] progress cell."""
+
+    match: int = 0
+    next: int = 1
+    state: int = 0
+    is_learner: bool = False
+    recent_active: bool = False
+    msg_app_flow_paused: bool = False
+    pending_snapshot: int = 0
+
+
+@dataclasses.dataclass
+class ConfState:
+    """reference: raftpb/raft.proto:136-151."""
+
+    voters: tuple = ()
+    learners: tuple = ()
+    voters_outgoing: tuple = ()
+    learners_next: tuple = ()
+    auto_leave: bool = False
+
+
+def conf_state(cfg: TrackerConfig) -> ConfState:
+    return ConfState(
+        voters=tuple(sorted(cfg.voters_in)),
+        learners=tuple(sorted(cfg.learners)),
+        voters_outgoing=tuple(sorted(cfg.voters_out)),
+        learners_next=tuple(sorted(cfg.learners_next)),
+        auto_leave=cfg.auto_leave,
+    )
+
+
+class ConfChangeError(Exception):
+    pass
+
+
+class Changer:
+    """reference: confchange/confchange.go:39-49."""
+
+    def __init__(self, cfg: TrackerConfig, progress: dict[int, Progress], last_index: int):
+        self.cfg = cfg
+        self.progress = progress
+        self.last_index = last_index
+
+    # -- entry points ------------------------------------------------------
+
+    def enter_joint(
+        self, auto_leave: bool, ccs: list[ConfChangeSingle]
+    ) -> tuple[TrackerConfig, dict[int, Progress]]:
+        """reference: confchange/confchange.go:51-78."""
+        cfg, trk = self._check_and_copy()
+        if cfg.joint:
+            raise ConfChangeError("config is already joint")
+        if not cfg.voters_in:
+            raise ConfChangeError("can't make a zero-voter config joint")
+        cfg.voters_out = set(cfg.voters_in)
+        self._apply(cfg, trk, ccs)
+        cfg.auto_leave = auto_leave
+        return self._check_and_return(cfg, trk)
+
+    def leave_joint(self) -> tuple[TrackerConfig, dict[int, Progress]]:
+        """reference: confchange/confchange.go:94-121."""
+        cfg, trk = self._check_and_copy()
+        if not cfg.joint:
+            raise ConfChangeError("can't leave a non-joint config")
+        for nid in cfg.learners_next:
+            cfg.learners.add(nid)
+            trk[nid].is_learner = True
+        cfg.learners_next = set()
+        for nid in list(cfg.voters_out):
+            if nid not in cfg.voters_in and nid not in cfg.learners:
+                trk.pop(nid, None)
+        cfg.voters_out = set()
+        cfg.auto_leave = False
+        return self._check_and_return(cfg, trk)
+
+    def simple(
+        self, ccs: list[ConfChangeSingle]
+    ) -> tuple[TrackerConfig, dict[int, Progress]]:
+        """reference: confchange/confchange.go:128-145."""
+        cfg, trk = self._check_and_copy()
+        if cfg.joint:
+            raise ConfChangeError("can't apply simple config change in joint config")
+        self._apply(cfg, trk, ccs)
+        if len(self.cfg.voters_in ^ cfg.voters_in) > 1:
+            raise ConfChangeError(
+                "more than one voter changed without entering joint config"
+            )
+        return self._check_and_return(cfg, trk)
+
+    # -- internals (reference: confchange/confchange.go:150-271) -----------
+
+    def _apply(self, cfg, trk, ccs):
+        for cc in ccs:
+            if cc.node_id == 0:
+                continue  # etcd zeroes NodeID for no-op changes
+            if cc.type == ConfChangeType.ADD_NODE:
+                self._make_voter(cfg, trk, cc.node_id)
+            elif cc.type == ConfChangeType.ADD_LEARNER_NODE:
+                self._make_learner(cfg, trk, cc.node_id)
+            elif cc.type == ConfChangeType.REMOVE_NODE:
+                self._remove(cfg, trk, cc.node_id)
+            elif cc.type == ConfChangeType.UPDATE_NODE:
+                pass
+            else:
+                raise ConfChangeError(f"unexpected conf type {cc.type}")
+        if not cfg.voters_in:
+            raise ConfChangeError("removed all voters")
+
+    def _make_voter(self, cfg, trk, nid):
+        pr = trk.get(nid)
+        if pr is None:
+            self._init_progress(cfg, trk, nid, is_learner=False)
+            return
+        pr.is_learner = False
+        cfg.learners.discard(nid)
+        cfg.learners_next.discard(nid)
+        cfg.voters_in.add(nid)
+
+    def _make_learner(self, cfg, trk, nid):
+        pr = trk.get(nid)
+        if pr is None:
+            self._init_progress(cfg, trk, nid, is_learner=True)
+            return
+        if pr.is_learner:
+            return
+        self._remove(cfg, trk, nid)
+        trk[nid] = pr  # ...but save the Progress
+        if nid in cfg.voters_out:
+            cfg.learners_next.add(nid)
+        else:
+            pr.is_learner = True
+            cfg.learners.add(nid)
+
+    def _remove(self, cfg, trk, nid):
+        if nid not in trk:
+            return
+        cfg.voters_in.discard(nid)
+        cfg.learners.discard(nid)
+        cfg.learners_next.discard(nid)
+        if nid not in cfg.voters_out:
+            del trk[nid]
+
+    def _init_progress(self, cfg, trk, nid, is_learner):
+        if not is_learner:
+            cfg.voters_in.add(nid)
+        else:
+            cfg.learners.add(nid)
+        trk[nid] = Progress(
+            match=0,
+            next=max(self.last_index, 1),
+            is_learner=is_learner,
+            # RecentActive so CheckQuorum doesn't immediately depose us
+            # (reference: confchange.go:264-268)
+            recent_active=True,
+        )
+
+    # -- invariants (reference: confchange/confchange.go:276-332) ----------
+
+    def _check_invariants(self, cfg: TrackerConfig, trk: dict[int, Progress]):
+        for nid in cfg.voters_in | cfg.voters_out | cfg.learners | cfg.learners_next:
+            if nid not in trk:
+                raise ConfChangeError(f"no progress for {nid}")
+        for nid in cfg.learners_next:
+            if nid not in cfg.voters_out:
+                raise ConfChangeError(f"{nid} is in LearnersNext, but not Voters[1]")
+            if trk[nid].is_learner:
+                raise ConfChangeError(
+                    f"{nid} is in LearnersNext, but is already marked as learner"
+                )
+        for nid in cfg.learners:
+            if nid in cfg.voters_out:
+                raise ConfChangeError(f"{nid} is in Learners and Voters[1]")
+            if nid in cfg.voters_in:
+                raise ConfChangeError(f"{nid} is in Learners and Voters[0]")
+            if not trk[nid].is_learner:
+                raise ConfChangeError(f"{nid} is in Learners, but is not marked as learner")
+        if not cfg.joint:
+            if cfg.learners_next:
+                raise ConfChangeError("LearnersNext must be empty when not joint")
+            if cfg.auto_leave:
+                raise ConfChangeError("AutoLeave must be false when not joint")
+
+    def _check_and_copy(self):
+        cfg = self.cfg.clone()
+        trk = {nid: dataclasses.replace(pr) for nid, pr in self.progress.items()}
+        self._check_invariants(cfg, trk)
+        return cfg, trk
+
+    def _check_and_return(self, cfg, trk):
+        self._check_invariants(cfg, trk)
+        return cfg, trk
+
+
+def restore(
+    cs: ConfState, last_index: int
+) -> tuple[TrackerConfig, dict[int, Progress]]:
+    """Replay a ConfState onto an empty config (reference:
+    confchange/restore.go:26-155)."""
+    outgoing = [
+        ConfChangeSingle(int(ConfChangeType.ADD_NODE), nid)
+        for nid in cs.voters_outgoing
+    ]
+    incoming = (
+        [
+            ConfChangeSingle(int(ConfChangeType.REMOVE_NODE), nid)
+            for nid in cs.voters_outgoing
+        ]
+        + [ConfChangeSingle(int(ConfChangeType.ADD_NODE), nid) for nid in cs.voters]
+        + [
+            ConfChangeSingle(int(ConfChangeType.ADD_LEARNER_NODE), nid)
+            for nid in list(cs.learners) + list(cs.learners_next)
+        ]
+    )
+    cfg, trk = TrackerConfig(), {}
+    if not outgoing:
+        for cc in incoming:
+            cfg, trk = Changer(cfg, trk, last_index).simple([cc])
+    else:
+        for cc in outgoing:
+            cfg, trk = Changer(cfg, trk, last_index).simple([cc])
+        cfg, trk = Changer(cfg, trk, last_index).enter_joint(cs.auto_leave, incoming)
+    return cfg, trk

@@ -50,6 +50,7 @@ class Shape:
     log_window: int = 64
     max_msg_entries: int = 8
     max_inflight: int = 8
+    max_read_index: int = 4  # outstanding ReadIndex requests per lane ("R")
     outbox: int = 0  # 0 -> derived
 
     def __post_init__(self):

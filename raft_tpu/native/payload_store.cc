@@ -1,0 +1,148 @@
+// Host-side entry-payload store for the TPU-native multi-raft engine.
+//
+// The device keeps only (term, type, size) columns per log slot (SURVEY §7
+// state layout); the bytes live here, keyed (lane, index) with the term for
+// ABA protection — the native half of the reference's MemoryStorage
+// (reference: storage.go:98-310, which is a mutex-guarded []pb.Entry; here a
+// per-lane ordered map over an append-mostly workload, O(log W) per op with
+// W = live window length).
+//
+// C ABI (ctypes-friendly). Not thread-safe per store: the owning runtime
+// serializes access the same way the reference serializes MemoryStorage
+// behind its mutex (storage.go:99-102) — one writer loop per shard.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Rec {
+  int32_t term;
+  int32_t type;
+  std::string data;
+};
+
+struct Store {
+  std::vector<std::map<int32_t, Rec>> lanes;
+  int64_t total_bytes = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ps_new(int32_t n_lanes) {
+  auto* s = new Store();
+  s->lanes.resize(n_lanes);
+  return s;
+}
+
+void ps_free(void* p) { delete static_cast<Store*>(p); }
+
+void ps_put(void* p, int32_t lane, int32_t index, int32_t term, int32_t type,
+            const uint8_t* data, int32_t len) {
+  auto* s = static_cast<Store*>(p);
+  auto& m = s->lanes[lane];
+  auto it = m.find(index);
+  if (it != m.end()) {
+    s->total_bytes -= (int64_t)it->second.data.size();
+    m.erase(it);
+  }
+  Rec r;
+  r.term = term;
+  r.type = type;
+  r.data.assign(reinterpret_cast<const char*>(data), (size_t)len);
+  s->total_bytes += len;
+  m.emplace(index, std::move(r));
+}
+
+// Returns payload length, or -1 when missing / term mismatch (term 0 skips
+// the check). type_out receives the entry type.
+int32_t ps_get_len(void* p, int32_t lane, int32_t index, int32_t term,
+                   int32_t* type_out) {
+  auto* s = static_cast<Store*>(p);
+  auto& m = s->lanes[lane];
+  auto it = m.find(index);
+  if (it == m.end()) return -1;
+  if (term != 0 && it->second.term != term) return -1;
+  if (type_out) *type_out = it->second.type;
+  return (int32_t)it->second.data.size();
+}
+
+// Copies up to cap bytes into buf; returns copied length or -1.
+int32_t ps_get(void* p, int32_t lane, int32_t index, int32_t term,
+               uint8_t* buf, int32_t cap) {
+  auto* s = static_cast<Store*>(p);
+  auto& m = s->lanes[lane];
+  auto it = m.find(index);
+  if (it == m.end()) return -1;
+  if (term != 0 && it->second.term != term) return -1;
+  int32_t n = (int32_t)it->second.data.size();
+  if (n > cap) n = cap;
+  std::memcpy(buf, it->second.data.data(), (size_t)n);
+  return n;
+}
+
+// Drop entries with index >= from (log truncation on conflicting append,
+// reference: log_unstable.go:196-218).
+void ps_truncate_from(void* p, int32_t lane, int32_t from) {
+  auto* s = static_cast<Store*>(p);
+  auto& m = s->lanes[lane];
+  auto it = m.lower_bound(from);
+  while (it != m.end()) {
+    s->total_bytes -= (int64_t)it->second.data.size();
+    it = m.erase(it);
+  }
+}
+
+// Drop entries with index < below (compaction, reference: storage.go:251-272).
+void ps_compact_below(void* p, int32_t lane, int32_t below) {
+  auto* s = static_cast<Store*>(p);
+  auto& m = s->lanes[lane];
+  auto it = m.begin();
+  while (it != m.end() && it->first < below) {
+    s->total_bytes -= (int64_t)it->second.data.size();
+    it = m.erase(it);
+  }
+}
+
+int64_t ps_total_bytes(void* p) { return static_cast<Store*>(p)->total_bytes; }
+
+int32_t ps_lane_count(void* p, int32_t lane) {
+  return (int32_t)static_cast<Store*>(p)->lanes[lane].size();
+}
+
+// Batched fill for message construction: for each k in [0, n), look up
+// (lane[k], index[k], term[k]) and append its payload to out (offsets[k] =
+// running offset, lens[k] = -1 when missing). Returns total bytes written,
+// or -(needed) when out_cap is too small (caller retries with a bigger buf).
+int64_t ps_get_batch(void* p, const int32_t* lane, const int32_t* index,
+                     const int32_t* term, int32_t n, uint8_t* out,
+                     int64_t out_cap, int64_t* offsets, int32_t* lens,
+                     int32_t* types) {
+  auto* s = static_cast<Store*>(p);
+  int64_t off = 0;
+  for (int32_t k = 0; k < n; ++k) {
+    auto& m = s->lanes[lane[k]];
+    auto it = m.find(index[k]);
+    if (it == m.end() || (term[k] != 0 && it->second.term != term[k])) {
+      offsets[k] = off;
+      lens[k] = -1;
+      if (types) types[k] = 0;
+      continue;
+    }
+    int32_t len = (int32_t)it->second.data.size();
+    if (off + len > out_cap) return -(off + len);
+    std::memcpy(out + off, it->second.data.data(), (size_t)len);
+    offsets[k] = off;
+    lens[k] = len;
+    if (types) types[k] = it->second.type;
+    off += len;
+  }
+  return off;
+}
+
+}  // extern "C"

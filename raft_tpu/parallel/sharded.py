@@ -1,0 +1,147 @@
+"""Multi-chip scale-out: the cluster round under shard_map over a device mesh.
+
+The group axis is the engine's data-parallel axis (SURVEY §2.3): lanes of one
+raft group are contiguous, groups are distributed over the mesh's "groups"
+axis, and each shard steps + routes its own groups entirely locally — the
+round body contains no collectives at all, so it scales linearly over ICI,
+and XLA only inserts the scalar psum for the dropped-message counter.
+
+Cross-host/mesh raft groups (a group whose members live on different shards)
+are the host runtime's job, exactly like the reference leaves transport to
+the application (README.md:10-14): Ready messages addressed outside the
+shard's lane range are exported by the host router (see runtime/), not the
+device path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from raft_tpu.cluster import Cluster, route, scan_step, _bytes_between
+from raft_tpu.messages import MsgBatch, empty_batch
+from raft_tpu.ops import log as lg
+from raft_tpu.ops import step as stepmod
+from raft_tpu.types import MessageType as MT, StateType
+
+I32 = jnp.int32
+
+
+def _round_body(state, inbox, group_of, lane_of, *, m_in, do_tick, lanes_per_shard):
+    """Shard-local cluster round (runs inside shard_map)."""
+    e = inbox.ent_term.shape[-1]
+    if do_tick:
+        state, local = stepmod.tick(state, e)
+        inbox = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=1), local, inbox
+        )
+    state, out_all = scan_step(state, inbox)
+    state = dataclasses.replace(state, stabled=state.last)
+    applied_bytes = _bytes_between(state, state.applied, state.committed)
+    state = lg.applied_to(state, state.committed)
+    state = dataclasses.replace(
+        state,
+        uncommitted_size=jnp.clip(state.uncommitted_size - applied_bytes, 0),
+    )
+    offset = jax.lax.axis_index("groups") * lanes_per_shard
+    nxt, dropped = route(out_all, group_of, lane_of, m_in, lane_offset=offset)
+    dropped = jax.lax.psum(dropped, "groups")
+    return state, nxt, dropped
+
+
+class ShardedCluster(Cluster):
+    """A Cluster whose lane axis is sharded over a jax Mesh."""
+
+    def __init__(self, n_groups: int, n_voters: int, devices=None, **kw):
+        devices = devices if devices is not None else jax.devices()
+        if n_groups % len(devices):
+            raise ValueError("n_groups must divide evenly over devices")
+        super().__init__(n_groups, n_voters, **kw)
+        self.mesh = Mesh(np.asarray(devices), ("groups",))
+        self.lane_sharding = NamedSharding(self.mesh, P("groups"))
+        self.repl_sharding = NamedSharding(self.mesh, P())
+        n = self.shape.n
+        self.lanes_per_shard = n // len(devices)
+        if (n_groups // len(devices)) * n_voters != self.lanes_per_shard:
+            raise ValueError("groups must not straddle shard boundaries")
+
+        def shard_lanes(x):
+            if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == n:
+                return jax.device_put(x, self.lane_sharding)
+            return jax.device_put(x, self.repl_sharding)
+
+        self.state = jax.tree.map(shard_lanes, self.state)
+        self.group_of = jax.device_put(self.group_of, self.lane_sharding)
+        self.lane_of = jax.device_put(self.lane_of, self.repl_sharding)
+        self._round_cache: dict[bool, object] = {}
+
+    def _sharded_round(self, do_tick: bool):
+        if do_tick not in self._round_cache:
+            lane = P("groups")
+
+            def spec_like(tree):
+                return jax.tree.map(lambda _: lane, tree)
+
+            body = partial(
+                _round_body,
+                m_in=self.m_in,
+                do_tick=do_tick,
+                lanes_per_shard=self.lanes_per_shard,
+            )
+            sm = shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(
+                    spec_like(self.state),
+                    spec_like(jax.tree.map(jnp.asarray, self._pending)),
+                    lane,
+                    P(),
+                ),
+                out_specs=(
+                    spec_like(self.state),
+                    spec_like(jax.tree.map(jnp.asarray, self._pending)),
+                    P(),
+                ),
+            )
+            self._round_cache[do_tick] = jax.jit(sm)
+        return self._round_cache[do_tick]
+
+    def _do_round(self, do_tick: bool):
+        inbox = jax.tree.map(jnp.asarray, self._pending)
+        fn = self._sharded_round(do_tick)
+        self.state, nxt, dropped = fn(
+            self.state, inbox, self.group_of, self.lane_of
+        )
+        self._pending = jax.tree.map(lambda x: np.array(x), nxt)
+        self.dropped += int(dropped)
+
+    # device-resident fast path for benchmarking: no host mirrors
+    def run_device_rounds(self, n_rounds: int, do_tick: bool = True):
+        fn = self._sharded_round(do_tick)
+        state = self.state
+        pending = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self.lane_sharding),
+            self._pending,
+        )
+        total_dropped = jnp.zeros((), I32)
+        for i in range(n_rounds):
+            state, pending, dropped = fn(
+                state, pending, self.group_of, self.lane_of
+            )
+            total_dropped = total_dropped + dropped
+            if i % 8 == 7:  # bound in-flight executions (memory pressure)
+                jax.block_until_ready(state.term)
+        jax.block_until_ready(state.term)
+        self.state = state
+        self._pending = jax.tree.map(lambda x: np.array(x), pending)
+        self.dropped += int(total_dropped)

@@ -132,6 +132,20 @@ class RaftState:
     infl_count: Any  # [N, V] i32
     infl_total_bytes: Any  # [N, V] i32
 
+    # --- read-only (linearizable read) tracking ---
+    # Outstanding ReadOnlySafe requests: the batched readOnly queue
+    # (reference: read_only.go:39-43). A slot is live when ro_ctx != 0;
+    # ro_acks is the per-voter heartbeat-ack set (read_only.go:68-79).
+    ro_ctx: Any  # [N, R] i32 request ctx ticket (0 = free slot)
+    ro_from: Any  # [N, R] i32 requester raft id
+    ro_index: Any  # [N, R] i32 commit index captured at enqueue
+    ro_acks: Any  # [N, R, V] bool
+    # Released ReadStates awaiting host pickup (reference: raft.go:371
+    # readStates slice, drained by Ready).
+    rs_ctx: Any  # [N, R] i32
+    rs_index: Any  # [N, R] i32
+    rs_count: Any  # [N] i32
+
     # Where the reference panics on broken invariants (e.g. log.go:319-324,
     # log.go:135-137), a lockstep tensor program can't: violations set a bit
     # here and the offending update is clamped to a no-op. Tests and the host
@@ -198,6 +212,7 @@ def init_state(
     """
     n, v, w = shape.n, shape.v, shape.w
     f = shape.max_inflight
+    r = shape.max_read_index
     ids = np.asarray(ids, np.int32)
     peer_ids = np.asarray(peer_ids, np.int32)
     if peer_ids.shape != (n, v):
@@ -212,8 +227,15 @@ def init_state(
     zeros_n = jnp.zeros((n,), I32)
     zeros_nv = jnp.zeros((n, v), I32)
 
+    # Distinct per-lane streams: lane index scaled by an odd constant so no
+    # two lanes collide (a bare +lane collapses adjacent lanes under the |1
+    # below), |1 keeps every stream odd.
     rng = np.asarray(
-        ((seed * 2654435761 + np.arange(n, dtype=np.uint64)) & 0xFFFFFFFF) | 1,
+        (
+            (seed * 2654435761 + np.arange(n, dtype=np.uint64) * 0x9E3779B9)
+            & 0xFFFFFFFF
+        )
+        | 1,
         np.uint32,
     )
 
@@ -231,8 +253,14 @@ def init_state(
         heartbeat_elapsed=zeros_n,
         # becomeFollower resets this on first real transition; init like
         # newRaft's becomeFollower call by sampling below via reset in step 0.
+        # High bits: the LCG's low bits are lattice-correlated across lanes
+        # (deltas stay fixed mod small ET), which can lock groups into
+        # synchronized split votes forever.
         randomized_election_timeout=jnp.asarray(
-            DEFAULT_ELECTION_TICK + (rng % np.uint32(DEFAULT_ELECTION_TICK)).astype(np.int32)
+            DEFAULT_ELECTION_TICK
+            + ((rng >> np.uint32(16)) % np.uint32(DEFAULT_ELECTION_TICK)).astype(
+                np.int32
+            )
         ),
         rng=jnp.asarray(rng),
         log_term=jnp.zeros((n, w), I32),
@@ -260,6 +288,13 @@ def init_state(
         pr_recent_active=jnp.zeros((n, v), BOOL),
         pr_msg_app_flow_paused=jnp.zeros((n, v), BOOL),
         votes=zeros_nv,
+        ro_ctx=jnp.zeros((n, r), I32),
+        ro_from=jnp.zeros((n, r), I32),
+        ro_index=jnp.zeros((n, r), I32),
+        ro_acks=jnp.zeros((n, r, v), BOOL),
+        rs_ctx=jnp.zeros((n, r), I32),
+        rs_index=jnp.zeros((n, r), I32),
+        rs_count=zeros_n,
         infl_index=jnp.zeros((n, v, f), I32),
         infl_bytes=jnp.zeros((n, v, f), I32),
         infl_start=zeros_nv,

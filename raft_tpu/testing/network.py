@@ -1,0 +1,141 @@
+"""Fault-injecting network simulators for tests and benchmarks.
+
+Two layers, mirroring the reference's two harness networks:
+
+- `SyncNetwork` — the synchronous fixture of raft_test.go:4827-4887
+  (`newNetwork`): per-connection drop rates, message-type ignore lists, and a
+  msg hook; messages move synchronously between lanes of a RawNodeBatch.
+- `LossyNetwork` — the goroutine-level simulator of rafttest/network.go:33-144:
+  per-connection drop probability, random delay, disconnect, bounded queues;
+  used with the threaded Node API for liveness (not golden) tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable
+
+from raft_tpu.api.rawnode import Message, RawNodeBatch
+
+
+class SyncNetwork:
+    """reference: raft_test.go:4827-4887."""
+
+    def __init__(self, batch: RawNodeBatch, seed: int = 1):
+        self.batch = batch
+        self.rng = random.Random(seed)
+        self.drop: dict[tuple[int, int], float] = {}
+        self.ignore: set[int] = set()
+        self.msg_hook: Callable[[Message], bool] | None = None
+        self.id2lane = {batch.id_of(l): l for l in range(batch.shape.n)}
+
+    def cut(self, a: int, b: int):
+        self.drop[(a, b)] = 1.0
+        self.drop[(b, a)] = 1.0
+
+    def isolate(self, nid: int):
+        for other in self.id2lane:
+            if other != nid:
+                self.cut(nid, other)
+
+    def recover(self):
+        self.drop.clear()
+        self.ignore.clear()
+
+    def _filter(self, msgs: list[Message]) -> list[Message]:
+        out = []
+        for m in msgs:
+            if m.type in self.ignore:
+                continue
+            p = self.drop.get((m.frm, m.to), 0.0)
+            if p and self.rng.random() < p:
+                continue
+            if self.msg_hook is not None and not self.msg_hook(m):
+                continue
+            out.append(m)
+        return out
+
+    def send(self, msgs: list[Message], max_iters: int = 200):
+        """Deliver messages (and all cascading emissions) to quiescence —
+        the reference's network.send loop."""
+        pending = list(msgs)
+        for _ in range(max_iters):
+            progressed = False
+            while pending:
+                m = pending.pop(0)
+                dst = self.id2lane.get(m.to)
+                if dst is None:
+                    continue
+                self.batch.step(dst, m)
+                progressed = True
+            for lane in range(self.batch.shape.n):
+                if self.batch.has_ready(lane):
+                    rd = self.batch.ready(lane)
+                    pending.extend(self._filter(rd.messages))
+                    self.batch.advance(lane)
+                    progressed = True
+            if not progressed and not pending:
+                return
+        raise RuntimeError("network did not quiesce")
+
+
+@dataclasses.dataclass
+class _InFlight:
+    deliver_at: float
+    msg: Message
+
+
+class LossyNetwork:
+    """reference: rafttest/network.go:33-144."""
+
+    def __init__(
+        self,
+        ids: list[int],
+        seed: int = 1,
+        drop_prob: float = 0.0,
+        max_delay: float = 0.0,
+    ):
+        self.rng = random.Random(seed)
+        self.drop_prob = {(a, b): drop_prob for a in ids for b in ids if a != b}
+        self.delay = {
+            (a, b): (0.0, max_delay) for a in ids for b in ids if a != b
+        }
+        self.disconnected: set[int] = set()
+        self.queues: dict[int, list[_InFlight]] = {i: [] for i in ids}
+
+    def drop(self, frm: int, to: int, prob: float):
+        self.drop_prob[(frm, to)] = prob
+
+    def delay_conn(self, frm: int, to: int, max_delay: float, rate: float = 1.0):
+        self.delay[(frm, to)] = (rate, max_delay)
+
+    def disconnect(self, nid: int):
+        self.disconnected.add(nid)
+
+    def connect(self, nid: int):
+        self.disconnected.discard(nid)
+
+    def send(self, m: Message, now: float | None = None):
+        """reference: network.go:92-121 — drop/delay applied at send time."""
+        now = time.monotonic() if now is None else now
+        if m.frm in self.disconnected or m.to in self.disconnected:
+            return
+        if m.to not in self.queues:
+            return
+        if self.rng.random() < self.drop_prob.get((m.frm, m.to), 0.0):
+            return
+        rate, max_d = self.delay.get((m.frm, m.to), (0.0, 0.0))
+        d = self.rng.random() * max_d if self.rng.random() < rate else 0.0
+        q = self.queues[m.to]
+        if len(q) >= 1024:  # bounded queue (network.go:40)
+            return
+        q.append(_InFlight(now + d, m))
+
+    def recv(self, nid: int, now: float | None = None) -> list[Message]:
+        now = time.monotonic() if now is None else now
+        q = self.queues.get(nid, [])
+        due = [f for f in q if f.deliver_at <= now]
+        self.queues[nid] = [f for f in q if f.deliver_at > now]
+        return [f.msg for f in due]

@@ -1,12 +1,20 @@
 """Test config: run everything on a virtual 8-device CPU mesh so sharding
 tests exercise real collectives without TPU hardware (driver benches run the
-same code on the real chip)."""
+same code on the real chip).
+
+NOTE: this environment's sitecustomize (PYTHONPATH=/root/.axon_site) imports
+jax at interpreter start with JAX_PLATFORMS=axon, so env vars set here are
+too late — pin the platform through jax.config instead (backends are still
+uninitialized at conftest time, so XLA_FLAGS and the config update take)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,235 @@
+"""Membership-change tests: Changer unit semantics (reference:
+confchange/confchange.go + testdata) and live joint-consensus scenarios
+through the RawNode facade (reference: testdata/confchange_v2_replace_leader.txt,
+confchange_v1_add_single.txt)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import confchange as ccm
+from raft_tpu.api.rawnode import RawNodeBatch
+from raft_tpu.config import Shape
+from raft_tpu.types import EntryType
+
+
+# -- Changer unit tests (mirroring confchange/testdata semantics) ----------
+
+
+def simple(cfg, trk, s, last=5):
+    return ccm.Changer(cfg, trk, last).simple(ccm.conf_changes_from_string(s))
+
+
+def test_simple_add_one():
+    cfg, trk = ccm.TrackerConfig(), {}
+    cfg, trk = simple(cfg, trk, "v1")
+    assert cfg.voters_in == {1}
+    assert trk[1].next == 5 and trk[1].match == 0 and trk[1].recent_active
+
+
+def test_simple_cannot_change_two_voters():
+    cfg, trk = simple(ccm.TrackerConfig(), {}, "v1")
+    with pytest.raises(ccm.ConfChangeError):
+        simple(cfg, trk, "v2 v3")
+
+
+def test_simple_remove_last_voter_fails():
+    cfg, trk = simple(ccm.TrackerConfig(), {}, "v1")
+    with pytest.raises(ccm.ConfChangeError):
+        simple(cfg, trk, "r1")
+
+
+def test_learner_add_and_promote():
+    cfg, trk = simple(ccm.TrackerConfig(), {}, "v1")
+    cfg, trk = simple(cfg, trk, "l2")
+    assert cfg.learners == {2} and trk[2].is_learner
+    cfg, trk = simple(cfg, trk, "v2")
+    assert cfg.voters_in == {1, 2} and cfg.learners == set()
+    assert not trk[2].is_learner
+
+
+def test_enter_leave_joint_learners_next():
+    """Demoting a voter in a joint change stages it in LearnersNext until
+    LeaveJoint (reference: confchange.go:204-228)."""
+    cfg, trk = simple(ccm.TrackerConfig(), {}, "v1")
+    cfg, trk = simple(cfg, trk, "v2")
+    cfg, trk = simple(cfg, trk, "v3")
+    ch = ccm.Changer(cfg, trk, 5)
+    cfg, trk = ch.enter_joint(True, ccm.conf_changes_from_string("l3 v4"))
+    assert cfg.joint
+    assert cfg.voters_in == {1, 2, 4}
+    assert cfg.voters_out == {1, 2, 3}
+    assert cfg.learners_next == {3}
+    assert cfg.auto_leave
+    assert not trk[3].is_learner  # staged, not yet a learner
+    cfg, trk = ccm.Changer(cfg, trk, 5).leave_joint()
+    assert not cfg.joint
+    assert cfg.voters_in == {1, 2, 4}
+    assert cfg.learners == {3} and trk[3].is_learner
+
+
+def test_enter_joint_twice_fails():
+    cfg, trk = simple(ccm.TrackerConfig(), {}, "v1")
+    cfg, trk = ccm.Changer(cfg, trk, 5).enter_joint(False, ccm.conf_changes_from_string("v2"))
+    with pytest.raises(ccm.ConfChangeError):
+        ccm.Changer(cfg, trk, 5).enter_joint(False, ccm.conf_changes_from_string("v3"))
+
+
+def test_leave_nonjoint_fails():
+    cfg, trk = simple(ccm.TrackerConfig(), {}, "v1")
+    with pytest.raises(ccm.ConfChangeError):
+        ccm.Changer(cfg, trk, 5).leave_joint()
+
+
+def test_restore_roundtrip():
+    """reference: confchange/restore_test.go:84 — ConfState -> Restore ->
+    identical ConfState."""
+    cases = [
+        ccm.ConfState(voters=(1, 2, 3)),
+        ccm.ConfState(voters=(1, 2, 3), learners=(4,)),
+        ccm.ConfState(
+            voters=(1, 2, 3),
+            voters_outgoing=(1, 2, 4, 6),
+            learners=(5,),
+            learners_next=(4,),
+            auto_leave=True,
+        ),
+    ]
+    for cs in cases:
+        cfg, trk = ccm.restore(cs, last_index=10)
+        assert ccm.conf_state(cfg) == cs, cs
+        for nid in set(cs.voters) | set(cs.learners) | set(cs.voters_outgoing):
+            assert nid in trk
+
+
+def test_encode_decode_roundtrip():
+    v1 = ccm.ConfChange(type=int(ccm.ConfChangeType.ADD_NODE), node_id=7, context=b"ctx")
+    assert ccm.decode(ccm.encode(v1)) == v1
+    v2 = ccm.ConfChangeV2(
+        transition=int(ccm.ConfChangeTransition.JOINT_EXPLICIT),
+        changes=[
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.REMOVE_NODE), 1),
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_NODE), 4),
+        ],
+    )
+    assert ccm.decode(ccm.encode(v2)) == v2
+    assert ccm.decode(b"").leave_joint()
+
+
+# -- live scenarios through the facade -------------------------------------
+
+
+def make_batch_with_joiner():
+    """Lanes 0-2: group (1,2,3). Lane 3: fresh node 4 configured with the
+    existing cluster membership (the etcd "initial cluster" model); since its
+    own id is not in the config it cannot campaign (promotable false) until a
+    conf change adds it."""
+    shape = Shape(n_lanes=4, max_peers=4)
+    peers = np.zeros((4, 4), np.int32)
+    peers[:, :3] = [1, 2, 3]
+    return RawNodeBatch(shape, [1, 2, 3, 4], peers)
+
+
+def drive_apply(b, max_iters=60):
+    """Message pump that also applies committed conf-change entries —
+    the full app contract (reference: doc.go:75-103 + ApplyConfChange)."""
+    n = b.shape.n
+    id2lane = {b.id_of(l): l for l in range(n)}
+    states = {}
+    for _ in range(max_iters):
+        moved = False
+        for lane in range(n):
+            if not b.has_ready(lane):
+                continue
+            rd = b.ready(lane)
+            msgs = rd.messages
+            for e in rd.committed_entries:
+                if e.type in (
+                    int(EntryType.ENTRY_CONF_CHANGE),
+                    int(EntryType.ENTRY_CONF_CHANGE_V2),
+                ):
+                    cs = b.apply_conf_change(lane, ccm.decode(e.data))
+                    states[lane] = cs
+            b.advance(lane)
+            for m in msgs:
+                dst = id2lane.get(m.to)
+                if dst is not None:
+                    b.step(dst, m)
+            moved = True
+        if not moved:
+            return states
+    raise AssertionError("did not quiesce")
+
+
+def test_v1_add_learner_then_promote_live():
+    b = make_batch_with_joiner()
+    b.campaign(0)
+    drive_apply(b)
+    b.propose_conf_change(
+        0, ccm.encode(ccm.ConfChange(int(ccm.ConfChangeType.ADD_LEARNER_NODE), 4))
+    )
+    states = drive_apply(b)
+    assert states[0].learners == (4,)
+    # learner catches up with the log
+    assert b.basic_status(3)["commit"] == b.basic_status(0)["commit"]
+    b.propose_conf_change(
+        0, ccm.encode(ccm.ConfChange(int(ccm.ConfChangeType.ADD_NODE), 4))
+    )
+    states = drive_apply(b)
+    assert states[0].voters == (1, 2, 3, 4)
+    assert states[0].learners == ()
+
+
+def test_v2_joint_replace_leader_live():
+    """confchange_v2_replace_leader: joint-remove the leader, add node 4,
+    auto-leave, then transfer leadership to the new node."""
+    b = make_batch_with_joiner()
+    b.campaign(0)
+    drive_apply(b)
+    cc = ccm.ConfChangeV2(
+        changes=[
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.REMOVE_NODE), 1),
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_NODE), 4),
+        ]
+    )
+    b.propose_conf_change(0, ccm.encode(cc), v2=True)
+    states = drive_apply(b)
+    # auto-leave proposed+applied: final config is (2,3,4)
+    assert states[0].voters == (2, 3, 4), states[0]
+    assert states[0].voters_outgoing == ()
+    # removed leader still leads (no step_down_on_removal) but can no longer
+    # propose (reference raft.go:1246-1252); hand off to the new node
+    b.transfer_leadership(0, 4)
+    drive_apply(b)
+    assert b.basic_status(3)["raft_state"] == "LEADER"
+    # replication under the new config and leader
+    b.propose(3, b"after-joint")
+    drive_apply(b)
+    assert b.basic_status(1)["commit"] == b.basic_status(3)["commit"]
+
+
+def test_step_down_on_removal():
+    b = make_batch_with_joiner()
+    # enable step_down_on_removal on every lane
+    import jax.numpy as jnp
+    import dataclasses
+
+    st = b.state
+    b.state = dataclasses.replace(
+        st,
+        cfg=dataclasses.replace(
+            st.cfg, step_down_on_removal=jnp.ones_like(st.cfg.step_down_on_removal)
+        ),
+    )
+    b.view.refresh(b.state)
+    b.campaign(0)
+    drive_apply(b)
+    cc = ccm.ConfChangeV2(
+        changes=[
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.REMOVE_NODE), 1),
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_NODE), 4),
+        ]
+    )
+    b.propose_conf_change(0, ccm.encode(cc), v2=True)
+    drive_apply(b)
+    # leader stepped down once fully removed; someone else can take over
+    assert b.basic_status(0)["raft_state"] == "FOLLOWER"

@@ -1,0 +1,120 @@
+"""Threaded Node API + lossy-network liveness tests (reference:
+rafttest/node_test.go TestBasicProgress/TestRestart/TestPause, node_test.go
+channel semantics)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.api.node import NodeHost
+from raft_tpu.api.rawnode import RawNodeBatch
+from raft_tpu.config import Shape
+from raft_tpu.testing.network import LossyNetwork, SyncNetwork
+from tests.test_rawnode import make_group
+
+
+def run_cluster(n_nodes, drop_prob, n_proposals, deadline_s=300.0):
+    """5 real Nodes over the lossy simulator, app loop per node — the
+    reference's TestBasicProgress shape (rafttest/node_test.go:25-60)."""
+    b = make_group(n_nodes)
+    host = NodeHost(b)
+    nodes = [host.node(i) for i in range(n_nodes)]
+    ids = [b.id_of(i) for i in range(n_nodes)]
+    net = LossyNetwork(ids, seed=7, drop_prob=drop_prob, max_delay=0.01)
+    stop = threading.Event()
+    commits = [0] * n_nodes
+
+    def app(i):
+        nd = nodes[i]
+        nid = ids[i]
+        last_tick = time.monotonic()
+        while not stop.is_set():
+            now = time.monotonic()
+            if now - last_tick >= 0.05:  # 50ms tick (first compiles are slow)
+                nd.tick()
+                last_tick = now
+            for m in net.recv(nid, now):
+                nd.step(m)
+            try:
+                rd = nd.ready(timeout=0.005)
+            except Exception:
+                continue
+            for m in rd.messages:
+                net.send(m, now)
+            commits[i] = max(
+                commits[i],
+                max((e.index for e in rd.committed_entries), default=commits[i]),
+            )
+            nd.advance()
+
+    threads = [threading.Thread(target=app, args=(i,), daemon=True) for i in range(n_nodes)]
+    for t in threads:
+        t.start()
+
+    t0 = time.monotonic()
+    # wait for a leader
+    leader = None
+    while time.monotonic() - t0 < deadline_s:
+        sts = [nodes[i].status() for i in range(n_nodes)]
+        leaders = [i for i, s in enumerate(sts) if s["raft_state"] == "LEADER"]
+        if leaders:
+            leader = leaders[-1]
+            break
+        time.sleep(0.05)
+    assert leader is not None, "no leader elected under lossy network"
+
+    for k in range(n_proposals):
+        nodes[leader].propose(b"prop-%d" % k)
+        time.sleep(0.01)
+
+    target = n_proposals  # at least the proposals (plus empty entries)
+    ok = False
+    while time.monotonic() - t0 < deadline_s:
+        if min(commits) >= target:
+            ok = True
+            break
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    host.stop()
+    assert ok, f"commits {commits} did not reach {target}"
+
+
+def test_basic_progress_clean_network():
+    run_cluster(3, drop_prob=0.0, n_proposals=10)
+
+
+def test_progress_under_lossy_network():
+    run_cluster(3, drop_prob=0.1, n_proposals=5)
+
+
+def test_sync_network_partition_reelection():
+    """Leader isolated -> remaining quorum elects a new leader (reference:
+    raft_test.go partition scenarios via newNetwork)."""
+    b = make_group(3)
+    net = SyncNetwork(b)
+    b.campaign(0)
+    net.send([])
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+    net.isolate(1)  # cut off the leader (id 1)
+    # followers time out and elect among themselves (a split vote can cost
+    # two full randomized timeouts: up to ~2*2*ET ticks)
+    for _ in range(60):
+        b.tick(1)
+        b.tick(2)
+        net.send([])
+        states = [b.basic_status(i)["raft_state"] for i in range(3)]
+        if "LEADER" in states[1:]:
+            break
+    assert "LEADER" in states[1:], states
+    net.recover()
+    net.send([])
+    # old leader rejoins as follower once it hears the higher term
+    for _ in range(5):
+        b.tick(1)
+        b.tick(2)
+        net.send([])
+    assert b.basic_status(0)["raft_state"] == "FOLLOWER"

@@ -1,0 +1,150 @@
+"""RawNode facade tests: the reference's Ready/Advance contract driven from
+the host (reference: rawnode_test.go, node.go:52-115, doc.go:69-145)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.api.rawnode import Entry, Message, RawNodeBatch
+from raft_tpu.config import Shape
+from raft_tpu.types import MessageType as MT, StateType
+
+
+def make_group(n_voters=3, shape_kw=None, **cfg):
+    """One group of n_voters lanes; lane i has id i+1."""
+    shape = Shape(
+        n_lanes=n_voters, max_peers=max(4, n_voters), **(shape_kw or {})
+    )
+    ids = list(range(1, n_voters + 1))
+    peers = np.zeros((n_voters, shape.v), np.int32)
+    peers[:, :n_voters] = np.arange(1, n_voters + 1)
+    return RawNodeBatch(shape, ids, peers, **cfg)
+
+
+def lane_of(b, nid):
+    return nid - 1
+
+
+def drive(b, max_iters=50):
+    """Synchronous message pump: collect every lane's Ready, persist
+    (implicit), deliver messages, advance — until quiet. Mirrors the
+    reference tests' network fixture (raft_test.go:4844)."""
+    n = b.shape.n
+    for _ in range(max_iters):
+        moved = False
+        for lane in range(n):
+            if not b.has_ready(lane):
+                continue
+            rd = b.ready(lane)
+            msgs = rd.messages
+            b.advance(lane)
+            for m in msgs:
+                dst = lane_of(b, m.to)
+                if 0 <= dst < n:
+                    b.step(dst, m)
+            moved = True
+        if not moved:
+            return
+    raise AssertionError("did not quiesce")
+
+
+def test_campaign_elects_leader():
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+    assert b.basic_status(1)["raft_state"] == "FOLLOWER"
+    assert b.basic_status(1)["lead"] == 1
+    assert b.basic_status(2)["lead"] == 1
+    # empty entry at the new term committed everywhere
+    for lane in range(3):
+        assert b.basic_status(lane)["commit"] == 1
+
+
+def test_propose_commits_and_applies_payload():
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    b.propose(0, b"hello")
+    committed = {}
+
+    # capture committed entries as they surface in Ready
+    n = b.shape.n
+    for _ in range(30):
+        moved = False
+        for lane in range(n):
+            if not b.has_ready(lane):
+                continue
+            rd = b.ready(lane)
+            for e in rd.committed_entries:
+                if e.data:
+                    committed.setdefault(lane, []).append(e)
+            msgs = rd.messages
+            b.advance(lane)
+            for m in msgs:
+                b.step(lane_of(b, m.to), m)
+            moved = True
+        if not moved:
+            break
+    assert set(committed) == {0, 1, 2}
+    for lane in range(3):
+        (e,) = committed[lane]
+        assert e.data == b"hello"
+        assert e.index == 2
+
+
+def test_ready_contract_hard_state_and_must_sync():
+    b = make_group(1)
+    b.campaign(0)
+    # first Ready: the vote is durable state; the self vote-resp is an
+    # after-append message stepped only at Advance (reference raft.go:534-580)
+    rd = b.ready(0)
+    assert rd.hard_state is not None
+    assert rd.hard_state.term == 1
+    assert rd.hard_state.vote == 1
+    assert rd.must_sync
+    assert rd.entries == []
+    b.advance(0)  # steps self MsgVoteResp -> becomes leader, appends entry
+    rd = b.ready(0)
+    assert len(rd.entries) == 1
+    assert rd.entries[0].term == 1 and rd.entries[0].index == 1
+    assert rd.must_sync
+    b.advance(0)
+    drive(b)
+    # single-voter: self-ack commits immediately
+    assert b.basic_status(0)["commit"] == 1
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+
+
+def test_leadership_transfer():
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    b.transfer_leadership(0, 2)
+    drive(b)
+    assert b.basic_status(1)["raft_state"] == "LEADER"
+    assert b.basic_status(0)["raft_state"] == "FOLLOWER"
+
+
+def test_status_progress_map():
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    b.propose(0, b"x")
+    drive(b)
+    st = b.status(0)
+    assert st["raft_state"] == "LEADER"
+    assert set(st["progress"]) == {1, 2, 3}
+    last = 2  # empty entry + proposal
+    for pid, pr in st["progress"].items():
+        assert pr["match"] == last, (pid, pr)
+        assert pr["state"] == "REPLICATE"
+
+
+def test_forget_leader():
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    assert b.basic_status(1)["lead"] == 1
+    b.forget_leader(1)
+    assert b.basic_status(1)["lead"] == 0
+    assert b.basic_status(1)["raft_state"] == "FOLLOWER"

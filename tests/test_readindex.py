@@ -1,0 +1,98 @@
+"""Linearizable-read (ReadIndex) tests (reference: read_only.go,
+raft.go:1303-1332, 1548-1561; raft_test.go TestReadOnlyForNewLeader et al)."""
+
+import numpy as np
+
+from raft_tpu.api.rawnode import RawNodeBatch
+from raft_tpu.config import Shape
+from tests.test_rawnode import drive, make_group
+
+
+def pump_collect_reads(b, max_iters=40):
+    reads = {}
+    n = b.shape.n
+    for _ in range(max_iters):
+        moved = False
+        for lane in range(n):
+            if not b.has_ready(lane):
+                continue
+            rd = b.ready(lane)
+            for rs in rd.read_states:
+                reads.setdefault(lane, []).append(rs)
+            msgs = rd.messages
+            b.advance(lane)
+            for m in msgs:
+                dst = m.to - 1
+                if 0 <= dst < n:
+                    b.step(dst, m)
+            moved = True
+        if not moved:
+            break
+    return reads
+
+
+def test_leader_safe_read_quorum_ack():
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    b.propose(0, b"x")
+    drive(b)
+    commit = b.basic_status(0)["commit"]
+    b.read_index(0, ctx=77)
+    reads = pump_collect_reads(b)
+    assert 0 in reads, reads
+    (rs,) = reads[0]
+    assert rs.request_ctx == 77
+    assert rs.index == commit
+
+
+def test_follower_read_forwarded():
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    commit = b.basic_status(0)["commit"]
+    b.read_index(2, ctx=91)
+    reads = pump_collect_reads(b)
+    assert 2 in reads, reads
+    (rs,) = reads[2]
+    assert rs.request_ctx == 91
+    assert rs.index == commit
+
+
+def test_single_node_immediate():
+    b = make_group(1)
+    b.campaign(0)
+    drive(b)
+    commit = b.basic_status(0)["commit"]
+    assert commit == 1
+    b.read_index(0, ctx=5)
+    reads = pump_collect_reads(b)
+    (rs,) = reads[0]
+    assert rs.request_ctx == 5 and rs.index == commit
+
+
+def test_read_before_commit_in_term_dropped():
+    """Deviation from the reference (which queues): requests before the
+    leader commits in its term are dropped; the client retries."""
+    b = make_group(3)
+    b.campaign(0)
+    # leader not yet established/committed: read on candidate lane is inert
+    b.read_index(0, ctx=3)
+    reads = pump_collect_reads(b)
+    drive(b)
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+    # after commit-in-term, reads flow again
+    b.read_index(0, ctx=4)
+    reads = pump_collect_reads(b)
+    assert [r.request_ctx for r in reads.get(0, [])] == [4]
+
+
+def test_lease_based_read():
+    b = make_group(3, read_only_lease_based=True)
+    b.campaign(0)
+    drive(b)
+    commit = b.basic_status(0)["commit"]
+    b.read_index(0, ctx=12)
+    reads = pump_collect_reads(b)
+    (rs,) = reads[0]
+    assert rs.request_ctx == 12 and rs.index == commit
