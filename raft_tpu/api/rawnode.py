@@ -26,7 +26,7 @@ doc.go:69-145):
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+from functools import lru_cache, partial
 from typing import Iterable
 
 import jax
@@ -141,6 +141,26 @@ class ReadState:
     request_ctx: int
 
 
+def _sov(x: int) -> int:
+    """Protobuf varint encoding size (reference: raftpb/raft.pb.go sovRaft)."""
+    n = 1
+    while x >= 0x80:
+        x >>= 7
+        n += 1
+    return n
+
+
+def entry_go_size(e: Entry) -> int:
+    """Byte-exact raftpb.Entry.Size() (generated gogoproto marshal size) so
+    size-based pagination decisions match the reference bit-for-bit. Empty
+    payloads are nil Data in Go and marshal no Data field (raft.pb.go guards
+    `if m.Data != nil`)."""
+    n = 1 + _sov(e.term) + 1 + _sov(e.index) + 1 + _sov(e.type)
+    if e.data:
+        n += 1 + _sov(len(e.data)) + len(e.data)
+    return n
+
+
 class EntryStore:
     """Host-side payload store: (lane, index) -> (term, type, data).
 
@@ -234,6 +254,18 @@ class _StateView:
 # --------------------------------------------------------------------------
 
 
+@lru_cache(maxsize=None)
+def _compiled_kernels(max_entries: int):
+    """Process-wide jit wrappers shared by every batch: jax caches compiled
+    programs per wrapper instance, so per-batch wrappers would recompile the
+    step kernel for every RawNodeBatch constructed (brutal in test suites)."""
+    return (
+        jax.jit(partial(stepmod.step, max_entries=max_entries)),
+        jax.jit(lambda s, m: stepmod.tick(s, max_entries, m)),
+        jax.jit(partial(stepmod.post_conf_change, max_entries=max_entries)),
+    )
+
+
 class RawNodeBatch:
     """N RawNodes resident in one device batch."""
 
@@ -258,6 +290,9 @@ class RawNodeBatch:
         from raft_tpu.runtime.native import make_payload_store
 
         self.store = make_payload_store(n)
+        # optional single-lane step observer (the conformance harness's log
+        # oracle): trace.snapshot(lane) before, trace.after_step(...) after
+        self.trace = None
         self.view = _StateView()
         self.view.refresh(self.state)
         self._msgs: list[list[Message]] = [[] for _ in range(n)]
@@ -266,9 +301,7 @@ class RawNodeBatch:
         self._prev_ss = [SoftState() for _ in range(n)]
         self._read_states: list[list[ReadState]] = [[] for _ in range(n)]
         e = shape.max_msg_entries
-        self._step_fn = jax.jit(partial(stepmod.step, max_entries=e))
-        self._tick_fn = jax.jit(lambda s, m: stepmod.tick(s, e, m))
-        self._post_cc_fn = jax.jit(partial(stepmod.post_conf_change, max_entries=e))
+        self._step_fn, self._tick_fn, self._post_cc_fn = _compiled_kernels(e)
 
     # -- kernel plumbing ---------------------------------------------------
 
@@ -343,6 +376,7 @@ class RawNodeBatch:
 
     def _run_step(self, lane: int, msg: Message):
         """One kernel invocation with a single hot lane; payload bookkeeping."""
+        pre = self.trace.snapshot(lane) if self.trace is not None else None
         old_last = int(self.view.last[lane])
         old_term = int(self.view.term[lane])
         inbox = self._inbox_one(lane, msg)
@@ -351,6 +385,8 @@ class RawNodeBatch:
         # payloads first: fan-out messages emitted by this same step resolve
         # their entry bytes from the store
         self._store_accepted_payloads(lane, msg, old_last, old_term)
+        if self.trace is not None:
+            self.trace.after_step(lane, msg, pre)
         self._collect_out(out)
 
     def _store_accepted_payloads(
@@ -515,19 +551,21 @@ class RawNodeBatch:
             rd.snapshot = snap if snap and snap.index == psi else Snapshot(
                 index=psi, term=int(v.pending_snap_term[lane])
             )
-        # committed entries (applied, committed], byte-paginated (log.go:216-240)
+        # committed entries (applied, committed], paginated by proto-encoding
+        # size with limitSize's never-empty rule (log.go:216-240, util.go:266)
         budget = int(np.asarray(self.state.cfg.max_committed_size_per_ready[lane]))
         lo, hi = int(v.applied[lane]) + 1, commit
         if psi:
             hi = lo - 1  # snapshot must be applied first
+        size = 0
         for i in range(lo, hi + 1):
             t = int(v.log_term[lane, i & (w - 1)])
             etype, data = self.store.get(lane, i, t)
             ent = Entry(t, i, int(v.log_type[lane, i & (w - 1)]), data)
-            rd.committed_entries.append(ent)
-            budget -= len(data)
-            if budget <= 0:
+            size += entry_go_size(ent)
+            if rd.committed_entries and size > budget:
                 break
+            rd.committed_entries.append(ent)
         rd.messages = list(self._msgs[lane])
         # drain the device-side ReadState ring (reference: raft.go:371)
         nrs = int(v.rs_count[lane])
@@ -535,10 +573,11 @@ class RawNodeBatch:
             ReadState(index=int(v.rs_index[lane, r]), request_ctx=int(v.rs_ctx[lane, r]))
             for r in range(nrs)
         ] + list(self._read_states[lane])
+        # reference: rawnode.go:193-200 MustSync (entries, vote or term only)
         rd.must_sync = bool(
             rd.entries
-            or (rd.hard_state and (term != self._prev_hs[lane].term or vote != self._prev_hs[lane].vote))
-            or rd.snapshot
+            or term != self._prev_hs[lane].term
+            or vote != self._prev_hs[lane].vote
         )
         if not peek:
             # acceptReady (reference rawnode.go:404-440)

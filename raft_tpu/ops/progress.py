@@ -75,7 +75,8 @@ def become_snapshot(state: RaftState, sel, snapshot_index) -> RaftState:
 def inflights_full(state: RaftState):
     """[N, V] bool. reference: tracker/inflights.go:129-133."""
     f = state.infl_index.shape[-1]
-    cap_hit = state.infl_count >= f
+    cap = jnp.minimum(f, state.cfg.max_inflight[:, None])
+    cap_hit = state.infl_count >= cap
     max_bytes = state.cfg.max_inflight_bytes[:, None]
     bytes_hit = (max_bytes != 0) & (state.infl_total_bytes >= max_bytes)
     return cap_hit | bytes_hit

@@ -58,6 +58,7 @@ class LaneConfig:
     max_size_per_msg: Any  # [N] i32, bytes per MsgApp (raft.go:188)
     max_uncommitted_size: Any  # [N] i32 (raft.go:200-204)
     max_committed_size_per_ready: Any  # [N] i32 (raft.go:193-199)
+    max_inflight: Any  # [N] i32 in-flight MsgApp count cap (raft.go:211-215)
     max_inflight_bytes: Any  # [N] i32 (raft.go:216-220)
     check_quorum: Any  # [N] bool (raft.go:221-225)
     pre_vote: Any  # [N] bool (raft.go:226-229)
@@ -178,6 +179,7 @@ def make_lane_config(shape: Shape, **overrides) -> LaneConfig:
         max_size_per_msg=full(DEFAULT_MAX_SIZE_PER_MSG),
         max_uncommitted_size=full(DEFAULT_MAX_UNCOMMITTED_SIZE),
         max_committed_size_per_ready=full(DEFAULT_MAX_COMMITTED_SIZE_PER_READY),
+        max_inflight=full(shape.max_inflight),
         max_inflight_bytes=full(2**30),
         check_quorum=full(False, BOOL),
         pre_vote=full(False, BOOL),

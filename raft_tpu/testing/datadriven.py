@@ -1,0 +1,117 @@
+"""Parser for cockroachdb/datadriven test files.
+
+The reference's conformance suite (reference: interaction_test.go:26-38) walks
+`testdata/*.txt` scripts in this format:
+
+    command arg1 arg2=val arg3=(v1,v2,v3)
+    optional input lines
+    ----
+    expected output
+
+Directives are separated by blank lines; `#` starts a comment outside a
+directive. When the expected output itself contains blank lines the separator
+is doubled (`----\n----`) and the output runs until a matching double
+separator. This module only *parses* scripts — the golden files themselves
+are read from the reference tree at test time and never copied into this
+repo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+
+@dataclasses.dataclass
+class CmdArg:
+    key: str
+    vals: list[str]
+
+
+@dataclasses.dataclass
+class TestData:
+    pos: str  # file:line of the command for error messages
+    cmd: str
+    cmd_args: list[CmdArg]
+    input: str  # lines between the command and ----
+    expected: str  # golden output (with trailing newline unless empty)
+
+    def arg(self, key: str) -> CmdArg | None:
+        for a in self.cmd_args:
+            if a.key == key:
+                return a
+        return None
+
+    def bool_arg(self, key: str, default: bool = False) -> bool:
+        a = self.arg(key)
+        if a is None:
+            return default
+        if not a.vals:
+            return True
+        return a.vals[0].lower() in ("true", "t", "1", "yes")
+
+    def int_arg(self, key: str, default: int = 0) -> int:
+        a = self.arg(key)
+        return int(a.vals[0]) if a and a.vals else default
+
+
+_ARG_RE = re.compile(r"([^\s=()]+)(?:=(\(([^)]*)\)|\S*))?")
+
+
+def parse_cmd_line(line: str) -> tuple[str, list[CmdArg]]:
+    parts = []
+    for m in _ARG_RE.finditer(line):
+        key = m.group(1)
+        if m.group(2) is None:
+            parts.append(CmdArg(key, []))
+        elif m.group(3) is not None:
+            vals = [v.strip() for v in re.split(r"[,\s]+", m.group(3)) if v.strip()]
+            parts.append(CmdArg(key, vals))
+        else:
+            parts.append(CmdArg(key, [m.group(2)]))
+    if not parts:
+        raise ValueError(f"empty command line: {line!r}")
+    cmd = parts[0].key
+    return cmd, parts[1:]
+
+
+def parse_file(path: str) -> list[TestData]:
+    with open(path) as f:
+        lines = f.read().split("\n")
+    out: list[TestData] = []
+    i = 0
+    n = len(lines)
+    while i < n:
+        line = lines[i]
+        if not line.strip() or line.lstrip().startswith("#"):
+            i += 1
+            continue
+        pos = f"{path}:{i + 1}"
+        cmd, args = parse_cmd_line(line.strip())
+        i += 1
+        input_lines = []
+        while i < n and lines[i] != "----":
+            input_lines.append(lines[i])
+            i += 1
+        if i >= n:
+            raise ValueError(f"{pos}: missing ---- separator")
+        i += 1  # skip ----
+        expected_lines = []
+        if i < n and lines[i] == "----":
+            # doubled separator: output runs to the next ----\n---- pair
+            i += 1
+            while i < n and not (
+                lines[i] == "----" and i + 1 < n and lines[i + 1] == "----"
+            ):
+                expected_lines.append(lines[i])
+                i += 1
+            i += 2
+        else:
+            while i < n and lines[i].strip() != "":
+                expected_lines.append(lines[i])
+                i += 1
+        expected = "\n".join(expected_lines)
+        if expected:
+            expected += "\n"
+        out.append(TestData(pos, cmd, args, "\n".join(input_lines), expected))
+    return out

@@ -1,0 +1,642 @@
+"""Datadriven interaction harness — the conformance gate.
+
+Re-implements the reference's `InteractionEnv` (reference:
+rafttest/interaction_env.go:49-55, interaction_env_handler.go:29-211) over the
+batched TPU engine: each scripted node is one lane of a `RawNodeBatch`, the
+env keeps the in-flight message list, and every handler reproduces the
+reference's output byte-for-byte so the reference's own `testdata/*.txt`
+golden files (read from the mounted reference tree at test time — never
+copied) validate behavioral parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import confchange as ccm
+from raft_tpu.api.rawnode import (
+    Entry,
+    HardState,
+    Message,
+    RawNodeBatch,
+    Ready,
+    Snapshot,
+)
+from raft_tpu.config import Shape
+from raft_tpu.testing import describe as D
+from raft_tpu.testing.datadriven import TestData
+from raft_tpu.testing.logoracle import LogOracle
+from raft_tpu.types import EntryType, MessageType as MT, StateType
+
+# reference: rafttest/interaction_env.go raftConfigStub
+STUB_ELECTION_TICK = 3
+STUB_HEARTBEAT_TICK = 1
+
+DEBUG, INFO, WARN, ERROR, FATAL, NONE = range(6)
+LVL_NAMES = ["DEBUG", "INFO", "WARN", "ERROR", "FATAL", "NONE"]
+
+
+class Output:
+    """reference: rafttest/interaction_env_logger.go RedirectLogger."""
+
+    def __init__(self):
+        self.lvl = DEBUG
+        self.parts: list[str] = []
+
+    def quiet(self) -> bool:
+        return self.lvl == NONE
+
+    def write(self, s: str):
+        if not self.quiet():
+            self.parts.append(s)
+
+    def logf(self, lvl: int, text: str):
+        if self.lvl <= lvl:
+            self.write(f"{LVL_NAMES[lvl]} {text}\n")
+
+    def take(self) -> str:
+        s = "".join(self.parts)
+        self.parts = []
+        return s
+
+
+@dataclasses.dataclass
+class EnvNode:
+    lane: int
+    async_storage: bool = False
+    append_work: list = dataclasses.field(default_factory=list)
+    apply_work: list = dataclasses.field(default_factory=list)
+    history: list = dataclasses.field(default_factory=list)
+
+
+class InteractionEnv:
+    """Scripted multi-node environment over one RawNodeBatch."""
+
+    CAPACITY = 8
+
+    def __init__(self):
+        self.output = Output()
+        self.nodes: list[EnvNode] = []
+        self.messages: list[Message] = []
+        self.batch: RawNodeBatch | None = None
+        self.oracle: LogOracle | None = None
+
+    # ------------------------------------------------------------------ core
+
+    def _ensure_batch(self):
+        if self.batch is not None:
+            return
+        n = self.CAPACITY
+        shape = Shape(n_lanes=n, max_peers=8, log_window=64, max_msg_entries=8,
+                      max_inflight=8, max_read_index=4)
+        self.batch = RawNodeBatch(
+            shape,
+            ids=[0] * n,
+            peers=np.zeros((n, shape.v), np.int32),
+            election_tick=STUB_ELECTION_TICK,
+            heartbeat_tick=STUB_HEARTBEAT_TICK,
+            max_size_per_msg=2**30,
+            max_inflight_bytes=2**30,
+        )
+        self.oracle = LogOracle(self, self.batch)
+        self.batch.trace = self.oracle
+
+    def _set_lane_state(self, lane: int, **fields):
+        st = self.batch.state
+        upd = {}
+        for k, v in fields.items():
+            arr = getattr(st, k)
+            upd[k] = arr.at[lane].set(v)
+        self.batch.state = dataclasses.replace(st, **upd)
+        self.batch.view.refresh(self.batch.state)
+
+    def _set_lane_cfg(self, lane: int, **fields):
+        st = self.batch.state
+        cfg = st.cfg
+        upd = {}
+        for k, v in fields.items():
+            arr = getattr(cfg, k)
+            upd[k] = arr.at[lane].set(v)
+        self.batch.state = dataclasses.replace(st, cfg=dataclasses.replace(cfg, **upd))
+        self.batch.view.refresh(self.batch.state)
+
+    # ------------------------------------------------------------- dispatch
+
+    def handle(self, d: TestData) -> str:
+        self.output.parts = []
+        err: str | None = None
+        try:
+            fn = getattr(self, "handle_" + d.cmd.replace("-", "_"), None)
+            if fn is None:
+                err = "unknown command"
+            else:
+                err = fn(d)
+        except HandlerError as e:
+            err = str(e)
+        if err:
+            if self.output.quiet():
+                return err
+            self.output.write(err if err.endswith("\n") else err + "\n")
+        out = self.output.take()
+        return out if out else "ok\n"
+
+    # ------------------------------------------------------------- handlers
+
+    def handle_log_level(self, d: TestData):
+        name = d.cmd_args[0].key.upper()
+        for i, nm in enumerate(LVL_NAMES):
+            if nm == name:
+                self.output.lvl = i
+                return
+        return f"log levels must be either of {LVL_NAMES}"
+
+    def handle__breakpoint(self, d: TestData):
+        return
+
+    def handle_add_nodes(self, d: TestData):
+        self._ensure_batch()
+        n = int(d.cmd_args[0].key)
+        voters = [int(x) for x in (d.arg("voters").vals if d.arg("voters") else [])]
+        learners = [int(x) for x in (d.arg("learners").vals if d.arg("learners") else [])]
+        index = d.int_arg("index")
+        content = (d.arg("content").vals[0].encode() if d.arg("content") else b"")
+        bootstrap = bool(voters or learners or index or content)
+        if bootstrap and index <= 1:
+            return "index must be specified as > 1 due to bootstrap"
+        for _ in range(n):
+            nid = len(self.nodes) + 1
+            lane = nid - 1
+            if lane >= self.CAPACITY:
+                return "node capacity exceeded"
+            node = EnvNode(lane=lane, async_storage=d.bool_arg("async-storage-writes"))
+            snap = Snapshot(
+                index=index, term=1 if bootstrap else 0, data=content,
+                voters=tuple(voters), learners=tuple(learners),
+            )
+            self._add_node(node, nid, snap, d)
+            self.nodes.append(node)
+
+    def _add_node(self, node: EnvNode, nid: int, snap: Snapshot, d: TestData):
+        lane = node.lane
+        b = self.batch
+        # per-lane config (reference: rafttest stub + add-nodes args)
+        self._set_lane_cfg(
+            lane,
+            check_quorum=d.bool_arg("checkquorum"),
+            pre_vote=d.bool_arg("prevote"),
+            read_only_lease_based=(
+                d.arg("read-only") is not None
+                and d.arg("read-only").vals[0] == "lease-based"
+            ),
+            step_down_on_removal=d.bool_arg("step-down-on-removal"),
+            disable_conf_change_validation=d.bool_arg("disable-conf-change-validation"),
+            max_committed_size_per_ready=d.int_arg(
+                "max-committed-size-per-ready", 2**30
+            ),
+            max_inflight=d.int_arg("inflight", b.shape.max_inflight),
+        )
+        i = snap.index
+        self._set_lane_state(
+            lane,
+            id=nid,
+            snap_index=i, snap_term=snap.term,
+            last=i, stabled=i, committed=i, applying=i, applied=i,
+        )
+        # conf from snapshot ConfState (reference: raft.go:455-475 via
+        # confchange.Restore)
+        if snap.voters or snap.learners:
+            cs = ccm.ConfState(voters=snap.voters, learners=snap.learners)
+            cfg, trk = ccm.restore(cs, last_index=i)
+            b._write_tracker(lane, cfg, trk)
+            # self-progress: MaybeUpdate(next-1) (reference: raft.go:470-473)
+            v = b.view
+            for j in range(b.shape.v):
+                if int(v.prs_id[lane, j]) == nid:
+                    self._set_lane_state(
+                        lane,
+                        pr_match=b.state.pr_match.at[lane, j].set(i)[lane],
+                    )
+                    break
+            self.output.logf(
+                INFO, f"{nid} switched to configuration {D.tracker_config_str(cfg)}"
+            )
+        else:
+            self.output.logf(INFO, f"{nid} switched to configuration voters=()")
+        b.store.set_snapshot(lane, snap)
+        node.history.append(snap)
+        # reference: rawnode.go:51-66 — NewRawNode seeds prevHardSt/prevSoftSt
+        # from the restored state, so boot state never surfaces in a Ready
+        b._prev_hs[lane] = HardState(term=0, vote=0, commit=i)
+        self.output.logf(INFO, f"{nid} became follower at term 0")
+        peers = sorted(set(snap.voters) | set(snap.learners))
+        peers_s = ",".join(str(p) for p in peers)
+        self.output.logf(
+            INFO,
+            f"newRaft {nid} [peers: [{peers_s}], term: 0, commit: {i}, "
+            f"applied: {i}, lastindex: {i}, lastterm: {snap.term}]",
+        )
+
+    # -- node idx helpers --------------------------------------------------
+
+    def _idxs(self, d: TestData) -> list[int]:
+        """reference: interaction_env_handler.go nodeIdxs (1-based ids in
+        the script, 0-based idxs internally; no args = all nodes)."""
+        idxs = []
+        for a in d.cmd_args:
+            if not a.vals:
+                try:
+                    idxs.append(int(a.key) - 1)
+                except ValueError:
+                    pass
+        return idxs if idxs else list(range(len(self.nodes)))
+
+    def _first_idx(self, d: TestData) -> int:
+        return int(d.cmd_args[0].key) - 1
+
+    # -- campaign / propose ------------------------------------------------
+
+    def handle_campaign(self, d: TestData):
+        self.batch.campaign(self.nodes[self._first_idx(d)].lane)
+
+    def handle_propose(self, d: TestData):
+        idx = self._first_idx(d)
+        data = d.cmd_args[1].key.encode()
+        self.batch.propose(self.nodes[idx].lane, data)
+
+    def handle_propose_conf_change(self, d: TestData):
+        idx = self._first_idx(d)
+        v1 = d.bool_arg("v1")
+        transition = "auto"
+        if d.arg("transition"):
+            transition = d.arg("transition").vals[0]
+        changes = ccm.conf_changes_from_string(d.input.strip())
+        if v1:
+            if len(changes) != 1:
+                return "v1 conf change supports only one change"
+            cc = ccm.ConfChange(type=changes[0].type, node_id=changes[0].node_id)
+        else:
+            tr = {
+                "auto": ccm.ConfChangeTransition.AUTO,
+                "implicit": ccm.ConfChangeTransition.JOINT_IMPLICIT,
+                "explicit": ccm.ConfChangeTransition.JOINT_EXPLICIT,
+            }[transition]
+            cc = ccm.ConfChangeV2(transition=tr, changes=tuple(changes))
+        data = ccm.encode(cc)
+        t = (
+            EntryType.ENTRY_CONF_CHANGE
+            if isinstance(cc, ccm.ConfChange)
+            else EntryType.ENTRY_CONF_CHANGE_V2
+        )
+        lane = self.nodes[idx].lane
+        nid = self.batch.id_of(lane)
+        self.batch._run_step(
+            lane,
+            Message(type=int(MT.MSG_PROP), to=nid, frm=nid,
+                    entries=[Entry(type=int(t), data=data)]),
+        )
+
+    # -- ticks -------------------------------------------------------------
+
+    def handle_tick_election(self, d: TestData):
+        idx = self._first_idx(d)
+        for _ in range(STUB_ELECTION_TICK):
+            self.batch.tick(self.nodes[idx].lane)
+
+    def handle_tick_heartbeat(self, d: TestData):
+        idx = self._first_idx(d)
+        for _ in range(STUB_HEARTBEAT_TICK):
+            self.batch.tick(self.nodes[idx].lane)
+
+    def handle_set_randomized_election_timeout(self, d: TestData):
+        idx = self._first_idx(d)
+        timeout = d.int_arg("timeout")
+        self._set_lane_state(
+            self.nodes[idx].lane, randomized_election_timeout=timeout
+        )
+
+    # -- leadership --------------------------------------------------------
+
+    def handle_transfer_leadership(self, d: TestData):
+        frm = d.int_arg("from")
+        to = d.int_arg("to")
+        if not (1 <= frm <= len(self.nodes)):
+            return f"from {frm} must be between 1 and {len(self.nodes)}"
+        if not (1 <= to <= len(self.nodes)):
+            return f"to {to} must be between 1 and {len(self.nodes)}"
+        self.batch.transfer_leadership(self.nodes[frm - 1].lane, to)
+
+    def handle_forget_leader(self, d: TestData):
+        self.batch.forget_leader(self.nodes[self._first_idx(d)].lane)
+
+    def handle_report_unreachable(self, d: TestData):
+        idxs = self._idxs(d)
+        self.batch.report_unreachable(
+            self.nodes[idxs[0]].lane, self.batch.id_of(self.nodes[idxs[1]].lane)
+        )
+
+    # -- snapshots / log ---------------------------------------------------
+
+    def handle_send_snapshot(self, d: TestData):
+        idxs = self._idxs(d)
+        from_idx, to_idx = idxs[0], idxs[1]
+        node = self.nodes[from_idx]
+        snap = node.history[-1]
+        msg = Message(
+            type=int(MT.MSG_SNAP),
+            frm=from_idx + 1,
+            to=to_idx + 1,
+            term=int(self.batch.view.term[node.lane]),
+            snapshot=snap,
+        )
+        self.messages.append(msg)
+        self.output.write(D.describe_message(msg))
+
+    def handle_compact(self, d: TestData):
+        idx = self._first_idx(d)
+        new_first = int(d.cmd_args[1].key)
+        self.batch.compact(self.nodes[idx].lane, new_first)
+        return self._raft_log(idx)
+
+    def handle_raft_log(self, d: TestData):
+        return self._raft_log(self._first_idx(d))
+
+    def _raft_log(self, idx: int):
+        lane = self.nodes[idx].lane
+        v = self.batch.view
+        fi = int(v.snap_index[lane]) + 1
+        li = int(v.stabled[lane])  # storage == stable prefix
+        if li < fi:
+            self.output.write(f"log is empty: first index={fi}, last index={li}")
+            return
+        w = self.batch.shape.w
+        ents = []
+        for i in range(fi, li + 1):
+            t = int(v.log_term[lane, i & (w - 1)])
+            etype, data = self.batch.store.get(lane, i, t)
+            ents.append(Entry(t, i, int(v.log_type[lane, i & (w - 1)]), data))
+        self.output.write(D.describe_entries(ents))
+
+    # -- state introspection -----------------------------------------------
+
+    def handle_raft_state(self, d: TestData):
+        for node in self.nodes:
+            lane = node.lane
+            v = self.batch.view
+            nid = int(v.id[lane])
+            voters = set(self.batch.peer_ids(lane, voters=True)) | set(
+                int(x)
+                for x in np.asarray(v.prs_id[lane])[np.asarray(v.voters_out[lane])]
+                if x
+            )
+            vs = "(Voter)" if nid in voters else "(Non-Voter)"
+            self.output.write(
+                f"{nid}: {D.STATE_NAMES[int(v.state[lane])]} {vs} "
+                f"Term:{int(v.term[lane])} Lead:{int(v.lead[lane])}\n"
+            )
+
+    def handle_status(self, d: TestData):
+        from raft_tpu.testing.logoracle import progress_fields
+
+        idx = self._first_idx(d)
+        lane = self.nodes[idx].lane
+        snap = self.oracle.snapshot(lane)
+        progress = {}
+        for j in range(self.batch.shape.v):
+            pid = int(snap.prs_id[j])
+            if pid:
+                progress[pid] = progress_fields(snap, j)
+        self.output.write(D.progress_map_str(progress))
+
+    # -- message plumbing --------------------------------------------------
+
+    def _split_msgs(self, to_id: int, typ: int = -1, drop: bool = False):
+        """reference: rafttest/interaction_env_handler_stabilize.go:117-139."""
+        take, rest = [], []
+        for m in self.messages:
+            local = (
+                m.frm == m.to or m.frm in (-1, -2) or m.to in (-1, -2)
+            )
+            if m.to == to_id and not (drop and local) and (typ < 0 or m.type == typ):
+                take.append(m)
+            else:
+                rest.append(m)
+        return take, rest
+
+    def handle_deliver_msgs(self, d: TestData):
+        typ = -1
+        recipients: list[tuple[int, bool]] = []
+        for a in d.cmd_args:
+            if not a.vals:
+                recipients.append((int(a.key), False))
+            elif a.key == "drop":
+                for val in a.vals:
+                    recipients.append((int(val), True))
+            elif a.key == "type":
+                for t, name in D.MSG_NAMES.items():
+                    if name == a.vals[0]:
+                        typ = t
+                        break
+                else:
+                    return f"unknown message type {a.vals[0]}"
+        n = self._deliver_msgs(typ, recipients)
+        if n == 0:
+            self.output.write("no messages\n")
+
+    def _deliver_msgs(self, typ: int, recipients: list[tuple[int, bool]]) -> int:
+        n = 0
+        for rid, drop in recipients:
+            msgs, self.messages = self._split_msgs(rid, typ, drop)
+            n += len(msgs)
+            for m in msgs:
+                if drop:
+                    self.output.write("dropped: ")
+                self.output.write(D.describe_message(m) + "\n")
+                if drop:
+                    continue
+                self.batch.step(self.nodes[m.to - 1].lane, m)
+        return n
+
+    # -- ready / storage threads -------------------------------------------
+
+    def handle_process_ready(self, d: TestData):
+        idxs = self._idxs(d)
+        for idx in idxs:
+            if len(idxs) > 1:
+                self.output.write(f"> {idx + 1} handling Ready\n")
+                with self._indent():
+                    err = self._process_ready(idx)
+            else:
+                err = self._process_ready(idx)
+            if err:
+                return err
+
+    def _process_ready(self, idx: int):
+        """reference: rafttest/interaction_env_handler_process_ready.go:44-82."""
+        node = self.nodes[idx]
+        b = self.batch
+        rd = b.ready(node.lane)
+        self.output.write(D.describe_ready(rd))
+        if node.async_storage:
+            raise NotImplementedError("async-storage-writes harness mode")
+        self._process_apply(node, rd.committed_entries)
+        for m in rd.messages:
+            self.messages.append(m)
+        b.advance(node.lane)
+        return None
+
+    def _process_apply(self, node: EnvNode, ents):
+        """reference: interaction_env_handler_process_apply_thread.go:71-111
+        — the hard-coded appender state machine + History snapshots."""
+        for ent in ents:
+            update = ent.data
+            cs = None
+            if ent.type in (
+                int(EntryType.ENTRY_CONF_CHANGE),
+                int(EntryType.ENTRY_CONF_CHANGE_V2),
+            ):
+                cc = ccm.decode(ent.data)
+                update = b""
+                cs = self.batch.apply_conf_change(node.lane, cc)
+            last = node.history[-1]
+            snap = Snapshot(
+                index=ent.index,
+                term=ent.term,
+                data=last.data + update,
+            )
+            if cs is None:
+                snap = dataclasses.replace(
+                    snap,
+                    voters=last.voters, learners=last.learners,
+                    voters_outgoing=last.voters_outgoing,
+                    learners_next=last.learners_next,
+                    auto_leave=last.auto_leave,
+                )
+            else:
+                snap = dataclasses.replace(
+                    snap,
+                    voters=tuple(sorted(cs.voters)),
+                    learners=tuple(sorted(cs.learners)),
+                    voters_outgoing=tuple(sorted(cs.voters_outgoing)),
+                    learners_next=tuple(sorted(cs.learners_next)),
+                    auto_leave=cs.auto_leave,
+                )
+            node.history.append(snap)
+            self.batch.store.set_snapshot(node.lane, snap)
+
+    def handle_stabilize(self, d: TestData):
+        restore_lvl = None
+        a = d.arg("log-level")
+        if a:
+            restore_lvl = self.output.lvl
+            self.handle_log_level(
+                TestData(d.pos, "log-level", [type(a)(a.vals[0], [])], "", "")
+            )
+        try:
+            return self._stabilize(self._idxs(d))
+        finally:
+            if restore_lvl is not None:
+                self.output.lvl = restore_lvl
+
+    def _stabilize(self, idxs: list[int]):
+        """reference: interaction_env_handler_stabilize.go:49-113."""
+        b = self.batch
+        while True:
+            done = True
+            for idx in idxs:
+                node = self.nodes[idx]
+                if b.has_ready(node.lane):
+                    self.output.write(f"> {idx + 1} handling Ready\n")
+                    with self._indent():
+                        err = self._process_ready(idx)
+                    if err:
+                        return err
+                    done = False
+            for idx in idxs:
+                nid = idx + 1
+                msgs, _ = self._split_msgs(nid)
+                if msgs:
+                    self.output.write(f"> {nid} receiving messages\n")
+                    with self._indent():
+                        self._deliver_msgs(-1, [(nid, False)])
+                    done = False
+            for idx in idxs:
+                node = self.nodes[idx]
+                if node.append_work:
+                    self.output.write(f"> {idx + 1} processing append thread\n")
+                    while node.append_work:
+                        with self._indent():
+                            self._process_append_thread(idx)
+                    done = False
+            for idx in idxs:
+                node = self.nodes[idx]
+                if node.apply_work:
+                    self.output.write(f"> {idx + 1} processing apply thread\n")
+                    while node.apply_work:
+                        with self._indent():
+                            self._process_apply_thread(idx)
+                    done = False
+            if done:
+                return None
+
+    def handle_process_append_thread(self, d: TestData):
+        idxs = self._idxs(d)
+        for idx in idxs:
+            if len(idxs) > 1:
+                self.output.write(f"> {idx + 1} processing append thread\n")
+                with self._indent():
+                    self._process_append_thread(idx)
+            else:
+                self._process_append_thread(idx)
+
+    def handle_process_apply_thread(self, d: TestData):
+        idxs = self._idxs(d)
+        for idx in idxs:
+            if len(idxs) > 1:
+                self.output.write(f"> {idx + 1} processing apply thread\n")
+                with self._indent():
+                    self._process_apply_thread(idx)
+            else:
+                self._process_apply_thread(idx)
+
+    def _process_append_thread(self, idx: int):
+        raise NotImplementedError("async-storage-writes harness mode")
+
+    def _process_apply_thread(self, idx: int):
+        raise NotImplementedError("async-storage-writes harness mode")
+
+    # -- indent ------------------------------------------------------------
+
+    def _indent(self):
+        env = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.saved = env.output.parts
+                env.output.parts = []
+
+            def __exit__(self, *exc):
+                inner = "".join(env.output.parts)
+                env.output.parts = self.saved
+                for line in inner.splitlines():
+                    env.output.write("  " + line + "\n")
+
+        return _Ctx()
+
+
+class HandlerError(Exception):
+    pass
+
+
+def run_script(path: str, env: InteractionEnv | None = None) -> list[tuple]:
+    """Run a datadriven script; returns [(TestData, actual)] per directive."""
+    from raft_tpu.testing.datadriven import parse_file
+
+    env = env or InteractionEnv()
+    results = []
+    for d in parse_file(path):
+        actual = env.handle(d)
+        results.append((d, actual))
+    return results

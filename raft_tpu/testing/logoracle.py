@@ -1,0 +1,773 @@
+"""Host-side log oracle for golden parity.
+
+The reference emits its INFO/DEBUG/WARN log lines from *inside* the scalar
+state machine (raft.go, log.go, log_unstable.go); the goldens capture them
+through the test Logger (reference: rafttest/interaction_env_logger.go). The
+TPU engine's step is a batched kernel with no logging, so the harness
+reproduces those lines host-side: before each single-lane step it snapshots
+the lane, and afterwards replays the reference's *logging decision tree*
+(reference: raft.go:1051-1221 Step + role handlers) against (pre-state,
+message, post-state). This never mutates engine state — it is a pure mirror
+of which log calls the Go code would have made, and doubles as a scalar
+cross-check of the kernel's control flow: if the kernel diverges, the logged
+lines (and the golden diff) expose it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.testing import describe as D
+from raft_tpu.types import (
+    CampaignType,
+    EntryType,
+    MessageType as MT,
+    ProgressState as PS,
+    StateType as ST,
+    VoteState,
+)
+
+DEBUG, INFO, WARN, ERROR = 0, 1, 2, 3
+
+FOLLOWER, CANDIDATE, LEADER, PRE_CANDIDATE = (
+    int(ST.FOLLOWER), int(ST.CANDIDATE), int(ST.LEADER), int(ST.PRE_CANDIDATE),
+)
+
+
+class LaneSnap:
+    """Copy of one lane's state, with the reference raft struct's accessors."""
+
+    SCALARS = (
+        "id term vote state lead lead_transferee election_elapsed "
+        "heartbeat_elapsed randomized_election_timeout committed applied "
+        "applying last stabled snap_index snap_term pending_snap_index "
+        "pending_snap_term pending_conf_index uncommitted_size auto_leave "
+        "is_learner"
+    ).split()
+    ROWS = (
+        "log_term log_type prs_id voters_in voters_out learners learners_next "
+        "pr_match pr_next pr_state pr_recent_active pr_msg_app_flow_paused "
+        "pr_pending_snapshot votes infl_count"
+    ).split()
+    CFG = (
+        "check_quorum pre_vote read_only_lease_based election_tick "
+        "disable_proposal_forwarding disable_conf_change_validation "
+        "step_down_on_removal max_inflight"
+    ).split()
+
+    def __init__(self, batch, lane: int):
+        v = batch.view
+        self.lane = lane
+        self.w = batch.shape.w
+        self.inflight_cap = batch.shape.max_inflight  # static ring size F
+        for f in self.SCALARS:
+            setattr(self, f, int(getattr(v, f)[lane]))
+        for f in self.ROWS:
+            setattr(self, f, np.array(getattr(v, f)[lane]))
+        cfg = batch.state.cfg
+        for f in self.CFG:
+            setattr(self, f, int(np.asarray(getattr(cfg, f)[lane])))
+
+    # -- log accessors (reference: log.go) --------------------------------
+
+    def term_at(self, index: int) -> int:
+        """zeroTermOnOutOfBounds semantics (reference: log.go:381-407)."""
+        if index == self.snap_index:
+            return self.snap_term
+        if index < self.snap_index or index > self.last:
+            return 0
+        return int(self.log_term[index & (self.w - 1)])
+
+    def type_at(self, index: int) -> int:
+        return int(self.log_type[index & (self.w - 1)])
+
+    @property
+    def last_term(self) -> int:
+        return self.term_at(self.last)
+
+    def is_up_to_date(self, log_term: int, index: int) -> bool:
+        """reference: log.go:435-441."""
+        return log_term > self.last_term or (
+            log_term == self.last_term and index >= self.last
+        )
+
+    # -- membership accessors ----------------------------------------------
+
+    def voter_ids(self) -> list[int]:
+        ids = set()
+        for j in range(len(self.prs_id)):
+            if self.prs_id[j] and (self.voters_in[j] or self.voters_out[j]):
+                ids.add(int(self.prs_id[j]))
+        return sorted(ids)
+
+    def promotable(self) -> bool:
+        """reference: raft.go:975-980."""
+        in_prs = any(
+            self.prs_id[j] == self.id and not self.learners[j]
+            for j in range(len(self.prs_id))
+        )
+        return in_prs and not self.is_learner and self.pending_snap_index == 0
+
+    def has_unapplied_conf_changes(self) -> bool:
+        """reference: raft.go:963-989 (scan (applied, committed])."""
+        for i in range(self.applied + 1, self.committed + 1):
+            if i <= self.snap_index:
+                continue
+            if self.type_at(i) in (
+                int(EntryType.ENTRY_CONF_CHANGE),
+                int(EntryType.ENTRY_CONF_CHANGE_V2),
+            ):
+                return True
+        return False
+
+    def tally(self) -> tuple[int, int]:
+        """reference: tracker/tracker.go:269-290 TallyVotes."""
+        gr = rj = 0
+        for j in range(len(self.prs_id)):
+            if not self.prs_id[j] or self.learners[j]:
+                continue
+            if not (self.voters_in[j] or self.voters_out[j]):
+                continue
+            if self.votes[j] == int(VoteState.GRANTED):
+                gr += 1
+            elif self.votes[j] == int(VoteState.REJECTED):
+                rj += 1
+        return gr, rj
+
+    def config_str(self) -> str:
+        ids = self.prs_id
+
+        def sel(mask):
+            return sorted(int(i) for i, m in zip(ids, mask) if i and m)
+
+        class _C:
+            pass
+
+        c = _C()
+        c.voters_in = sel(self.voters_in)
+        c.voters_out = sel(self.voters_out)
+        c.learners = sel(self.learners)
+        c.learners_next = sel(self.learners_next)
+        c.auto_leave = bool(self.auto_leave)
+        return D.tracker_config_str(c)
+
+
+class LogOracle:
+    """Trace hook installed on RawNodeBatch (called from `_run_step`)."""
+
+    def __init__(self, env, batch):
+        self.env = env
+        self.batch = batch
+
+    def snapshot(self, lane: int) -> LaneSnap:
+        return LaneSnap(self.batch, lane)
+
+    def logf(self, lvl: int, text: str):
+        self.env.output.logf(lvl, text)
+
+    # ------------------------------------------------------------------
+
+    def after_step(self, lane: int, msg, pre: LaneSnap):
+        post = LaneSnap(self.batch, lane)
+        self._step_lines(pre, post, msg)
+
+    # The mirror of raft.Step's logging (reference: raft.go:1051-1221).
+    def _step_lines(self, r: LaneSnap, post: LaneSnap, m):
+        logf = self.logf
+        mtype = int(m.type)
+        mname = D.MSG_NAMES.get(mtype, str(mtype))
+        term, vote, lead = r.term, r.vote, r.lead
+        state = r.state
+
+        if m.term > r.term:
+            if mtype in (int(MT.MSG_VOTE), int(MT.MSG_PRE_VOTE)):
+                force = int(getattr(m, "context", 0)) == int(CampaignType.TRANSFER)
+                in_lease = (
+                    r.check_quorum
+                    and r.lead != 0
+                    and r.election_elapsed < r.election_tick
+                )
+                if not force and in_lease:
+                    logf(
+                        INFO,
+                        f"{r.id:x} [logterm: {r.last_term}, index: {r.last}, "
+                        f"vote: {r.vote:x}] ignored {mname} from {m.frm:x} "
+                        f"[logterm: {m.log_term}, index: {m.index}] at term "
+                        f"{r.term}: lease is not expired (remaining ticks: "
+                        f"{r.election_tick - r.election_elapsed})",
+                    )
+                    return
+            skip_bump = mtype == int(MT.MSG_PRE_VOTE) or (
+                mtype == int(MT.MSG_PRE_VOTE_RESP) and not m.reject
+            )
+            if not skip_bump:
+                logf(
+                    INFO,
+                    f"{r.id:x} [term: {r.term}] received a {mname} message with "
+                    f"higher term from {m.frm:x} [term: {m.term}]",
+                )
+                logf(INFO, f"{r.id:x} became follower at term {m.term}")
+                term, vote, state = m.term, 0, FOLLOWER
+                lead = (
+                    m.frm
+                    if mtype in (int(MT.MSG_APP), int(MT.MSG_HEARTBEAT), int(MT.MSG_SNAP))
+                    else 0
+                )
+        elif m.term and m.term < r.term:
+            if (r.check_quorum or r.pre_vote) and mtype in (
+                int(MT.MSG_HEARTBEAT), int(MT.MSG_APP),
+            ):
+                return  # silent MsgAppResp bounce (raft.go:1082-1110)
+            if mtype == int(MT.MSG_PRE_VOTE):
+                logf(
+                    INFO,
+                    f"{r.id:x} [logterm: {r.last_term}, index: {r.last}, "
+                    f"vote: {r.vote:x}] rejected {mname} from {m.frm:x} "
+                    f"[logterm: {m.log_term}, index: {m.index}] at term {r.term}",
+                )
+                return
+            if mtype == int(MT.MSG_STORAGE_APPEND_RESP):
+                if m.snapshot is not None:
+                    logf(
+                        INFO,
+                        f"{r.id:x} [term: {r.term}] ignored entry appends from a "
+                        f"{mname} message with lower term [term: {m.term}]",
+                    )
+                # snapshot acks at lower term still apply (raft.go:1121-1133)
+            else:
+                logf(
+                    INFO,
+                    f"{r.id:x} [term: {r.term}] ignored a {mname} message with "
+                    f"lower term from {m.frm:x} [term: {m.term}]",
+                )
+                return
+
+        # ------- the main switch (raft.go:1141-1221) ----------------------
+        if mtype == int(MT.MSG_HUP):
+            self._hup(r, post, CampaignType.PRE_ELECTION if r.pre_vote else CampaignType.ELECTION)
+        elif mtype in (int(MT.MSG_VOTE), int(MT.MSG_PRE_VOTE)):
+            can_vote = (
+                vote == m.frm
+                or (vote == 0 and lead == 0)
+                or (mtype == int(MT.MSG_PRE_VOTE) and m.term > term)
+            )
+            if can_vote and r.is_up_to_date(m.log_term, m.index):
+                logf(
+                    INFO,
+                    f"{r.id:x} [logterm: {r.last_term}, index: {r.last}, "
+                    f"vote: {vote:x}] cast {mname} for {m.frm:x} "
+                    f"[logterm: {m.log_term}, index: {m.index}] at term {term}",
+                )
+            else:
+                logf(
+                    INFO,
+                    f"{r.id:x} [logterm: {r.last_term}, index: {r.last}, "
+                    f"vote: {vote:x}] rejected {mname} from {m.frm:x} "
+                    f"[logterm: {m.log_term}, index: {m.index}] at term {term}",
+                )
+        elif state == LEADER:
+            self._step_leader(r, post, m, mname, term)
+        elif state in (CANDIDATE, PRE_CANDIDATE):
+            self._step_candidate(r, post, m, mname, term, state)
+        else:
+            self._step_follower(r, post, m, mname, term, lead)
+
+    # ------------------------------------------------------------------
+
+    def _hup(self, r: LaneSnap, post: LaneSnap, t: CampaignType):
+        """reference: raft.go:941-1039 hup+campaign logging."""
+        logf = self.logf
+        if r.state == LEADER:
+            logf(DEBUG, f"{r.id:x} ignoring MsgHup because already leader")
+            return
+        if not r.promotable():
+            logf(WARN, f"{r.id:x} is unpromotable and can not campaign")
+            return
+        if r.has_unapplied_conf_changes():
+            logf(
+                WARN,
+                f"{r.id:x} cannot campaign at term {r.term} since there are "
+                f"still pending configuration changes to apply",
+            )
+            return
+        logf(INFO, f"{r.id:x} is starting a new election at term {r.term}")
+        self._campaign(r, post, t)
+
+    def _campaign(self, r: LaneSnap, post: LaneSnap, t: CampaignType):
+        logf = self.logf
+        if t == CampaignType.PRE_ELECTION:
+            logf(INFO, f"{r.id:x} became pre-candidate at term {r.term}")
+            vote_msg, log_term = "MsgPreVote", r.term
+        else:
+            logf(INFO, f"{r.id:x} became candidate at term {r.term + 1}")
+            vote_msg, log_term = "MsgVote", r.term + 1
+        for vid in r.voter_ids():
+            if vid == r.id:
+                continue
+            logf(
+                INFO,
+                f"{r.id:x} [logterm: {r.last_term}, index: {r.last}] sent "
+                f"{vote_msg} request to {vid:x} at term {log_term}",
+            )
+
+    # ------------------------------------------------------------------
+
+    def _step_leader(self, r: LaneSnap, post: LaneSnap, m, mname: str, term: int):
+        """reference: raft.go:1225-1620."""
+        logf = self.logf
+        mtype = int(m.type)
+        j = self._slot(r, m.frm)
+        if mtype == int(MT.MSG_CHECK_QUORUM):
+            if post.state == FOLLOWER:
+                logf(WARN, f"{r.id:x} stepped down to follower since quorum is not active")
+            return
+        if mtype == int(MT.MSG_PROP):
+            if r.lead_transferee:
+                logf(
+                    DEBUG,
+                    f"{r.id:x} [term {r.term}] transfer leadership to "
+                    f"{r.lead_transferee:x} is in progress; dropping proposal",
+                )
+                return
+            self._prop_conf_gating(r, m)
+            if post.auto_leave is False and r.auto_leave:
+                pass
+            return
+        if j is None:
+            if mtype in (
+                int(MT.MSG_APP_RESP), int(MT.MSG_HEARTBEAT_RESP),
+                int(MT.MSG_SNAP_STATUS), int(MT.MSG_UNREACHABLE),
+            ):
+                logf(DEBUG, f"{r.id:x} no progress available for {m.frm:x}")
+                return
+        if mtype == int(MT.MSG_APP_RESP):
+            if m.reject:
+                logf(
+                    DEBUG,
+                    f"{r.id:x} received MsgAppResp(rejected, hint: (index "
+                    f"{m.reject_hint}, term {m.log_term})) from {m.frm:x} for "
+                    f"index {m.index}",
+                )
+                if j is not None and post.pr_next[j] < r.pr_next[j]:
+                    logf(
+                        DEBUG,
+                        f"{r.id:x} decreased progress of {m.frm:x} to "
+                        f"[{self._pr_str(post, j)}]",
+                    )
+            else:
+                if (
+                    j is not None
+                    and r.pr_state[j] == int(PS.SNAPSHOT)
+                    and post.pr_state[j] != int(PS.SNAPSHOT)
+                ):
+                    logf(
+                        DEBUG,
+                        f"{r.id:x} recovered from needing snapshot, resumed "
+                        f"sending replication messages to {m.frm:x} "
+                        f"[{self._pr_str(post, j)}]",
+                    )
+                if r.lead_transferee == m.frm and post.lead_transferee == m.frm:
+                    logf(
+                        INFO,
+                        f"{r.id:x} sent MsgTimeoutNow to {m.frm:x} after "
+                        f"received MsgAppResp",
+                    )
+        elif mtype == int(MT.MSG_SNAP_STATUS):
+            if j is None or r.pr_state[j] != int(PS.SNAPSHOT):
+                return
+            if not m.reject:
+                logf(
+                    DEBUG,
+                    f"{r.id:x} snapshot succeeded, resumed sending replication "
+                    f"messages to {m.frm:x} [{self._pr_str(post, j)}]",
+                )
+            else:
+                logf(
+                    DEBUG,
+                    f"{r.id:x} snapshot failed, resumed sending replication "
+                    f"messages to {m.frm:x} [{self._pr_str(post, j)}]",
+                )
+        elif mtype == int(MT.MSG_UNREACHABLE):
+            if j is not None:
+                logf(
+                    DEBUG,
+                    f"{r.id:x} failed to send message to {m.frm:x} because it "
+                    f"is unreachable [{self._pr_str(post, j)}]",
+                )
+        elif mtype == int(MT.MSG_TRANSFER_LEADER):
+            self._transfer_leader(r, post, m)
+
+    def _prop_conf_gating(self, r: LaneSnap, m):
+        """reference: raft.go:1259-1296 — 'ignoring conf change' line."""
+        from raft_tpu import confchange as ccm
+
+        logf = self.logf
+        for e in m.entries:
+            if int(e.type) not in (
+                int(EntryType.ENTRY_CONF_CHANGE), int(EntryType.ENTRY_CONF_CHANGE_V2),
+            ):
+                continue
+            if r.disable_conf_change_validation:
+                continue
+            already_pending = r.pending_conf_index > r.applied
+            already_joint = bool(np.any(r.voters_out & (r.prs_id != 0)))
+            cc2 = ccm.decode(e.data).as_v2()
+            wants_leave = not cc2.changes and cc2.transition == 0
+            refused = ""
+            if already_pending:
+                refused = (
+                    f"possible unapplied conf change at index "
+                    f"{r.pending_conf_index} (applied to {r.applied})"
+                )
+            elif already_joint and not wants_leave:
+                refused = "must transition out of joint config first"
+            elif not already_joint and wants_leave:
+                refused = "not in joint state; refusing empty conf change"
+            if refused:
+                logf(
+                    INFO,
+                    f"{r.id:x} ignoring conf change {self._cc_gostr(cc2)} at "
+                    f"config {r.config_str()}: {refused}",
+                )
+
+    @staticmethod
+    def _cc_gostr(cc2) -> str:
+        """%v of a Go ConfChangeV2 struct literal."""
+        tr = {
+            0: "ConfChangeTransitionAuto",
+            1: "ConfChangeTransitionJointImplicit",
+            2: "ConfChangeTransitionJointExplicit",
+        }[int(cc2.transition)]
+        from raft_tpu.confchange import ConfChangeType as CT
+
+        names = {
+            int(CT.ADD_NODE): "ConfChangeAddNode",
+            int(CT.ADD_LEARNER_NODE): "ConfChangeAddLearnerNode",
+            int(CT.REMOVE_NODE): "ConfChangeRemoveNode",
+            int(CT.UPDATE_NODE): "ConfChangeUpdateNode",
+        }
+        chs = " ".join(
+            f"{{{names[int(c.type)]} {c.node_id}}}" for c in cc2.changes
+        )
+        return f"{{{tr} [{chs}] []}}" if chs else f"{{{tr} [] []}}"
+
+    def _transfer_leader(self, r: LaneSnap, post: LaneSnap, m):
+        """reference: raft.go:1588-1615."""
+        logf = self.logf
+        if r.is_learner:
+            logf(DEBUG, f"{r.id:x} is learner. Ignored transferring leadership")
+            return
+        transferee = m.frm
+        if r.lead_transferee:
+            if r.lead_transferee == transferee:
+                logf(
+                    INFO,
+                    f"{r.id:x} [term {r.term}] transfer leadership to "
+                    f"{transferee:x} is in progress, ignores request to same "
+                    f"node {transferee:x}",
+                )
+                return
+            logf(
+                INFO,
+                f"{r.id:x} [term {r.term}] abort previous transferring "
+                f"leadership to {r.lead_transferee:x}",
+            )
+        if transferee == r.id:
+            logf(
+                DEBUG,
+                f"{r.id:x} is already leader. Ignored transferring leadership to self",
+            )
+            return
+        logf(
+            INFO,
+            f"{r.id:x} [term {r.term}] starts to transfer leadership to {transferee:x}",
+        )
+        j = self._slot(r, transferee)
+        if j is not None and r.pr_match[j] == r.last:
+            logf(
+                INFO,
+                f"{r.id:x} sends MsgTimeoutNow to {transferee:x} immediately as "
+                f"{transferee:x} already has up-to-date log",
+            )
+
+    # ------------------------------------------------------------------
+
+    def _step_candidate(self, r, post, m, mname, term, state):
+        """reference: raft.go:1624-1667."""
+        logf = self.logf
+        mtype = int(m.type)
+        my_vote_resp = (
+            int(MT.MSG_PRE_VOTE_RESP) if state == PRE_CANDIDATE else int(MT.MSG_VOTE_RESP)
+        )
+        if mtype == int(MT.MSG_PROP):
+            logf(INFO, f"{r.id:x} no leader at term {term}; dropping proposal")
+            return
+        if mtype == my_vote_resp:
+            rname = D.MSG_NAMES[my_vote_resp]
+            if not m.reject:
+                logf(INFO, f"{r.id:x} received {rname} from {m.frm:x} at term {term}")
+            else:
+                logf(
+                    INFO,
+                    f"{r.id:x} received {rname} rejection from {m.frm:x} at term {term}",
+                )
+            gr, rj = post.tally() if post.state == state else self._tally_with(r, m)
+            logf(
+                INFO,
+                f"{r.id:x} has received {gr} {rname} votes and {rj} vote rejections",
+            )
+            q = len(r.voter_ids()) // 2 + 1
+            if gr >= q:
+                if state == PRE_CANDIDATE:
+                    self._campaign(r, post, CampaignType.ELECTION)
+                else:
+                    logf(INFO, f"{r.id:x} became leader at term {post.term}")
+            elif rj + gr == len(r.voter_ids()) and rj > 0 or post.state == FOLLOWER:
+                if post.state == FOLLOWER and post.term == term:
+                    logf(INFO, f"{r.id:x} became follower at term {term}")
+        elif mtype == int(MT.MSG_TIMEOUT_NOW):
+            logf(
+                DEBUG,
+                f"{r.id:x} [term {term} state {self._go_state(state)}] ignored "
+                f"MsgTimeoutNow from {m.frm:x}",
+            )
+        elif mtype in (int(MT.MSG_APP), int(MT.MSG_HEARTBEAT), int(MT.MSG_SNAP)):
+            # becomeFollower(m.Term, m.From) at same term (raft.go:1633-1645)
+            if post.state == FOLLOWER:
+                logf(INFO, f"{r.id:x} became follower at term {term}")
+            self._step_follower(r, post, m, mname, term, m.frm, skip_become=True)
+
+    def _tally_with(self, r: LaneSnap, m) -> tuple[int, int]:
+        """Tally as the reference would after recording this vote, computed
+        from the PRE state (needed when the tally transitions the role so the
+        post-state vote rows were reset)."""
+        gr = rj = 0
+        recorded = False
+        for jj in range(len(r.prs_id)):
+            nid = int(r.prs_id[jj])
+            if not nid or r.learners[jj]:
+                continue
+            if not (r.voters_in[jj] or r.voters_out[jj]):
+                continue
+            v = int(r.votes[jj])
+            if nid == m.frm and v == int(VoteState.PENDING):
+                v = int(VoteState.REJECTED) if m.reject else int(VoteState.GRANTED)
+                recorded = True
+            if v == int(VoteState.GRANTED):
+                gr += 1
+            elif v == int(VoteState.REJECTED):
+                rj += 1
+        del recorded
+        return gr, rj
+
+    @staticmethod
+    def _go_state(state: int) -> str:
+        return D.STATE_NAMES[state]
+
+    # ------------------------------------------------------------------
+
+    def _step_follower(self, r, post, m, mname, term, lead, skip_become=False):
+        """reference: raft.go:1669-1730."""
+        logf = self.logf
+        mtype = int(m.type)
+        if mtype == int(MT.MSG_PROP):
+            if lead == 0:
+                logf(INFO, f"{r.id:x} no leader at term {term}; dropping proposal")
+            elif r.disable_proposal_forwarding:
+                logf(
+                    INFO,
+                    f"{r.id:x} not forwarding to leader {lead:x} at term {term}; "
+                    f"dropping proposal",
+                )
+            return
+        if mtype == int(MT.MSG_APP):
+            self._handle_append(r, post, m)
+        elif mtype == int(MT.MSG_SNAP):
+            self._handle_snapshot(r, post, m)
+        elif mtype == int(MT.MSG_TRANSFER_LEADER):
+            if lead == 0:
+                logf(INFO, f"{r.id:x} no leader at term {term}; dropping leader transfer msg")
+        elif mtype == int(MT.MSG_TIMEOUT_NOW):
+            logf(
+                INFO,
+                f"{r.id:x} [term {term}] received MsgTimeoutNow from {m.frm:x} "
+                f"and starts an election to get leadership.",
+            )
+            self._hup_transfer(r, post, term)
+        elif mtype == int(MT.MSG_FORGET_LEADER):
+            if r.read_only_lease_based:
+                logf(ERROR, "ignoring MsgForgetLeader due to ReadOnlyLeaseBased")
+                return
+            if lead != 0:
+                logf(INFO, f"{r.id:x} forgetting leader {lead:x} at term {term}")
+        elif mtype == int(MT.MSG_READ_INDEX):
+            if lead == 0:
+                logf(INFO, f"{r.id:x} no leader at term {term}; dropping index reading msg")
+
+    def _hup_transfer(self, r: LaneSnap, post: LaneSnap, term: int):
+        """MsgTimeoutNow → hup(campaignTransfer) with the post-ladder state."""
+        logf = self.logf
+        if not r.promotable():
+            logf(WARN, f"{r.id:x} is unpromotable and can not campaign")
+            return
+        if r.has_unapplied_conf_changes():
+            logf(
+                WARN,
+                f"{r.id:x} cannot campaign at term {term} since there are "
+                f"still pending configuration changes to apply",
+            )
+            return
+        logf(INFO, f"{r.id:x} is starting a new election at term {term}")
+        fake = LaneSnap.__new__(LaneSnap)
+        fake.__dict__.update(r.__dict__)
+        fake.term = term
+        self._campaign(fake, post, CampaignType.ELECTION)
+
+    def _handle_append(self, r: LaneSnap, post: LaneSnap, m):
+        """reference: raft.go:1732-1770 + log.go maybeAppend/findConflict +
+        log_unstable.go truncateAndAppend."""
+        logf = self.logf
+        if m.index < r.committed:
+            return
+        # matchTerm(m.Index, m.LogTerm)?
+        if r.term_at(m.index) == m.log_term:
+            ents = m.entries
+            conflict = 0
+            for e in ents:
+                if r.term_at(e.index) != e.term:
+                    if e.index <= r.last:
+                        logf(
+                            INFO,
+                            f"found conflict at index {e.index} [existing term: "
+                            f"{r.term_at(e.index)}, conflicting term: {e.term}]",
+                        )
+                    conflict = e.index
+                    break
+            if conflict and conflict <= r.committed:
+                pass  # would panic in reference; kernel flags error_bits
+            if conflict:
+                # unstable.truncateAndAppend cases (log_unstable.go:196-218)
+                offset = r.stabled + 1
+                if conflict == r.last + 1:
+                    pass
+                elif conflict <= offset:
+                    logf(INFO, f"replace the unstable entries from index {conflict}")
+                else:
+                    logf(
+                        INFO,
+                        f"truncate the unstable entries before index {conflict}",
+                    )
+        else:
+            hint_index = min(m.index, r.last)
+            # findConflictByTerm walk (log.go:178-213)
+            while hint_index > r.committed and r.term_at(hint_index) > m.log_term:
+                hint_index -= 1
+            hint_term = r.term_at(hint_index)
+            logf(
+                DEBUG,
+                f"{r.id:x} [logterm: {r.term_at(m.index)}, index: {m.index}] "
+                f"rejected MsgApp [logterm: {m.log_term}, index: {m.index}] "
+                f"from {m.frm:x}",
+            )
+            del hint_term
+
+    def _handle_snapshot(self, r: LaneSnap, post: LaneSnap, m):
+        """reference: raft.go:1777-1879 handleSnapshot/restore logging."""
+        logf = self.logf
+        snap = m.snapshot
+        sindex, sterm = snap.index, snap.term
+        restored = post.snap_index >= sindex or post.pending_snap_index == sindex
+        if sindex <= r.committed:
+            logf(
+                INFO,
+                f"{r.id:x} [commit: {r.committed}] ignored snapshot [index: "
+                f"{sindex}, term: {sterm}]",
+            )
+            return
+        if r.state == LEADER:
+            logf(
+                WARN,
+                f"{r.id:x} attempted to restore snapshot as leader; should never happen",
+            )
+            return
+        # fast-forward: snapshot matches an entry we already have
+        if r.term_at(sindex) == sterm:
+            logf(
+                INFO,
+                f"{r.id:x} [commit: {r.committed}, lastindex: {r.last}, "
+                f"lastterm: {r.last_term}] fast-forwarded commit to snapshot "
+                f"[index: {sindex}, term: {sterm}]",
+            )
+            logf(
+                INFO,
+                f"{r.id:x} [commit: {post.committed}] ignored snapshot [index: "
+                f"{sindex}, term: {sterm}]",
+            )
+            return
+        if restored:
+            unstable_len = r.last - r.stabled
+            logf(
+                INFO,
+                f"log [committed={r.committed}, applied={r.applied}, "
+                f"applying={r.applying}, unstable.offset={r.stabled + 1}, "
+                f"unstable.offsetInProgress={r.stabled + 1}, "
+                f"len(unstable.Entries)={unstable_len}] starts to restore "
+                f"snapshot [index: {sindex}, term: {sterm}]",
+            )
+            cs_cfg = _conf_from_snapshot(snap)
+            logf(INFO, f"{r.id:x} switched to configuration {cs_cfg}")
+            logf(
+                INFO,
+                f"{r.id:x} [commit: {sindex}, lastindex: {sindex}, lastterm: "
+                f"{sterm}] restored snapshot [index: {sindex}, term: {sterm}]",
+            )
+            logf(
+                INFO,
+                f"{r.id:x} [commit: {sindex}] restored snapshot [index: "
+                f"{sindex}, term: {sterm}]",
+            )
+
+    def _slot(self, r: LaneSnap, nid: int):
+        for j in range(len(r.prs_id)):
+            if int(r.prs_id[j]) == nid:
+                return j
+        return None
+
+    def _pr_str(self, snap: LaneSnap, j: int) -> str:
+        return D.progress_str(progress_fields(snap, j))
+
+
+def progress_fields(snap: LaneSnap, j: int) -> dict:
+    """The reference Progress.String() field set for peer slot j (reference:
+    tracker/progress.go:225-262 IsPaused + String). Single source of truth for
+    both the oracle's [%s] interpolations and the `status` handler."""
+    st = int(snap.pr_state[j])
+    cnt = int(snap.infl_count[j])
+    cap = min(snap.inflight_cap, snap.max_inflight)
+    paused = (
+        True if st == int(PS.SNAPSHOT) else bool(snap.pr_msg_app_flow_paused[j])
+    )
+    return {
+        "state_name": D.PROGRESS_STATE_NAMES[st],
+        "match": int(snap.pr_match[j]),
+        "next": int(snap.pr_next[j]),
+        "is_learner": bool(snap.learners[j]),
+        "paused": paused,
+        "pending_snapshot": int(snap.pr_pending_snapshot[j]),
+        "recent_active": bool(snap.pr_recent_active[j]),
+        "inflight_count": cnt,
+        "inflight_full": cnt >= cap,
+    }
+
+
+def _conf_from_snapshot(snap) -> str:
+    class _C:
+        pass
+
+    c = _C()
+    c.voters_in = sorted(snap.voters)
+    c.voters_out = sorted(snap.voters_outgoing)
+    c.learners = sorted(snap.learners)
+    c.learners_next = sorted(snap.learners_next)
+    c.auto_leave = snap.auto_leave
+    return D.tracker_config_str(c)
