@@ -220,6 +220,20 @@ _MSG_SCALARS = (
 
 def _msg_to_row(msg: Message, e: int) -> dict:
     row = {b: getattr(msg, h) for h, b in _MSG_SCALARS}
+    if msg.type == int(MT.MSG_PROP) and any(
+        x.type == int(EntryType.ENTRY_CONF_CHANGE_V2) for x in msg.entries
+    ):
+        # per-entry leave-joint bitmask for the device's conf-change gating
+        # (bit k set = entry k is a semantically-empty V2)
+        from raft_tpu import confchange as _ccm
+
+        bits = 0
+        for k, x in enumerate(msg.entries[:e]):
+            if x.type == int(
+                EntryType.ENTRY_CONF_CHANGE_V2
+            ) and _ccm.decode(x.data, v1=False).leave_joint():
+                bits |= 1 << k
+        row["context"] = bits
     ents = msg.entries[:e]
     row["n_ents"] = len(ents)
     row["ent_term"] = [x.term for x in ents] + [0] * (e - len(ents))
@@ -316,7 +330,9 @@ class RawNodeBatch:
             upd[f.name] = arr.at[lane].set(val)
         return MsgBatch(**upd)
 
-    def _collect_out(self, out: MsgBatch, exclude_lane_msgs: bool = False):
+    def _collect_out(
+        self, out: MsgBatch, exclude_lane_msgs: bool = False, src_msg=None
+    ):
         """Move kernel emissions into per-lane host queues."""
         v = self.shape.v
         types = np.asarray(out.type)
@@ -343,7 +359,15 @@ class RawNodeBatch:
                 context=int(cols["context"][lane, slot]),
             )
             ne = int(cols["n_ents"][lane, slot])
-            if ne:
+            if ne and m.type == int(MT.MSG_PROP):
+                # proposal forwarded to the leader: entries ride verbatim with
+                # unset term/index (reference: raft.go:1682-1684)
+                if src_msg is not None:
+                    m.entries = [
+                        Entry(term=0, index=0, type=x.type, data=x.data)
+                        for x in src_msg.entries[:ne]
+                    ]
+            elif ne:
                 base_index = m.index
                 for k in range(ne):
                     term = int(cols["ent_term"][lane, slot, k])
@@ -359,14 +383,18 @@ class RawNodeBatch:
                     )
             si = int(cols["snap_index"][lane, slot])
             if m.type == int(MT.MSG_SNAP):
+                # resolve the app snapshot the kernel referenced by index
+                # (Storage.Snapshot() semantics — carries its own ConfState)
                 snap = self.store.snapshot(lane)
-                m.snapshot = Snapshot(
-                    index=si,
-                    term=int(cols["snap_term"][lane, slot]),
-                    data=snap.data if snap and snap.index == si else b"",
-                    voters=self.peer_ids(lane, voters=True),
-                    learners=self.peer_ids(lane, learners=True),
-                )
+                if snap is not None and snap.index == si:
+                    m.snapshot = snap
+                else:
+                    m.snapshot = Snapshot(
+                        index=si,
+                        term=int(cols["snap_term"][lane, slot]),
+                        voters=self.peer_ids(lane, voters=True),
+                        learners=self.peer_ids(lane, learners=True),
+                    )
             if slot == v or m.to == int(self.view.id[lane]):
                 # self-addressed (after-append acks, own ReadIndex responses):
                 # stepped at Advance, never surfaced in Ready.messages
@@ -387,7 +415,7 @@ class RawNodeBatch:
         self._store_accepted_payloads(lane, msg, old_last, old_term)
         if self.trace is not None:
             self.trace.after_step(lane, msg, pre)
-        self._collect_out(out)
+        self._collect_out(out, src_msg=msg)
 
     def _store_accepted_payloads(
         self, lane: int, msg: Message, old_last: int, old_term: int
@@ -404,10 +432,11 @@ class RawNodeBatch:
             for k, e in enumerate(msg.entries):
                 idx = old_last + 1 + k
                 if idx <= last and int(log_term[idx & (w - 1)]) == cur_term:
-                    self.store.put(
-                        lane,
-                        Entry(cur_term, idx, int(log_type[idx & (w - 1)]), e.data),
-                    )
+                    etype = int(log_type[idx & (w - 1)])
+                    # a conf change refused by gating was appended as an
+                    # EMPTY normal entry (reference: raft.go:1291-1295)
+                    data = e.data if etype == e.type else b""
+                    self.store.put(lane, Entry(cur_term, idx, etype, data))
         else:  # MsgApp
             for e in msg.entries:
                 if e.index <= last and int(log_term[e.index & (w - 1)]) == e.term:
@@ -440,7 +469,7 @@ class RawNodeBatch:
                 )
                 cfg, trk = ccm.restore(cs, last_index=snap.index)
                 self._write_tracker(lane, cfg, trk)
-                self.store.set_snapshot(lane, snap)
+                self.set_app_snapshot(lane, snap)
                 self.store.compact_below(lane, snap.index + 1)
 
     def campaign(self, lane: int):
@@ -649,9 +678,28 @@ class RawNodeBatch:
             and int(v.state[lane]) == int(StateType.LEADER)
             and int(v.lead_transferee[lane]) == 0
         ):
-            self.propose_conf_change(lane, b"", v2=True)
+            from raft_tpu import confchange as _ccm
+
+            if self.trace is not None:
+                self.trace.auto_leave_initiated(lane)
+            self.propose_conf_change(
+                lane, _ccm.encode(_ccm.ConfChangeV2()), v2=True
+            )
 
     # -- snapshot/compaction (reference: storage.go:227-272) ---------------
+
+    def set_app_snapshot(self, lane: int, snap: Snapshot):
+        """Install the application's latest snapshot — the one
+        Storage.Snapshot() returns and leaders ship in MsgSnap (reference:
+        storage.go:79-84, raft.go:636-649)."""
+        self.store.set_snapshot(lane, snap)
+        st = self.state
+        self.state = dataclasses.replace(
+            st,
+            avail_snap_index=st.avail_snap_index.at[lane].set(snap.index),
+            avail_snap_term=st.avail_snap_term.at[lane].set(snap.term),
+        )
+        self.view.refresh(self.state)
 
     def compact(self, lane: int, to_index: int, data: bytes = b""):
         """App-driven compaction: CreateSnapshot(to_index, data) + Compact
@@ -670,7 +718,7 @@ class RawNodeBatch:
         self.state = lg.compact(self.state, mask_idx, mask_term)
         self.view.refresh(self.state)
         self.store.compact_below(lane, to_index + 1)
-        self.store.set_snapshot(
+        self.set_app_snapshot(
             lane,
             Snapshot(
                 index=to_index,

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import struct
 
 
 class ConfChangeType(enum.IntEnum):
@@ -87,39 +86,114 @@ class ConfChangeV2:
         return self.transition == ConfChangeTransition.AUTO and not self.changes
 
 
-# -- byte encoding (engine-native, not protobuf) ---------------------------
+# -- byte encoding: the exact gogoproto wire format ------------------------
+#
+# Encoding is byte-identical to the reference's generated marshal code
+# (reference: raftpb/raft.pb.go:1133-1231) so payload sizes — and therefore
+# every size-budget decision — agree with Go. Non-nullable scalar fields are
+# always written; bytes fields only when non-empty. ConfChange (v1) fields:
+# id=1, type=2, node_id=3, context=4. ConfChangeV2: transition=1,
+# changes=2 (repeated ConfChangeSingle{type=1, node_id=2}), context=3.
+# A ConfChange entry is distinguished from V2 by the Entry.Type, not the
+# payload, so decode() takes a `v1` hint with a structural fallback.
 
-_V1_MAGIC = 0xC1
-_V2_MAGIC = 0xC2
+
+def _varint(x: int) -> bytes:
+    out = bytearray()
+    while x >= 0x80:
+        out.append((x & 0x7F) | 0x80)
+        x >>= 7
+    out.append(x)
+    return bytes(out)
+
+
+def _read_varint(data: bytes, off: int) -> tuple[int, int]:
+    x = shift = 0
+    while True:
+        b = data[off]
+        off += 1
+        x |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return x, off
+        shift += 7
 
 
 def encode(cc: ConfChange | ConfChangeV2) -> bytes:
     if isinstance(cc, ConfChange):
-        return struct.pack("<BBi", _V1_MAGIC, cc.type, cc.node_id) + cc.context
-    b = struct.pack("<BBH", _V2_MAGIC, cc.transition, len(cc.changes))
+        b = b"\x08" + _varint(0)  # id (unused by the harness)
+        b += b"\x10" + _varint(int(cc.type))
+        b += b"\x18" + _varint(cc.node_id)
+        if cc.context:
+            b += b"\x22" + _varint(len(cc.context)) + cc.context
+        return b
+    b = b"\x08" + _varint(int(cc.transition))
     for ch in cc.changes:
-        b += struct.pack("<Bi", ch.type, ch.node_id)
-    return b + cc.context
+        single = b"\x08" + _varint(int(ch.type)) + b"\x10" + _varint(ch.node_id)
+        b += b"\x12" + _varint(len(single)) + single
+    if cc.context:
+        b += b"\x1a" + _varint(len(cc.context)) + cc.context
+    return b
 
 
-def decode(data: bytes) -> ConfChange | ConfChangeV2:
+def _decode_single(data: bytes) -> ConfChangeSingle:
+    t = nid = 0
+    off = 0
+    while off < len(data):
+        tag, off = _read_varint(data, off)
+        if tag == 0x08:
+            t, off = _read_varint(data, off)
+        elif tag == 0x10:
+            nid, off = _read_varint(data, off)
+        else:
+            raise ValueError(f"bad ConfChangeSingle tag {tag:#x}")
+    return ConfChangeSingle(t, nid)
+
+
+def decode(data: bytes, v1: bool | None = None) -> ConfChange | ConfChangeV2:
+    """Callers must pass `v1` (from Entry.Type) — the wire payloads are not
+    self-describing."""
     if not data:
-        # empty V2 payload = leave-joint (reference: raftpb/confchange.go:106)
         return ConfChangeV2()
-    magic = data[0]
-    if magic == _V1_MAGIC:
-        _, t, nid = struct.unpack_from("<BBi", data)
-        return ConfChange(type=t, node_id=nid, context=data[6:])
-    if magic == _V2_MAGIC:
-        _, tr, n = struct.unpack_from("<BBH", data)
-        off = 4
-        changes = []
-        for _ in range(n):
-            t, nid = struct.unpack_from("<Bi", data, off)
-            off += 5
-            changes.append(ConfChangeSingle(t, nid))
-        return ConfChangeV2(transition=tr, changes=changes, context=data[off:])
-    raise ValueError(f"bad conf-change payload: {data[:8]!r}")
+    if v1 is None:
+        raise ValueError("decode() needs the v1 hint (from the entry type)")
+    if v1:
+        t = nid = 0
+        ctx = b""
+        off = 0
+        while off < len(data):
+            tag, off = _read_varint(data, off)
+            if tag == 0x08:
+                _, off = _read_varint(data, off)
+            elif tag == 0x10:
+                t, off = _read_varint(data, off)
+            elif tag == 0x18:
+                nid, off = _read_varint(data, off)
+            elif tag == 0x22:
+                n, off = _read_varint(data, off)
+                ctx = data[off : off + n]
+                off += n
+            else:
+                raise ValueError(f"bad ConfChange tag {tag:#x}")
+        return ConfChange(type=t, node_id=nid, context=ctx)
+    tr = 0
+    changes = []
+    ctx = b""
+    off = 0
+    while off < len(data):
+        tag, off = _read_varint(data, off)
+        if tag == 0x08:
+            tr, off = _read_varint(data, off)
+        elif tag == 0x12:
+            n, off = _read_varint(data, off)
+            changes.append(_decode_single(data[off : off + n]))
+            off += n
+        elif tag == 0x1A:
+            n, off = _read_varint(data, off)
+            ctx = data[off : off + n]
+            off += n
+        else:
+            raise ValueError(f"bad ConfChangeV2 tag {tag:#x}")
+    return ConfChangeV2(transition=tr, changes=tuple(changes), context=ctx)
 
 
 def conf_changes_from_string(s: str) -> list[ConfChangeSingle]:

@@ -375,13 +375,22 @@ def maybe_send_append(
     sie = jnp.broadcast_to(sie, sel.shape)
     sel = sel & ((n_send > 0) | sie)
 
-    # snapshot path: predecessor compacted away (reference raft.go:625-649)
+    # snapshot path: predecessor compacted away (reference raft.go:625-649).
+    # The snapshot *sent* is the application's latest (Storage.Snapshot() —
+    # avail_snap_*, which may be ahead of the compaction point), matching
+    # r.raftLog.snapshot() semantics (reference: raft.go:636-649).
     need_snap = prev < state.snap_index[:, None]
     snap_sel = sel & need_snap & state.pr_recent_active
     app_sel = sel & ~need_snap
 
+    send_si = jnp.where(
+        state.avail_snap_index != 0, state.avail_snap_index, state.snap_index
+    )
+    send_st = jnp.where(
+        state.avail_snap_index != 0, state.avail_snap_term, state.snap_term
+    )
     state = pg.become_snapshot(
-        state, snap_sel, jnp.broadcast_to(state.snap_index[:, None], prev.shape)
+        state, snap_sel, jnp.broadcast_to(send_si[:, None], prev.shape)
     )
     out.put_peers(
         snap_sel,
@@ -389,8 +398,8 @@ def maybe_send_append(
         to=state.prs_id,
         frm=state.id[:, None],
         term=state.term[:, None],
-        snap_index=state.snap_index[:, None],
-        snap_term=state.snap_term[:, None],
+        snap_index=send_si[:, None],
+        snap_term=send_st[:, None],
     )
 
     out.put_peers(
@@ -813,9 +822,18 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     is_cc = msg.ent_type != 0  # [N, E]
     already_pending = state.pending_conf_index > state.applied
     already_joint = state.voters_out.any(axis=1)
-    wants_leave = (msg.ent_type == EntryType.ENTRY_CONF_CHANGE_V2) & (
-        msg.ent_bytes == 0
-    )
+    # leave-joint = semantically-empty V2 (reference: confchange.go:106-112);
+    # the host flags entry k in bit k of msg.context since the 2-byte proto
+    # payload is opaque to the device (an empty V2 still marshals its
+    # transition field)
+    e_ax = msg.ent_type.shape[-1]
+    leave_bits = (
+        jnp.right_shift(
+            msg.context[:, None], jnp.arange(e_ax, dtype=I32)[None, :]
+        )
+        & 1
+    ).astype(bool)
+    wants_leave = (msg.ent_type == EntryType.ENTRY_CONF_CHANGE_V2) & leave_bits
     failed = (
         already_pending[:, None]
         | (already_joint[:, None] & ~wants_leave)

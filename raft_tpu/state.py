@@ -105,6 +105,11 @@ class RaftState:
     # In-flight incoming snapshot (unstable.snapshot, log_unstable.go:38-40):
     pending_snap_index: Any  # [N] i32 (0 = none)
     pending_snap_term: Any  # [N] i32
+    # The application's latest snapshot — what Storage.Snapshot() would
+    # return (reference: storage.go:79-84). May run ahead of the compaction
+    # point; it is what leaders send in MsgSnap (raft.go:636-649).
+    avail_snap_index: Any  # [N] i32 (0 = none)
+    avail_snap_term: Any  # [N] i32
 
     # --- membership (reference: tracker/tracker.go:27-78) ---
     # Slot-major: peer slot j of lane n describes group-member prs_id[n, j].
@@ -277,6 +282,8 @@ def init_state(
         snap_term=zeros_n,
         pending_snap_index=zeros_n,
         pending_snap_term=zeros_n,
+        avail_snap_index=zeros_n,
+        avail_snap_term=zeros_n,
         prs_id=jnp.asarray(peer_ids),
         voters_in=jnp.asarray(voters_in),
         voters_out=jnp.zeros((n, v), BOOL),

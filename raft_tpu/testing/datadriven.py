@@ -105,6 +105,8 @@ def parse_file(path: str) -> list[TestData]:
             ):
                 expected_lines.append(lines[i])
                 i += 1
+            if i >= n:
+                raise ValueError(f"{pos}: unterminated ----/---- output block")
             i += 2
         else:
             while i < n and lines[i].strip() != "":

@@ -123,7 +123,9 @@ def describe_entry(e, formatter=None) -> str:
         from raft_tpu import confchange as ccm
 
         try:
-            cc = ccm.decode(e.data)
+            cc = ccm.decode(
+                e.data, v1=etype == int(EntryType.ENTRY_CONF_CHANGE)
+            )
             formatted = describe_conf_changes(cc.as_v2().changes)
         except Exception as err:  # mirror the unmarshal-error text path
             formatted = str(err)
@@ -238,16 +240,33 @@ def joint_str(voters_in, voters_out) -> str:
     return s
 
 
-def tracker_config_str(cfg) -> str:
-    """reference: tracker/tracker.go:80-93."""
-    s = f"voters={joint_str(cfg.voters_in, cfg.voters_out)}"
-    if cfg.learners:
-        s += f" learners={majority_str(cfg.learners)}"
-    if cfg.learners_next:
-        s += f" learners_next={majority_str(cfg.learners_next)}"
-    if cfg.auto_leave:
+def config_str(
+    voters_in, voters_out=(), learners=(), learners_next=(), auto_leave=False
+) -> str:
+    """reference: tracker/tracker.go:80-93 (Config.String)."""
+    s = f"voters={joint_str(voters_in, voters_out)}"
+    if learners:
+        s += f" learners={majority_str(learners)}"
+    if learners_next:
+        s += f" learners_next={majority_str(learners_next)}"
+    if auto_leave:
         s += " autoleave"
     return s
+
+
+def tracker_config_str(cfg) -> str:
+    return config_str(
+        cfg.voters_in, cfg.voters_out, cfg.learners, cfg.learners_next,
+        cfg.auto_leave,
+    )
+
+
+def conf_state_config_str(cs) -> str:
+    """Config.String over a ConfState-shaped object (voters/_outgoing…)."""
+    return config_str(
+        sorted(cs.voters), sorted(cs.voters_outgoing), sorted(cs.learners),
+        sorted(cs.learners_next), cs.auto_leave,
+    )
 
 
 def progress_str(pr) -> str:

@@ -141,6 +141,8 @@ class InteractionEnv:
                 return err
             self.output.write(err if err.endswith("\n") else err + "\n")
         out = self.output.take()
+        if out and not out.endswith("\n"):
+            out += "\n"  # goldens are newline-terminated
         return out if out else "ok\n"
 
     # ------------------------------------------------------------- handlers
@@ -225,7 +227,7 @@ class InteractionEnv:
             )
         else:
             self.output.logf(INFO, f"{nid} switched to configuration voters=()")
-        b.store.set_snapshot(lane, snap)
+        b.set_app_snapshot(lane, snap)
         node.history.append(snap)
         # reference: rawnode.go:51-66 — NewRawNode seeds prevHardSt/prevSoftSt
         # from the restored state, so boot state never surfaces in a Ready
@@ -261,10 +263,34 @@ class InteractionEnv:
     def handle_campaign(self, d: TestData):
         self.batch.campaign(self.nodes[self._first_idx(d)].lane)
 
+    def _proposal_dropped(self, lane: int) -> bool:
+        """Mirror of ErrProposalDropped returns (reference: raft.go:1244-1302
+        stepLeader, 1636-1642 stepCandidate, 1671-1680 stepFollower)."""
+        v = self.batch.view
+        st = int(v.state[lane])
+        if st == int(StateType.LEADER):
+            nid = int(v.id[lane])
+            in_prs = any(
+                int(v.prs_id[lane, j]) == nid for j in range(self.batch.shape.v)
+            )
+            return not in_prs or int(v.lead_transferee[lane]) != 0
+        if st in (int(StateType.CANDIDATE), int(StateType.PRE_CANDIDATE)):
+            return True
+        # follower
+        if int(v.lead[lane]) == 0:
+            return True
+        return bool(
+            np.asarray(self.batch.state.cfg.disable_proposal_forwarding[lane])
+        )
+
     def handle_propose(self, d: TestData):
         idx = self._first_idx(d)
         data = d.cmd_args[1].key.encode()
-        self.batch.propose(self.nodes[idx].lane, data)
+        lane = self.nodes[idx].lane
+        dropped = self._proposal_dropped(lane)
+        self.batch.propose(lane, data)
+        if dropped:
+            return "raft proposal dropped"
 
     def handle_propose_conf_change(self, d: TestData):
         idx = self._first_idx(d)
@@ -292,11 +318,14 @@ class InteractionEnv:
         )
         lane = self.nodes[idx].lane
         nid = self.batch.id_of(lane)
+        dropped = self._proposal_dropped(lane)
         self.batch._run_step(
             lane,
             Message(type=int(MT.MSG_PROP), to=nid, frm=nid,
                     entries=[Entry(type=int(t), data=data)]),
         )
+        if dropped:
+            return "raft proposal dropped"
 
     # -- ticks -------------------------------------------------------------
 
@@ -402,7 +431,7 @@ class InteractionEnv:
 
         idx = self._first_idx(d)
         lane = self.nodes[idx].lane
-        snap = self.oracle.snapshot(lane)
+        snap = self.oracle.snapshot(lane, force=True)
         progress = {}
         for j in range(self.batch.shape.v):
             pid = int(snap.prs_id[j])
@@ -456,7 +485,21 @@ class InteractionEnv:
                 self.output.write(D.describe_message(m) + "\n")
                 if drop:
                     continue
-                self.batch.step(self.nodes[m.to - 1].lane, m)
+                lane = self.nodes[m.to - 1].lane
+                # reference: rawnode.go:108-125 — response messages from
+                # peers absent from the config are refused
+                from raft_tpu.types import RESPONSE_MSGS
+
+                if m.type in {int(x) for x in RESPONSE_MSGS}:
+                    v = self.batch.view
+                    known = any(
+                        int(v.prs_id[lane, j]) == m.frm
+                        for j in range(self.batch.shape.v)
+                    )
+                    if not known:
+                        self.output.write("raft: cannot step as peer not found\n")
+                        continue
+                self.batch.step(lane, m)
         return n
 
     # -- ready / storage threads -------------------------------------------
@@ -497,9 +540,29 @@ class InteractionEnv:
                 int(EntryType.ENTRY_CONF_CHANGE),
                 int(EntryType.ENTRY_CONF_CHANGE_V2),
             ):
-                cc = ccm.decode(ent.data)
-                update = b""
+                cc = ccm.decode(
+                    ent.data, v1=ent.type == int(EntryType.ENTRY_CONF_CHANGE)
+                )
+                # reference appender applies cc.Context as the update bytes
+                # (interaction_env_handler_process_apply_thread.go:76-91)
+                update = cc.context
+                v = self.batch.view
+                pre_state = int(v.state[node.lane])
+                pre_term = int(v.term[node.lane])
                 cs = self.batch.apply_conf_change(node.lane, cc)
+                nid = self.batch.id_of(node.lane)
+                # reference: raft.go:1920 switchToConfig
+                self.output.logf(
+                    1, f"{nid} switched to configuration {self._cs_str(cs)}"
+                )
+                v = self.batch.view
+                if pre_state == int(StateType.LEADER) and int(
+                    v.state[node.lane]
+                ) == int(StateType.FOLLOWER):
+                    # StepDownOnRemoval (raft.go:1930-1936)
+                    self.output.logf(
+                        1, f"{nid} became follower at term {pre_term}"
+                    )
             last = node.history[-1]
             snap = Snapshot(
                 index=ent.index,
@@ -524,7 +587,11 @@ class InteractionEnv:
                     auto_leave=cs.auto_leave,
                 )
             node.history.append(snap)
-            self.batch.store.set_snapshot(node.lane, snap)
+            self.batch.set_app_snapshot(node.lane, snap)
+
+    @staticmethod
+    def _cs_str(cs) -> str:
+        return D.conf_state_config_str(cs)
 
     def handle_stabilize(self, d: TestData):
         restore_lvl = None

@@ -41,7 +41,8 @@ class LaneSnap:
         "id term vote state lead lead_transferee election_elapsed "
         "heartbeat_elapsed randomized_election_timeout committed applied "
         "applying last stabled snap_index snap_term pending_snap_index "
-        "pending_snap_term pending_conf_index uncommitted_size auto_leave "
+        "pending_snap_term avail_snap_index avail_snap_term "
+        "pending_conf_index uncommitted_size auto_leave "
         "is_learner"
     ).split()
     ROWS = (
@@ -140,16 +141,10 @@ class LaneSnap:
         def sel(mask):
             return sorted(int(i) for i, m in zip(ids, mask) if i and m)
 
-        class _C:
-            pass
-
-        c = _C()
-        c.voters_in = sel(self.voters_in)
-        c.voters_out = sel(self.voters_out)
-        c.learners = sel(self.learners)
-        c.learners_next = sel(self.learners_next)
-        c.auto_leave = bool(self.auto_leave)
-        return D.tracker_config_str(c)
+        return D.config_str(
+            sel(self.voters_in), sel(self.voters_out), sel(self.learners),
+            sel(self.learners_next), bool(self.auto_leave),
+        )
 
 
 class LogOracle:
@@ -159,7 +154,11 @@ class LogOracle:
         self.env = env
         self.batch = batch
 
-    def snapshot(self, lane: int) -> LaneSnap:
+    def snapshot(self, lane: int, force: bool = False) -> LaneSnap | None:
+        # Under `log-level none` every line would be filtered anyway; skip
+        # the two full host syncs per step (stabilize loops are hot).
+        if not force and self.env.output.quiet():
+            return None
         return LaneSnap(self.batch, lane)
 
     def logf(self, lvl: int, text: str):
@@ -167,9 +166,22 @@ class LogOracle:
 
     # ------------------------------------------------------------------
 
-    def after_step(self, lane: int, msg, pre: LaneSnap):
+    def after_step(self, lane: int, msg, pre: LaneSnap | None):
+        if pre is None or self.env.output.quiet():
+            return
         post = LaneSnap(self.batch, lane)
         self._step_lines(pre, post, msg)
+
+    def auto_leave_initiated(self, lane: int):
+        """reference: raft.go:741 (appliedTo's auto-leave proposal)."""
+        if self.env.output.quiet():
+            return
+        snap = self.snapshot(lane, force=True)
+        self.logf(
+            INFO,
+            f"initiating automatic transition out of joint configuration "
+            f"{snap.config_str()}",
+        )
 
     # The mirror of raft.Step's logging (reference: raft.go:1051-1221).
     def _step_lines(self, r: LaneSnap, post: LaneSnap, m):
@@ -320,6 +332,7 @@ class LogOracle:
         if mtype == int(MT.MSG_CHECK_QUORUM):
             if post.state == FOLLOWER:
                 logf(WARN, f"{r.id:x} stepped down to follower since quorum is not active")
+                logf(INFO, f"{r.id:x} became follower at term {r.term}")
             return
         if mtype == int(MT.MSG_PROP):
             if r.lead_transferee:
@@ -352,19 +365,31 @@ class LogOracle:
                     logf(
                         DEBUG,
                         f"{r.id:x} decreased progress of {m.frm:x} to "
-                        f"[{self._pr_str(post, j)}]",
+                        f"[{self._mid_pr_str(r, post, j, int(PS.PROBE))}]",
                     )
+                if j is not None:
+                    self._snapshot_send_lines(r, post, j, m.frm)
             else:
                 if (
                     j is not None
                     and r.pr_state[j] == int(PS.SNAPSHOT)
                     and post.pr_state[j] != int(PS.SNAPSHOT)
                 ):
+                    # logged with the pre-transition pr (raft.go:1482-1488):
+                    # still StateSnapshot, match/next already MaybeUpdate'd
+                    mid = progress_fields(r, j)
+                    mid.update(
+                        state_name=D.PROGRESS_STATE_NAMES[int(PS.SNAPSHOT)],
+                        match=max(int(r.pr_match[j]), m.index),
+                        next=max(int(r.pr_next[j]), m.index + 1),
+                        paused=True,
+                        pending_snapshot=int(r.pr_pending_snapshot[j]),
+                    )
                     logf(
                         DEBUG,
                         f"{r.id:x} recovered from needing snapshot, resumed "
                         f"sending replication messages to {m.frm:x} "
-                        f"[{self._pr_str(post, j)}]",
+                        f"[{D.progress_str(mid)}]",
                     )
                 if r.lead_transferee == m.frm and post.lead_transferee == m.frm:
                     logf(
@@ -372,6 +397,9 @@ class LogOracle:
                         f"{r.id:x} sent MsgTimeoutNow to {m.frm:x} after "
                         f"received MsgAppResp",
                     )
+        elif mtype == int(MT.MSG_HEARTBEAT_RESP):
+            if j is not None:
+                self._snapshot_send_lines(r, post, j, m.frm)
         elif mtype == int(MT.MSG_SNAP_STATUS):
             if j is None or r.pr_state[j] != int(PS.SNAPSHOT):
                 return
@@ -411,7 +439,9 @@ class LogOracle:
                 continue
             already_pending = r.pending_conf_index > r.applied
             already_joint = bool(np.any(r.voters_out & (r.prs_id != 0)))
-            cc2 = ccm.decode(e.data).as_v2()
+            cc2 = ccm.decode(
+                e.data, v1=int(e.type) == int(EntryType.ENTRY_CONF_CHANGE)
+            ).as_v2()
             wants_leave = not cc2.changes and cc2.transition == 0
             refused = ""
             if already_pending:
@@ -516,15 +546,16 @@ class LogOracle:
                 INFO,
                 f"{r.id:x} has received {gr} {rname} votes and {rj} vote rejections",
             )
-            q = len(r.voter_ids()) // 2 + 1
-            if gr >= q:
-                if state == PRE_CANDIDATE:
-                    self._campaign(r, post, CampaignType.ELECTION)
-                else:
-                    logf(INFO, f"{r.id:x} became leader at term {post.term}")
-            elif rj + gr == len(r.voter_ids()) and rj > 0 or post.state == FOLLOWER:
-                if post.state == FOLLOWER and post.term == term:
-                    logf(INFO, f"{r.id:x} became follower at term {term}")
+            # Win/loss is read off the kernel's observed transition rather
+            # than re-deriving quorum host-side — the reference uses the full
+            # joint-config VoteResult (raft.go:1651, quorum/joint.go:61-75),
+            # and the kernel is the source of truth for it.
+            if state == PRE_CANDIDATE and post.state == CANDIDATE:
+                self._campaign(r, post, CampaignType.ELECTION)  # prevote won
+            elif post.state == LEADER:
+                logf(INFO, f"{r.id:x} became leader at term {post.term}")
+            elif post.state == FOLLOWER and post.term == term:
+                logf(INFO, f"{r.id:x} became follower at term {term}")
         elif mtype == int(MT.MSG_TIMEOUT_NOW):
             logf(
                 DEBUG,
@@ -727,6 +758,44 @@ class LogOracle:
                 f"{sindex}, term: {sterm}]",
             )
 
+    def _snapshot_send_lines(self, r: LaneSnap, post: LaneSnap, j: int, to: int):
+        """maybeSendAppend's snapshot fallback DEBUG pair (raft.go:636-649),
+        detected from the Probe/Replicate -> Snapshot transition."""
+        if r.pr_state[j] == int(PS.SNAPSHOT) or post.pr_state[j] != int(PS.SNAPSHOT):
+            return
+        logf = self.logf
+        sindex = int(post.pr_pending_snapshot[j])
+        sterm = (
+            post.avail_snap_term
+            if post.avail_snap_index == sindex
+            else post.snap_term
+        )
+        logf(
+            DEBUG,
+            f"{r.id:x} [firstindex: {post.snap_index + 1}, commit: "
+            f"{post.committed}] sent snapshot[index: {sindex}, term: {sterm}] "
+            f"to {to:x} [{self._mid_pr_str(r, post, j, int(PS.PROBE))}]",
+        )
+        logf(
+            DEBUG,
+            f"{r.id:x} paused sending replication messages to {to:x} "
+            f"[{self._mid_pr_str(r, post, j, int(PS.SNAPSHOT))}]",
+        )
+
+    def _mid_pr_str(self, r: LaneSnap, post: LaneSnap, j: int, state: int) -> str:
+        """Progress string for mid-step states the kernel never materializes
+        (between MaybeDecrTo/BecomeSnapshot within one reference step)."""
+        mid = progress_fields(post, j)
+        mid["state_name"] = D.PROGRESS_STATE_NAMES[state]
+        if state == int(PS.SNAPSHOT):
+            mid["paused"] = True
+        else:
+            # MaybeDecrTo/BecomeProbe reset MsgAppFlowPaused before the line
+            # is logged (progress.go:111-121, 207-216)
+            mid["paused"] = False
+            mid["pending_snapshot"] = 0
+        return D.progress_str(mid)
+
     def _slot(self, r: LaneSnap, nid: int):
         for j in range(len(r.prs_id)):
             if int(r.prs_id[j]) == nid:
@@ -761,13 +830,4 @@ def progress_fields(snap: LaneSnap, j: int) -> dict:
 
 
 def _conf_from_snapshot(snap) -> str:
-    class _C:
-        pass
-
-    c = _C()
-    c.voters_in = sorted(snap.voters)
-    c.voters_out = sorted(snap.voters_outgoing)
-    c.learners = sorted(snap.learners)
-    c.learners_next = sorted(snap.learners_next)
-    c.auto_leave = snap.auto_leave
-    return D.tracker_config_str(c)
+    return D.conf_state_config_str(snap)

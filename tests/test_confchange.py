@@ -103,16 +103,21 @@ def test_restore_roundtrip():
 
 def test_encode_decode_roundtrip():
     v1 = ccm.ConfChange(type=int(ccm.ConfChangeType.ADD_NODE), node_id=7, context=b"ctx")
-    assert ccm.decode(ccm.encode(v1)) == v1
+    assert ccm.decode(ccm.encode(v1), v1=True) == v1
     v2 = ccm.ConfChangeV2(
         transition=int(ccm.ConfChangeTransition.JOINT_EXPLICIT),
-        changes=[
+        changes=(
             ccm.ConfChangeSingle(int(ccm.ConfChangeType.REMOVE_NODE), 1),
             ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_NODE), 4),
-        ],
+        ),
     )
-    assert ccm.decode(ccm.encode(v2)) == v2
+    assert ccm.decode(ccm.encode(v2), v1=False) == v2
     assert ccm.decode(b"").leave_joint()
+    # the wire encoding is the exact gogoproto format (raft.pb.go:1133-1231):
+    # an empty V2 marshals to just its transition field, an AddNode(2) v1 to
+    # the three always-written varint fields
+    assert ccm.encode(ccm.ConfChangeV2()) == b"\x08\x00"
+    assert ccm.encode(ccm.ConfChange(type=0, node_id=2)) == b"\x08\x00\x10\x00\x18\x02"
 
 
 # -- live scenarios through the facade -------------------------------------
@@ -147,7 +152,13 @@ def drive_apply(b, max_iters=60):
                     int(EntryType.ENTRY_CONF_CHANGE),
                     int(EntryType.ENTRY_CONF_CHANGE_V2),
                 ):
-                    cs = b.apply_conf_change(lane, ccm.decode(e.data))
+                    cs = b.apply_conf_change(
+                        lane,
+                        ccm.decode(
+                            e.data,
+                            v1=e.type == int(EntryType.ENTRY_CONF_CHANGE),
+                        ),
+                    )
                     states[lane] = cs
             b.advance(lane)
             for m in msgs:
