@@ -37,7 +37,14 @@ from raft_tpu.config import Shape
 from raft_tpu.messages import MsgBatch, empty_batch
 from raft_tpu.ops import step as stepmod
 from raft_tpu.state import LaneConfig, RaftState, init_state, make_lane_config
-from raft_tpu.types import EntryType, MessageType as MT, ProgressState, StateType
+from raft_tpu.types import (
+    LOCAL_APPEND_THREAD,
+    LOCAL_APPLY_THREAD,
+    EntryType,
+    MessageType as MT,
+    ProgressState,
+    StateType,
+)
 
 I32 = jnp.int32
 
@@ -81,11 +88,15 @@ class Message:
     log_term: int = 0
     index: int = 0
     commit: int = 0
+    vote: int = 0
     reject: bool = False
     reject_hint: int = 0
     context: int = 0
     entries: list = dataclasses.field(default_factory=list)
     snapshot: Snapshot | None = None
+    # async-storage-writes: messages to deliver once this message's work is
+    # done (reference: raftpb/raft.proto:104-107 Responses)
+    responses: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -311,6 +322,13 @@ class RawNodeBatch:
         self.view.refresh(self.state)
         self._msgs: list[list[Message]] = [[] for _ in range(n)]
         self._after_append: list[list[Message]] = [[] for _ in range(n)]
+        self._steps_on_advance: list[list[Message]] = [[] for _ in range(n)]
+        # async-storage-writes bookkeeping (reference: doc.go:172-258):
+        # _async gates the Ready shape; _inprog mirrors unstable
+        # offsetInProgress; _applying mirrors the accepted applying cursor
+        self._async = [False] * n
+        self._inprog = [0] * n
+        self._applying = [0] * n
         self._prev_hs = [HardState() for _ in range(n)]
         self._prev_ss = [SoftState() for _ in range(n)]
         self._read_states: list[list[ReadState]] = [[] for _ in range(n)]
@@ -395,9 +413,16 @@ class RawNodeBatch:
                         voters=self.peer_ids(lane, voters=True),
                         learners=self.peer_ids(lane, learners=True),
                     )
-            if slot == v or m.to == int(self.view.id[lane]):
-                # self-addressed (after-append acks, own ReadIndex responses):
-                # stepped at Advance, never surfaced in Ready.messages
+            # reference send() rule (raft.go:534-580): MsgAppResp/MsgVoteResp/
+            # MsgPreVoteResp — to ANY target — are predicated on unstable
+            # state and wait for the append to be durable (msgsAfterAppend);
+            # everything else is immediately sendable. Self-addressed
+            # non-response messages (own ReadIndex release) also wait.
+            if m.type in (
+                int(MT.MSG_APP_RESP),
+                int(MT.MSG_VOTE_RESP),
+                int(MT.MSG_PRE_VOTE_RESP),
+            ) or m.to == int(self.view.id[lane]):
                 self._after_append[lane].append(m)
             else:
                 self._msgs[lane].append(m)
@@ -407,15 +432,40 @@ class RawNodeBatch:
         pre = self.trace.snapshot(lane) if self.trace is not None else None
         old_last = int(self.view.last[lane])
         old_term = int(self.view.term[lane])
+        old_lt = old_stabled = None
+        if self._async[lane]:
+            old_lt = np.array(self.view.log_term[lane])
+            old_stabled = int(self.view.stabled[lane])
         inbox = self._inbox_one(lane, msg)
         self.state, out = self._step_fn(self.state, inbox)
         self.view.refresh(self.state)
+        if old_lt is not None:
+            self._rewind_inprog(lane, old_lt, old_stabled, old_last)
         # payloads first: fan-out messages emitted by this same step resolve
         # their entry bytes from the store
         self._store_accepted_payloads(lane, msg, old_last, old_term)
         if self.trace is not None:
             self.trace.after_step(lane, msg, pre)
         self._collect_out(out, src_msg=msg)
+
+    def _rewind_inprog(self, lane: int, old_lt, old_stabled: int, old_last: int):
+        """Mirror of unstable.truncateAndAppend's offsetInProgress rewind
+        (reference: log_unstable.go:196-234): entries handed to the storage
+        thread that were truncated/overwritten must be re-emitted in the next
+        Ready."""
+        w = self.shape.w
+        new_last = int(self.view.last[lane])
+        inprog = min(self._inprog[lane], new_last)
+        hi = min(inprog, old_last)
+        lt = self.view.log_term[lane]
+        # a conflicting append can also rewind the stable cursor itself, so
+        # scan from the smaller of the old/new stable points
+        lo = min(old_stabled, int(self.view.stabled[lane]))
+        for i in range(lo + 1, hi + 1):
+            if int(lt[i & (w - 1)]) != int(old_lt[i & (w - 1)]):
+                inprog = i - 1
+                break
+        self._inprog[lane] = inprog
 
     def _store_accepted_payloads(
         self, lane: int, msg: Message, old_last: int, old_term: int
@@ -451,7 +501,20 @@ class RawNodeBatch:
             int(MT.MSG_STORAGE_APPLY),
         ):
             raise ValueError(f"cannot step raft local message {msg.type}")
+        if msg.type == int(MT.MSG_STORAGE_APPLY_RESP) and msg.entries:
+            # the kernel's apply-ack convention: last applied index rides
+            # msg.index, applied payload bytes ride msg.commit
+            msg = dataclasses.replace(
+                msg,
+                index=msg.entries[-1].index,
+                commit=sum(len(e.data) for e in msg.entries),
+                entries=[],
+            )
         self._run_step(lane, msg)
+        # async mode: appliedTo may arm the auto-leave proposal
+        # (reference: raft.go:717-745); sync mode does this in advance()
+        if msg.type == int(MT.MSG_STORAGE_APPLY_RESP) and self._async[lane]:
+            self._maybe_auto_leave(lane)
         if msg.type == int(MT.MSG_SNAP) and msg.snapshot is not None:
             snap = msg.snapshot
             if int(self.view.pending_snap_index[lane]) == snap.index:
@@ -556,6 +619,8 @@ class RawNodeBatch:
 
     def ready(self, lane: int, peek: bool = False) -> Ready:
         v = self.view
+        nid = self.id_of(lane)
+        is_async = self._async[lane]
         rd = Ready()
         term, vote, commit = (
             int(v.term[lane]),
@@ -569,7 +634,12 @@ class RawNodeBatch:
         if ss != self._prev_ss[lane]:
             rd.soft_state = ss
         w = self.shape.w
-        for i in range(int(v.stabled[lane]) + 1, int(v.last[lane]) + 1):
+        last = int(v.last[lane])
+        stabled = int(v.stabled[lane])
+        # unstable entries not yet handed to storage (async: skip in-progress;
+        # reference log_unstable.go nextEntries/offsetInProgress)
+        ent_lo = max(stabled, min(self._inprog[lane], last)) if is_async else stabled
+        for i in range(ent_lo + 1, last + 1):
             t = int(v.log_term[lane, i & (w - 1)])
             etype, data = self.store.get(lane, i, t)
             rd.entries.append(Entry(t, i, int(v.log_type[lane, i & (w - 1)]), data))
@@ -580,10 +650,16 @@ class RawNodeBatch:
             rd.snapshot = snap if snap and snap.index == psi else Snapshot(
                 index=psi, term=int(v.pending_snap_term[lane])
             )
-        # committed entries (applied, committed], paginated by proto-encoding
-        # size with limitSize's never-empty rule (log.go:216-240, util.go:266)
+        # committed entries, paginated by proto-encoding size with limitSize's
+        # never-empty rule (log.go:216-240, util.go:266). Sync mode applies
+        # from `applied`; async applies from the accepted `applying` cursor
+        # and never applies unstable entries (rawnode.go applyUnstableEntries)
         budget = int(np.asarray(self.state.cfg.max_committed_size_per_ready[lane]))
-        lo, hi = int(v.applied[lane]) + 1, commit
+        if is_async:
+            lo = max(int(v.applied[lane]), self._applying[lane]) + 1
+            hi = min(commit, stabled)
+        else:
+            lo, hi = int(v.applied[lane]) + 1, commit
         if psi:
             hi = lo - 1  # snapshot must be applied first
         size = 0
@@ -595,7 +671,13 @@ class RawNodeBatch:
             if rd.committed_entries and size > budget:
                 break
             rd.committed_entries.append(ent)
-        rd.messages = list(self._msgs[lane])
+        aa = self._after_append[lane]
+        if is_async:
+            rd.messages = list(self._msgs[lane])
+        else:
+            # sync mode: msgsAfterAppend to others ride this Ready's Messages
+            # after r.msgs (reference: rawnode.go:177-186)
+            rd.messages = list(self._msgs[lane]) + [m for m in aa if m.to != nid]
         # drain the device-side ReadState ring (reference: raft.go:371)
         nrs = int(v.rs_count[lane])
         rd.read_states = [
@@ -608,6 +690,12 @@ class RawNodeBatch:
             or term != self._prev_hs[lane].term
             or vote != self._prev_hs[lane].vote
         )
+        if is_async:
+            # storage-thread messages (reference: rawnode.go:202-399)
+            if rd.entries or rd.hard_state or rd.snapshot or aa:
+                rd.messages.append(self._storage_append_msg(lane, rd, aa))
+            if rd.committed_entries:
+                rd.messages.append(self._storage_apply_msg(lane, rd))
         if not peek:
             # acceptReady (reference rawnode.go:404-440)
             if rd.hard_state:
@@ -616,6 +704,13 @@ class RawNodeBatch:
                 self._prev_ss[lane] = rd.soft_state
             self._msgs[lane] = []
             self._read_states[lane] = []
+            self._steps_on_advance[lane] = [m for m in aa if m.to == nid]
+            self._after_append[lane] = []
+            if is_async:
+                if rd.entries:
+                    self._inprog[lane] = rd.entries[-1].index
+                if rd.committed_entries:
+                    self._applying[lane] = rd.committed_entries[-1].index
             if nrs:
                 self.state = dataclasses.replace(
                     self.state, rs_count=self.state.rs_count.at[lane].set(0)
@@ -625,9 +720,71 @@ class RawNodeBatch:
             self._accepted[lane] = rd
         return rd
 
+    def _storage_append_msg(self, lane: int, rd: Ready, aa: list) -> Message:
+        """reference: rawnode.go:225-262 newStorageAppendMsg."""
+        v = self.view
+        nid = self.id_of(lane)
+        m = Message(
+            type=int(MT.MSG_STORAGE_APPEND),
+            to=LOCAL_APPEND_THREAD,
+            frm=nid,
+            entries=list(rd.entries),
+        )
+        if rd.hard_state:
+            m.term = rd.hard_state.term
+            m.vote = rd.hard_state.vote
+            m.commit = rd.hard_state.commit
+        if rd.snapshot:
+            m.snapshot = rd.snapshot
+        m.responses = list(aa)
+        last, stabled = int(v.last[lane]), int(v.stabled[lane])
+        if last > stabled or rd.snapshot:
+            # newStorageAppendRespMsg (rawnode.go:264-365): attests the full
+            # unstable (index, term) with the ABA term guard
+            resp = Message(
+                type=int(MT.MSG_STORAGE_APPEND_RESP),
+                to=nid,
+                frm=LOCAL_APPEND_THREAD,
+                term=int(v.term[lane]),
+            )
+            if last > stabled:
+                resp.index = last
+                resp.log_term = int(v.log_term[lane, last & (self.shape.w - 1)])
+            if rd.snapshot:
+                resp.snapshot = rd.snapshot
+            m.responses.append(resp)
+        return m
+
+    def _storage_apply_msg(self, lane: int, rd: Ready) -> Message:
+        """reference: rawnode.go:374-399 newStorageApplyMsg."""
+        nid = self.id_of(lane)
+        ents = list(rd.committed_entries)
+        return Message(
+            type=int(MT.MSG_STORAGE_APPLY),
+            to=LOCAL_APPLY_THREAD,
+            frm=nid,
+            entries=ents,
+            responses=[
+                Message(
+                    type=int(MT.MSG_STORAGE_APPLY_RESP),
+                    to=nid,
+                    frm=LOCAL_APPLY_THREAD,
+                    entries=ents,
+                )
+            ],
+        )
+
+    def set_async_storage_writes(self, lane: int, on: bool = True):
+        """reference: raft.go:160-185 Config.AsyncStorageWrites."""
+        self._async[lane] = on
+
     def advance(self, lane: int):
         """reference: rawnode.go:479-491 — ack storage, then deliver the
         after-append self-messages."""
+        if self._async[lane]:
+            raise RuntimeError(
+                "Advance must not be called when using AsyncStorageWrites"
+            )
         rd = getattr(self, "_accepted", {}).pop(lane, None)
         if rd is None:
             return
@@ -665,12 +822,15 @@ class RawNodeBatch:
                     commit=nbytes,
                 ),
             )
-        pending = self._after_append[lane]
-        self._after_append[lane] = []
+        pending = self._steps_on_advance[lane]
+        self._steps_on_advance[lane] = []
         for m in pending:
             self._run_step(lane, m)
-        # auto-leave: leader proposes the empty V2 leave once the joint entry
-        # is applied (reference: raft.go:717-745 appliedTo)
+        self._maybe_auto_leave(lane)
+
+    def _maybe_auto_leave(self, lane: int):
+        """Leader proposes the empty V2 leave once the joint entry is applied
+        (reference: raft.go:717-745 appliedTo)."""
         v = self.view
         if (
             bool(v.auto_leave[lane])

@@ -70,6 +70,13 @@ class EnvNode:
     append_work: list = dataclasses.field(default_factory=list)
     apply_work: list = dataclasses.field(default_factory=list)
     history: list = dataclasses.field(default_factory=list)
+    # The node's MemoryStorage equivalent (reference: storage.go:98-310).
+    # Persisted when a Ready (sync) or the append thread (async) processes
+    # the write — which can trail or *lead* the engine's stable cursor, so
+    # the device log is not a substitute (e.g. the append-ABA race).
+    storage: dict = dataclasses.field(default_factory=dict)  # index -> Entry
+    storage_first: int = 1
+    storage_last: int = 0
 
 
 class InteractionEnv:
@@ -228,7 +235,10 @@ class InteractionEnv:
         else:
             self.output.logf(INFO, f"{nid} switched to configuration voters=()")
         b.set_app_snapshot(lane, snap)
+        b.set_async_storage_writes(lane, node.async_storage)
         node.history.append(snap)
+        node.storage_first = i + 1
+        node.storage_last = i
         # reference: rawnode.go:51-66 — NewRawNode seeds prevHardSt/prevSoftSt
         # from the restored state, so boot state never surfaces in a Ready
         b._prev_hs[lane] = HardState(term=0, vote=0, commit=i)
@@ -385,27 +395,25 @@ class InteractionEnv:
 
     def handle_compact(self, d: TestData):
         idx = self._first_idx(d)
+        node = self.nodes[idx]
         new_first = int(d.cmd_args[1].key)
-        self.batch.compact(self.nodes[idx].lane, new_first)
+        self.batch.compact(node.lane, new_first)
+        for i in [i for i in node.storage if i <= new_first]:
+            del node.storage[i]
+        node.storage_first = max(node.storage_first, new_first + 1)
         return self._raft_log(idx)
 
     def handle_raft_log(self, d: TestData):
         return self._raft_log(self._first_idx(d))
 
     def _raft_log(self, idx: int):
-        lane = self.nodes[idx].lane
-        v = self.batch.view
-        fi = int(v.snap_index[lane]) + 1
-        li = int(v.stabled[lane])  # storage == stable prefix
+        node = self.nodes[idx]
+        fi, li = node.storage_first, node.storage_last
         if li < fi:
             self.output.write(f"log is empty: first index={fi}, last index={li}")
             return
-        w = self.batch.shape.w
-        ents = []
-        for i in range(fi, li + 1):
-            t = int(v.log_term[lane, i & (w - 1)])
-            etype, data = self.batch.store.get(lane, i, t)
-            ents.append(Entry(t, i, int(v.log_type[lane, i & (w - 1)]), data))
+        # a hole here is a storage-model bug; MemoryStorage would panic
+        ents = [node.storage[i] for i in range(fi, li + 1)]
         self.output.write(D.describe_entries(ents))
 
     # -- state introspection -----------------------------------------------
@@ -490,7 +498,10 @@ class InteractionEnv:
                 # peers absent from the config are refused
                 from raft_tpu.types import RESPONSE_MSGS
 
-                if m.type in {int(x) for x in RESPONSE_MSGS}:
+                if m.type in {int(x) for x in RESPONSE_MSGS} and m.frm not in (
+                    D.LOCAL_APPEND_THREAD,
+                    D.LOCAL_APPLY_THREAD,
+                ):
                     v = self.batch.view
                     known = any(
                         int(v.prs_id[lane, j]) == m.frm
@@ -523,12 +534,38 @@ class InteractionEnv:
         rd = b.ready(node.lane)
         self.output.write(D.describe_ready(rd))
         if node.async_storage:
-            raise NotImplementedError("async-storage-writes harness mode")
+            # reference: process_ready.go:60-77 — route storage messages to
+            # the append/apply work queues; no Advance
+            for m in rd.messages:
+                if m.to == D.LOCAL_APPEND_THREAD:
+                    node.append_work.append(m)
+                elif m.to == D.LOCAL_APPLY_THREAD:
+                    node.apply_work.append(m)
+                else:
+                    self.messages.append(m)
+            return None
+        self._persist_append(node, rd.entries, rd.snapshot)
         self._process_apply(node, rd.committed_entries)
         for m in rd.messages:
             self.messages.append(m)
         b.advance(node.lane)
         return None
+
+    @staticmethod
+    def _persist_append(node: EnvNode, entries, snapshot):
+        """MemoryStorage.ApplySnapshot/Append semantics (reference:
+        storage.go:207-310 via rafttest processAppend)."""
+        if snapshot is not None and snapshot.index:
+            node.storage.clear()
+            node.storage_first = snapshot.index + 1
+            node.storage_last = snapshot.index
+        if entries:
+            first = entries[0].index
+            for i in [i for i in node.storage if i >= first]:
+                del node.storage[i]
+            for e in entries:
+                node.storage[e.index] = e
+            node.storage_last = entries[-1].index
 
     def _process_apply(self, node: EnvNode, ents):
         """reference: interaction_env_handler_process_apply_thread.go:71-111
@@ -669,10 +706,39 @@ class InteractionEnv:
                 self._process_apply_thread(idx)
 
     def _process_append_thread(self, idx: int):
-        raise NotImplementedError("async-storage-writes harness mode")
+        """reference: interaction_env_handler_process_append_thread.go:27-57.
+        Entry payloads already live in the host store, so "persisting" is a
+        no-op here; durability is modeled by when the MsgStorageAppendResp is
+        delivered back (that is what moves the device's stable cursor)."""
+        node = self.nodes[idx]
+        if not node.append_work:
+            self.output.write("no append work to perform\n")
+            return
+        m = node.append_work.pop(0)
+        resps = m.responses
+        shown = dataclasses.replace(m, responses=[])
+        self.output.write("Processing:\n" + D.describe_message(shown) + "\n")
+        self._persist_append(node, m.entries, m.snapshot)
+        self.output.write("Responses:\n")
+        for r in resps:
+            self.output.write(D.describe_message(r) + "\n")
+        self.messages.extend(resps)
 
     def _process_apply_thread(self, idx: int):
-        raise NotImplementedError("async-storage-writes harness mode")
+        """reference: interaction_env_handler_process_apply_thread.go:27-66."""
+        node = self.nodes[idx]
+        if not node.apply_work:
+            self.output.write("no apply work to perform\n")
+            return
+        m = node.apply_work.pop(0)
+        resps = m.responses
+        shown = dataclasses.replace(m, responses=[])
+        self.output.write("Processing:\n" + D.describe_message(shown) + "\n")
+        self._process_apply(node, m.entries)
+        self.output.write("Responses:\n")
+        for r in resps:
+            self.output.write(D.describe_message(r) + "\n")
+        self.messages.extend(resps)
 
     # -- indent ------------------------------------------------------------
 

@@ -239,13 +239,14 @@ class LogOracle:
                 )
                 return
             if mtype == int(MT.MSG_STORAGE_APPEND_RESP):
-                if m.snapshot is not None:
+                if m.index:
                     logf(
                         INFO,
                         f"{r.id:x} [term: {r.term}] ignored entry appends from a "
                         f"{mname} message with lower term [term: {m.term}]",
                     )
                 # snapshot acks at lower term still apply (raft.go:1121-1133)
+                return
             else:
                 logf(
                     INFO,
@@ -277,12 +278,37 @@ class LogOracle:
                     f"vote: {vote:x}] rejected {mname} from {m.frm:x} "
                     f"[logterm: {m.log_term}, index: {m.index}] at term {term}",
                 )
+        elif mtype == int(MT.MSG_STORAGE_APPEND_RESP):
+            if m.index:
+                self._stable_to_lines(r, m)
         elif state == LEADER:
             self._step_leader(r, post, m, mname, term)
         elif state in (CANDIDATE, PRE_CANDIDATE):
             self._step_candidate(r, post, m, mname, term, state)
         else:
             self._step_follower(r, post, m, mname, term, lead)
+
+    def _stable_to_lines(self, r: LaneSnap, m):
+        """unstable.stableTo's ignore cases (log_unstable.go:134-160)."""
+        logf = self.logf
+        offset = r.stabled + 1
+        if m.index < offset and m.index == r.pending_snap_index:
+            logf(
+                INFO,
+                f"entry at index {m.index} matched unstable snapshot; ignoring",
+            )
+        elif m.index < offset or m.index > r.last:
+            logf(
+                INFO,
+                f"entry at index {m.index} missing from unstable log; ignoring",
+            )
+        elif r.term_at(m.index) != m.log_term:
+            logf(
+                INFO,
+                f"entry at (index,term)=({m.index},{m.log_term}) mismatched "
+                f"with entry at ({m.index},{r.term_at(m.index)}) in unstable "
+                f"log; ignoring",
+            )
 
     # ------------------------------------------------------------------
 

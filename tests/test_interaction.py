@@ -17,9 +17,10 @@ import pytest
 REF_TESTDATA = "/root/reference/testdata"
 
 # Files currently expected to pass bit-identically.
-# All 27 reference scripts except the two async-storage-writes ones (the
-# async harness mode is still to be built).
+# All 27 reference interaction scripts.
 ENABLED = [
+    "async_storage_writes.txt",
+    "async_storage_writes_append_aba_race.txt",
     "campaign.txt",
     "campaign_learner_must_vote.txt",
     "checkquorum.txt",
