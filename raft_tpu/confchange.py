@@ -391,7 +391,7 @@ class Changer:
             cfg.learners.add(nid)
         trk[nid] = Progress(
             match=0,
-            next=max(self.last_index, 1),
+            next=self.last_index,
             is_learner=is_learner,
             # RecentActive so CheckQuorum doesn't immediately depose us
             # (reference: confchange.go:264-268)
@@ -420,7 +420,7 @@ class Changer:
                 raise ConfChangeError(f"{nid} is in Learners, but is not marked as learner")
         if not cfg.joint:
             if cfg.learners_next:
-                raise ConfChangeError("LearnersNext must be empty when not joint")
+                raise ConfChangeError("cfg.LearnersNext must be nil when not joint")
             if cfg.auto_leave:
                 raise ConfChangeError("AutoLeave must be false when not joint")
 

@@ -1,0 +1,460 @@
+"""Raft-paper clause tests over the batched engine — the tier-2 suite
+(reference: raft_paper_test.go, which mirrors §5 of the Raft paper
+clause-by-clause). Re-derived against the same scenarios, driven through
+RawNodeBatch + SyncNetwork instead of the Go network fixture."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.api.rawnode import Entry, Message, RawNodeBatch
+from raft_tpu.config import Shape
+from raft_tpu.testing.network import SyncNetwork
+from raft_tpu.types import MessageType as MT, StateType as ST
+
+I32 = np.int32
+
+
+def make_batch(n=3, election_tick=10, heartbeat_tick=1, **overrides) -> RawNodeBatch:
+    ids = list(range(1, n + 1))
+    peers = np.zeros((n, 8), I32)
+    for lane in range(n):
+        peers[lane, :n] = ids
+    return RawNodeBatch(
+        Shape(n_lanes=n), ids=ids, peers=peers,
+        election_tick=election_tick, heartbeat_tick=heartbeat_tick, **overrides,
+    )
+
+
+def set_lane(b: RawNodeBatch, lane: int, **fields):
+    st = b.state
+    upd = {k: getattr(st, k).at[lane].set(v) for k, v in fields.items()}
+    b.state = dataclasses.replace(st, **upd)
+    b.view.refresh(b.state)
+
+
+def set_log(b: RawNodeBatch, lane: int, terms: list[int], committed=0, stable=True):
+    """Install a log with the given per-entry terms (index 1..len)."""
+    w = b.shape.w
+    row = np.zeros((w,), I32)
+    for i, t in enumerate(terms, start=1):
+        row[i & (w - 1)] = t
+        b.store.put(lane, Entry(term=t, index=i, data=b""))
+    last = len(terms)
+    set_lane(
+        b, lane,
+        log_term=jnp.asarray(row),
+        last=last,
+        stabled=last if stable else 0,
+        committed=committed,
+        applying=committed,
+        applied=committed,
+    )
+    b._prev_hs[lane] = dataclasses.replace(b._prev_hs[lane], commit=committed)
+
+
+def log_terms(b: RawNodeBatch, lane: int) -> list[int]:
+    v = b.view
+    w = b.shape.w
+    return [int(v.log_term[lane, i & (w - 1)]) for i in range(1, int(v.last[lane]) + 1)]
+
+
+def state_of(b, lane):
+    return int(b.view.state[lane])
+
+
+# --------------------------------------------------------------------- §5.1
+
+
+@pytest.mark.parametrize("role", ["follower", "candidate", "leader"])
+def test_update_term_from_message(role):
+    """reference: raft_paper_test.go:36-72 — any message with a higher term
+    makes the node a follower at that term."""
+    b = make_batch()
+    net = SyncNetwork(b)
+    if role in ("candidate", "leader"):
+        b.campaign(0)
+        if role == "leader":
+            net.send([])
+    b.step(0, Message(type=int(MT.MSG_APP), to=1, frm=2, term=42))
+    assert state_of(b, 0) == int(ST.FOLLOWER)
+    assert int(b.view.term[0]) == 42
+
+
+def test_start_as_follower():
+    """reference: raft_paper_test.go:77-83."""
+    b = make_batch()
+    assert state_of(b, 0) == int(ST.FOLLOWER)
+
+
+def test_leader_bcast_beat():
+    """reference: raft_paper_test.go:87-119 — leader sends MsgHeartbeat to
+    every peer on MsgBeat, regardless of pending entries."""
+    b = make_batch(election_tick=10, heartbeat_tick=1)
+    net = SyncNetwork(b)
+    b.campaign(0)
+    net.send([])
+    for _ in range(2):
+        b.propose(0, b"x")
+    b.ready(0)
+    b.advance(0)
+    b.tick(0)  # heartbeat_tick=1 -> MsgBeat
+    rd = b.ready(0)
+    hb = [m for m in rd.messages if m.type == int(MT.MSG_HEARTBEAT)]
+    assert sorted(m.to for m in hb) == [2, 3]
+
+
+# --------------------------------------------------------------------- §5.2
+
+
+@pytest.mark.parametrize("role", ["follower", "candidate"])
+def test_nonleader_start_election(role):
+    """reference: raft_paper_test.go:126-159 — after election timeout a
+    (pre)candidate increments its term and requests votes from all peers."""
+    b = make_batch(election_tick=3)
+    if role == "candidate":
+        b.campaign(0)
+        b.ready(0)
+        b.advance(0)
+    set_lane(b, 0, randomized_election_timeout=3)
+    for _ in range(3):
+        b.tick(0)
+    assert state_of(b, 0) == int(ST.CANDIDATE)
+    term = int(b.view.term[0])
+    assert term == (1 if role == "follower" else 2)
+    rd = b.ready(0)
+    votes = [m for m in rd.messages if m.type == int(MT.MSG_VOTE)]
+    assert sorted(m.to for m in votes) == [2, 3]
+    assert all(m.term == term for m in votes)
+
+
+@pytest.mark.parametrize(
+    "n,grants,expect_leader",
+    [(1, 0, True), (3, 1, True), (3, 0, False), (5, 2, True), (5, 1, False)],
+)
+def test_leader_election_in_one_round_rpc(n, grants, expect_leader):
+    """reference: raft_paper_test.go:163-211 — candidate becomes leader iff
+    it gets a majority (counting its own vote) in one round."""
+    b = make_batch(n=n)
+    b.campaign(0)
+    b.ready(0)
+    b.advance(0)  # counts the self-vote
+    for peer in range(2, 2 + grants):
+        b.step(0, Message(type=int(MT.MSG_VOTE_RESP), to=1, frm=peer, term=1))
+    got = state_of(b, 0) == int(ST.LEADER)
+    assert got == expect_leader
+
+
+def test_follower_vote():
+    """reference: raft_paper_test.go:215-255 — a follower grants at most one
+    vote per term, repeat votes for the same candidate allowed."""
+    # (self-nominee rows of the reference table are exercised implicitly by
+    # every election test; here node 1 votes on requests from peers 2/3)
+    for vote, nominee, wrej in [
+        (0, 2, False), (0, 3, False),
+        (2, 2, False), (3, 3, False),
+        (2, 3, True), (3, 2, True),
+    ]:
+        b = make_batch()
+        set_lane(b, 0, term=1, vote=vote)
+        b.step(0, Message(type=int(MT.MSG_VOTE), to=1, frm=nominee, term=1))
+        rd = b.ready(0)
+        b.advance(0)
+        resp = [m for m in rd.messages if m.type == int(MT.MSG_VOTE_RESP)]
+        assert len(resp) == 1, (vote, nominee)
+        assert resp[0].reject == wrej, (vote, nominee)
+
+
+def test_candidate_fallback():
+    """reference: raft_paper_test.go:260-292 — a candidate that sees a
+    MsgApp at >= its term reverts to follower."""
+    for term in (1, 2):
+        b = make_batch()
+        b.campaign(0)  # candidate at term 1
+        b.step(0, Message(type=int(MT.MSG_APP), to=1, frm=2, term=term))
+        assert state_of(b, 0) == int(ST.FOLLOWER)
+        assert int(b.view.term[0]) == term
+        assert int(b.view.lead[0]) == 2
+
+
+def test_election_timeout_randomized():
+    """reference: raft_paper_test.go:297-320 — the effective timeout is
+    sampled from [electiontimeout, 2*electiontimeout)."""
+    b = make_batch(election_tick=10)
+    seen = set()
+    for round_ in range(40):
+        set_lane(
+            b, 0,
+            state=int(ST.FOLLOWER), term=round_ + 1, lead=0,
+            election_elapsed=0,
+        )
+        # force a resample via becomeFollower on a higher-term message
+        b.step(0, Message(type=int(MT.MSG_APP), to=1, frm=2, term=round_ + 2))
+        t = int(b.view.randomized_election_timeout[0])
+        assert 10 <= t < 20
+        seen.add(t)
+    assert len(seen) > 5  # actually randomized
+
+
+# --------------------------------------------------------------------- §5.3
+
+
+def test_leader_start_replication():
+    """reference: raft_paper_test.go:351-389 — accepted proposals are
+    appended and broadcast as MsgApp to every follower."""
+    b = make_batch()
+    net = SyncNetwork(b)
+    b.campaign(0)
+    net.send([])
+    li = int(b.view.last[0])
+    b.propose(0, b"some data")
+    rd = b.ready(0)
+    apps = [m for m in rd.messages if m.type == int(MT.MSG_APP)]
+    assert sorted(m.to for m in apps) == [2, 3]
+    for m in apps:
+        assert m.index == li and m.log_term == 1
+        assert [e.data for e in m.entries] == [b"some data"]
+    assert int(b.view.last[0]) == li + 1
+
+
+def test_leader_commit_entry():
+    """reference: raft_paper_test.go:394-425 — entry committed once
+    replicated on a majority; commit index broadcast to followers."""
+    b = make_batch()
+    net = SyncNetwork(b)
+    b.campaign(0)
+    net.send([])
+    li = int(b.view.last[0])
+    b.propose(0, b"some data")
+    net.send([])
+    assert int(b.view.committed[0]) == li + 1
+    # every follower learned the commit and applied the entry
+    for lane in (1, 2):
+        assert int(b.view.committed[lane]) == li + 1
+
+
+def test_leader_acknowledge_commit():
+    """reference: raft_paper_test.go:430-460 — commit requires a quorum of
+    acks (self counts)."""
+    cases = [
+        (1, [], True),
+        (3, [], False),
+        (3, [2], True),
+        (5, [], False),
+        (5, [2], False),
+        (5, [2, 3], True),
+    ]
+    for n, ackers, committed in cases:
+        b = make_batch(n=n)
+        # messages are delivered by hand here (ready() output is discarded),
+        # so followers never see the MsgApps
+        b.campaign(0)
+        # collect votes so the candidate becomes leader
+        for peer in range(2, n // 2 + 2):
+            b.step(0, Message(type=int(MT.MSG_VOTE_RESP), to=1, frm=peer, term=1))
+        b.ready(0)
+        b.advance(0)
+        li = int(b.view.last[0])
+        b.propose(0, b"some data")
+        b.ready(0)
+        b.advance(0)
+        for peer in ackers:
+            b.step(
+                0,
+                Message(
+                    type=int(MT.MSG_APP_RESP), to=1, frm=peer, term=1, index=li + 1
+                ),
+            )
+        assert (int(b.view.committed[0]) > li) == committed, (n, ackers)
+
+
+def test_leader_only_commits_log_from_current_term():
+    """reference: raft_paper_test.go:871-940 (§5.4.2) — entries from prior
+    terms are only committed once an entry of the current term commits."""
+    ents = [1, 2]  # terms of entries 1..2
+    for index, committed in [(1, 0), (2, 0), (3, 3)]:
+        b = make_batch()
+        for lane in range(3):
+            set_log(b, lane, ents)
+        set_lane(b, 0, term=2)
+        # become leader at term 3 without network traffic
+        b.campaign(0)
+        b.ready(0)
+        b.advance(0)
+        b.step(0, Message(type=int(MT.MSG_VOTE_RESP), to=1, frm=2, term=3))
+        b.ready(0)
+        b.advance(0)
+        assert state_of(b, 0) == int(ST.LEADER)
+        # ack up to `index`
+        b.step(
+            0,
+            Message(type=int(MT.MSG_APP_RESP), to=1, frm=2, term=3, index=index),
+        )
+        assert int(b.view.committed[0]) == committed, index
+
+
+def test_follower_commit_entry():
+    """reference: raft_paper_test.go:464-517 — follower commits min(leader
+    commit, last new entry)."""
+    for ents, commit in [
+        ([(1, b"some data")], 1),
+        ([(1, b"some data"), (1, b"some data2")], 2),
+        ([(1, b"some data2"), (1, b"some data")], 2),
+        ([(1, b"some data"), (1, b"some data2")], 1),
+    ]:
+        b = make_batch()
+        entries = [
+            Entry(term=t, index=i + 1, data=d) for i, (t, d) in enumerate(ents)
+        ]
+        b.step(
+            0,
+            Message(
+                type=int(MT.MSG_APP), to=1, frm=2, term=1, commit=commit,
+                entries=entries,
+            ),
+        )
+        assert int(b.view.committed[0]) == commit
+        assert log_terms(b, 0)[:commit] == [t for t, _ in ents][:commit]
+
+
+def test_follower_check_msg_app():
+    """reference: raft_paper_test.go:522-563 — follower rejects MsgApp whose
+    (prev term, prev index) is not in its log, with a hint."""
+    ents = [1, 2]  # follower log terms at index 1, 2
+    cases = [
+        (0, 0, False, 0),   # empty prev matches
+        (1, 1, False, 0),   # prev at (1,1) matches
+        (2, 2, False, 0),   # prev at (2,2) matches
+        (1, 2, True, 1),    # term mismatch at 2 (hint: index 1)
+        (3, 3, True, 2),    # unknown index (hint: last=2)
+    ]
+    for log_term, index, wreject, hint in cases:
+        b = make_batch()
+        set_log(b, 0, ents, committed=1)
+        set_lane(b, 0, term=2)
+        b.step(
+            0,
+            Message(
+                type=int(MT.MSG_APP), to=1, frm=2, term=2,
+                log_term=log_term, index=index,
+            ),
+        )
+        rd = b.ready(0)
+        b.advance(0)
+        resp = [m for m in rd.messages if m.type == int(MT.MSG_APP_RESP)]
+        assert len(resp) == 1
+        assert resp[0].reject == wreject, (log_term, index)
+        if wreject:
+            assert resp[0].reject_hint == hint, (log_term, index)
+
+
+def test_follower_append_entries():
+    """reference: raft_paper_test.go:568-618 — conflicting entries are
+    truncated and replaced."""
+    base = [1, 2]  # index 1 term 1, index 2 term 2
+    cases = [
+        # (prev_index, prev_term, entries(term@index), want_terms)
+        (2, 2, [(3, 3)], [1, 2, 3]),
+        (1, 1, [(3, 2), (4, 3)], [1, 3, 4]),
+        (0, 0, [(1, 1)], [1, 2]),
+        (0, 0, [(3, 1)], [3]),
+    ]
+    for prev_i, prev_t, ents, want in cases:
+        b = make_batch()
+        set_log(b, 0, base)
+        entries = [
+            Entry(term=t, index=prev_i + 1 + k, data=b"")
+            for k, (t, _) in enumerate(ents)
+        ]
+        b.step(
+            0,
+            Message(
+                type=int(MT.MSG_APP), to=1, frm=2, term=2,
+                log_term=prev_t, index=prev_i, entries=entries,
+            ),
+        )
+        assert log_terms(b, 0) == want, (prev_i, prev_t, ents)
+
+
+def test_leader_sync_follower_log():
+    """reference: raft_paper_test.go:700-780 — figure 7 of the paper: a new
+    leader brings every divergent follower log in sync with its own."""
+    leader_log = [1, 1, 1, 4, 4, 5, 5, 6, 6, 6]
+    followers = [
+        [1, 1, 1, 4, 4, 5, 5, 6, 6],                    # (a) missing tail
+        [1, 1, 1, 4],                                   # (b) far behind
+        [1, 1, 1, 4, 4, 5, 5, 6, 6, 6, 6],              # (c) extra entry
+        [1, 1, 1, 4, 4, 5, 5, 6, 6, 6, 7, 7],           # (d) extra terms
+        [1, 1, 1, 4, 4, 4, 4],                          # (e) diverged
+        [1, 1, 1, 2, 2, 2, 3, 3, 3, 3, 3],              # (f) diverged
+    ]
+    for fl in followers:
+        b = make_batch(n=3)
+        set_log(b, 0, leader_log, committed=len(leader_log))
+        set_lane(b, 0, term=8)
+        set_log(b, 1, fl)
+        set_lane(b, 1, term=8 if max(fl) <= 8 else max(fl))
+        set_log(b, 2, leader_log, committed=len(leader_log))
+        set_lane(b, 2, term=8)
+        net = SyncNetwork(b)
+        b.campaign(0)
+        net.send([])
+        assert state_of(b, 0) == int(ST.LEADER), fl
+        want = leader_log + [9]  # leader appends its empty term-9 entry
+        assert log_terms(b, 0) == want, fl
+        assert log_terms(b, 1) == want, fl
+
+
+def test_vote_request():
+    """reference: raft_paper_test.go:784-846 — campaign sends MsgVote with
+    the candidate's last (index, term) to every peer."""
+    for log, wterm in [([1], 2), ([1, 2], 3)]:
+        b = make_batch()
+        set_log(b, 0, log)
+        set_lane(b, 0, term=wterm - 1)
+        set_lane(b, 0, randomized_election_timeout=10)
+        for _ in range(10):
+            b.tick(0)
+        rd = b.ready(0)
+        votes = [m for m in rd.messages if m.type == int(MT.MSG_VOTE)]
+        assert sorted(m.to for m in votes) == [2, 3]
+        for m in votes:
+            assert m.term == wterm
+            assert m.index == len(log) and m.log_term == log[-1]
+
+
+def test_voter():
+    """reference: raft_paper_test.go:850-886 — the up-to-date check: grant
+    iff the candidate's log is at least as complete."""
+    cases = [
+        # (voter log, cand last_term, cand last_index, reject)
+        ([1], 1, 1, False),
+        ([1], 1, 2, False),
+        ([1, 1], 1, 1, True),
+        ([1], 2, 1, False),
+        ([1], 2, 2, False),
+        ([1, 1], 2, 1, False),
+        ([2], 1, 1, True),
+        ([2], 1, 2, True),
+        ([2, 2], 1, 1, True),
+        ([2, 1], 1, 1, True),
+        ([1], 3, 3, False),
+    ]
+    for log, lt, li, wreject in cases:
+        b = make_batch()
+        set_log(b, 0, log)
+        b.step(
+            0,
+            Message(
+                type=int(MT.MSG_VOTE), to=1, frm=2, term=3, log_term=lt, index=li
+            ),
+        )
+        rd = b.ready(0)
+        b.advance(0)
+        resp = [m for m in rd.messages if m.type == int(MT.MSG_VOTE_RESP)]
+        assert len(resp) == 1, (log, lt, li)
+        assert resp[0].reject == wreject, (log, lt, li)

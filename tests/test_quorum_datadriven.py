@@ -1,0 +1,212 @@
+"""Quorum datadriven conformance: replay the reference's quorum/testdata
+scripts (reference: quorum/datadriven_test.go:36-250) against the batched
+quorum kernels, byte-for-byte — including the driver's embedded cross-checks
+(alternative computation, zero/self-joint, symmetry, overlay), which only
+print when an implementation diverges."""
+
+from __future__ import annotations
+
+import difflib
+import os
+
+import numpy as np
+import pytest
+
+REF_TESTDATA = "/root/reference/quorum/testdata"
+V = 16  # slot capacity; scripts use at most ~6 distinct voters
+
+from raft_tpu.ops import quorum as Q  # noqa: E402
+from raft_tpu.types import VoteResult, VoteState  # noqa: E402
+
+INF = int(Q.COMMITTED_INF)
+
+VOTE_NAMES = {
+    int(VoteResult.VOTE_PENDING): "VotePending",
+    int(VoteResult.VOTE_LOST): "VoteLost",
+    int(VoteResult.VOTE_WON): "VoteWon",
+}
+
+
+def idx_str(i: int) -> str:
+    return "∞" if i == INF else str(i)
+
+
+def committed(acked: dict, ids: set) -> int:
+    """MajorityConfig.CommittedIndex via the batched kernel."""
+    match = np.zeros((V,), np.int32)
+    mask = np.zeros((V,), bool)
+    for slot, nid in enumerate(sorted(ids)):
+        mask[slot] = True
+        match[slot] = acked.get(nid, 0)
+    return int(Q.majority_committed(match, mask))
+
+
+def joint_committed(acked: dict, ids: set, idsj: set) -> int:
+    match = np.zeros((V,), np.int32)
+    m1 = np.zeros((V,), bool)
+    m2 = np.zeros((V,), bool)
+    for slot, nid in enumerate(sorted(ids | idsj)):
+        match[slot] = acked.get(nid, 0)
+        m1[slot] = nid in ids
+        m2[slot] = nid in idsj
+    return int(Q.joint_committed(match, m1, m2))
+
+
+def vote_result(votes: dict, ids: set) -> int:
+    vs = np.zeros((V,), np.int32)
+    mask = np.zeros((V,), bool)
+    for slot, nid in enumerate(sorted(ids)):
+        mask[slot] = True
+        vs[slot] = votes.get(nid, int(VoteState.PENDING))
+    return int(Q.majority_vote(vs, mask))
+
+
+def joint_vote_result(votes: dict, ids: set, idsj: set) -> int:
+    vs = np.zeros((V,), np.int32)
+    m1 = np.zeros((V,), bool)
+    m2 = np.zeros((V,), bool)
+    for slot, nid in enumerate(sorted(ids | idsj)):
+        vs[slot] = votes.get(nid, int(VoteState.PENDING))
+        m1[slot] = nid in ids
+        m2[slot] = nid in idsj
+    return int(Q.joint_vote(vs, m1, m2))
+
+
+def alternative_committed(acked: dict, ids: set) -> int:
+    """The reference's 'dumb' implementation (quorum/quick_test.go:85)."""
+    if not ids:
+        return INF
+    q = len(ids) // 2 + 1
+    best = 0
+    for k in set(acked.get(i, 0) for i in ids) | {0}:
+        if sum(1 for i in ids if acked.get(i, 0) >= k) >= q:
+            best = max(best, k)
+    return best
+
+
+def describe(acked: dict, ids: set) -> str:
+    """MajorityConfig.Describe's bar chart (quorum/majority.go:47-104)."""
+    if not ids:
+        return "<empty majority quorum>"
+    n = len(ids)
+    info = []
+    for nid in ids:
+        ok = nid in acked
+        info.append([nid, acked.get(nid, 0), ok, 0])
+    info.sort(key=lambda t: (t[1], t[0]))
+    # NB: matches the reference code exactly — an entry equal to its sorted
+    # predecessor keeps the default bar 0 (majority.go:78-82)
+    for i in range(1, len(info)):
+        if info[i - 1][1] < info[i][1]:
+            info[i][3] = i
+    info.sort(key=lambda t: t[0])
+    out = [" " * n + "    idx"]
+    for nid, idx, ok, bar in info:
+        lead = "?" + " " * n if not ok else "x" * bar + ">" + " " * (n - bar)
+        out.append(f"{lead} {idx:5d}    (id={nid})")
+    return "\n".join(out) + "\n"
+
+
+def run_directive(d) -> str:
+    ids: list[int] = []
+    idsj: list[int] = []
+    idxs: list[int] = []
+    votes: list[int] = []
+    joint = False
+    for a in d.cmd_args:
+        for val in a.vals:
+            if a.key == "cfg":
+                ids.append(int(val))
+            elif a.key == "cfgj":
+                joint = True
+                if val != "zero":
+                    idsj.append(int(val))
+            elif a.key == "idx":
+                idxs.append(0 if val == "_" else int(val))
+            elif a.key == "votes":
+                votes.append({"y": 2, "n": 1, "_": 0}[val])
+    c, cj = set(ids), set(idsj)
+
+    def lookuper(vals: list[int]) -> dict:
+        l, p = {}, 0
+        for nid in ids + idsj:
+            if nid in l:
+                continue
+            if p < len(vals):
+                l[nid] = vals[p]
+                p += 1
+        return {k: v for k, v in l.items() if v != 0}
+
+    buf = []
+    if d.cmd == "committed":
+        l = lookuper(idxs)
+        if not joint:
+            idx = committed(l, c)
+            buf.append(describe(l, c))
+            if (a := alternative_committed(l, c)) != idx:
+                buf.append(f"{idx_str(a)} <-- via alternative computation\n")
+            if (a := joint_committed(l, c, set())) != idx:
+                buf.append(f"{idx_str(a)} <-- via zero-joint quorum\n")
+            if (a := joint_committed(l, c, c)) != idx:
+                buf.append(f"{idx_str(a)} <-- via self-joint quorum\n")
+            for nid in c:
+                iidx = l.get(nid, 0)
+                if idx > iidx and iidx > 0:
+                    # divergence labels match the reference's: original index
+                    # for the -1 probe, literal 0 for the zero probe
+                    for lowered, label in ((iidx - 1, iidx), (0, 0)):
+                        lo = dict(l)
+                        lo[nid] = lowered
+                        lo = {k: v for k, v in lo.items() if v != 0}
+                        if (a := committed(lo, c)) != idx:
+                            buf.append(
+                                f"{idx_str(a)} <-- overlaying {nid}->{label}"
+                            )
+            buf.append(f"{idx_str(idx)}\n")
+        else:
+            buf.append(describe(l, c | cj))
+            idx = joint_committed(l, c, cj)
+            if (a := joint_committed(l, cj, c)) != idx:
+                buf.append(f"{idx_str(a)} <-- via symmetry\n")
+            buf.append(f"{idx_str(idx)}\n")
+    elif d.cmd == "vote":
+        ll = lookuper(votes)
+        # 1 == rejected, 2 == granted in the script; map to VoteState
+        vmap = {
+            nid: int(VoteState.GRANTED) if v == 2 else int(VoteState.REJECTED)
+            for nid, v in ll.items()
+        }
+        if not joint:
+            r = vote_result(vmap, c)
+            buf.append(f"{VOTE_NAMES[r]}\n")
+        else:
+            r = joint_vote_result(vmap, c, cj)
+            if (a := joint_vote_result(vmap, cj, c)) != r:
+                buf.append(f"{VOTE_NAMES[a]} <-- via symmetry\n")
+            buf.append(f"{VOTE_NAMES[r]}\n")
+    else:
+        raise ValueError(f"unknown command {d.cmd}")
+    return "".join(buf)
+
+
+@pytest.mark.parametrize(
+    "fname",
+    ["majority_commit.txt", "majority_vote.txt", "joint_commit.txt", "joint_vote.txt"],
+)
+def test_quorum_datadriven(fname):
+    if not os.path.isdir(REF_TESTDATA):
+        pytest.skip("reference testdata not mounted")
+    from raft_tpu.testing.datadriven import parse_file
+
+    failures = []
+    for d in parse_file(os.path.join(REF_TESTDATA, fname)):
+        actual = run_directive(d)
+        if actual != d.expected:
+            diff = "\n".join(
+                difflib.unified_diff(
+                    d.expected.splitlines(), actual.splitlines(),
+                    "expected", "actual", lineterm="",
+                )
+            )
+            failures.append(f"{d.pos}: {d.cmd}\n{diff}")
+    assert not failures, f"{len(failures)} diverged:\n\n" + "\n\n".join(failures)
