@@ -115,8 +115,7 @@ def scan_step(state: RaftState, inbox: MsgBatch) -> tuple[RaftState, MsgBatch]:
     return state, out_all
 
 
-@partial(jax.jit, static_argnames=("m_in", "do_tick"))
-def cluster_round(
+def _cluster_round_impl(
     state: RaftState,
     inbox: MsgBatch,
     group_of,
@@ -146,6 +145,35 @@ def cluster_round(
     )
     nxt, dropped = route(out_all, group_of, lane_of, m_in)
     return state, nxt, dropped
+
+
+@partial(jax.jit, static_argnames=("m_in", "do_tick"))
+def cluster_round(state, inbox, group_of, lane_of, *, m_in, do_tick):
+    return _cluster_round_impl(
+        state, inbox, group_of, lane_of, m_in=m_in, do_tick=do_tick
+    )
+
+
+@partial(jax.jit, static_argnames=("m_in", "do_tick", "n_rounds"))
+def cluster_rounds(
+    state, inbox, group_of, lane_of, *, m_in, do_tick, n_rounds
+):
+    """n_rounds synchronous rounds in ONE dispatch (lax.scan over the round
+    body). This is the latency-amortized driver for benchmarks and steady-
+    state serving: the host only sequences whole blocks of rounds, so
+    dispatch/tunnel latency is paid once per block instead of per round."""
+
+    def body(carry, _):
+        st, inb, drops = carry
+        st, nxt, d = _cluster_round_impl(
+            st, inb, group_of, lane_of, m_in=m_in, do_tick=do_tick
+        )
+        return (st, nxt, drops + d), None
+
+    (state, inbox, dropped), _ = jax.lax.scan(
+        body, (state, inbox, jnp.int32(0)), None, length=n_rounds
+    )
+    return state, inbox, dropped
 
 
 def _bytes_between(state: RaftState, lo, hi):
@@ -216,6 +244,16 @@ class Cluster:
     def run(self, rounds: int = 1):
         for _ in range(rounds):
             self._do_round(do_tick=False)
+
+    def run_scanned(self, rounds: int, do_tick: bool = True):
+        """Run `rounds` rounds in a single device dispatch."""
+        inbox = jax.tree.map(jnp.asarray, self._pending)
+        self.state, nxt, dropped = cluster_rounds(
+            self.state, inbox, self.group_of, self.lane_of,
+            m_in=self.m_in, do_tick=do_tick, n_rounds=rounds,
+        )
+        self._pending = jax.tree.map(lambda x: np.array(x), nxt)
+        self.dropped += int(dropped)
 
     def has_pending(self) -> bool:
         return bool((self._pending.type != MT.MSG_NONE).any())

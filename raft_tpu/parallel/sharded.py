@@ -16,7 +16,6 @@ device path.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -55,7 +54,6 @@ def _round_body(state, inbox, group_of, lane_of, *, m_in, do_tick, lanes_per_sha
     )
     offset = jax.lax.axis_index("groups") * lanes_per_shard
     nxt, dropped = route(out_all, group_of, lane_of, m_in, lane_offset=offset)
-    dropped = jax.lax.psum(dropped, "groups")
     return state, nxt, dropped
 
 
@@ -83,42 +81,88 @@ class ShardedCluster(Cluster):
         self.state = jax.tree.map(shard_lanes, self.state)
         self.group_of = jax.device_put(self.group_of, self.lane_sharding)
         self.lane_of = jax.device_put(self.lane_of, self.repl_sharding)
-        self._round_cache: dict[bool, object] = {}
+        self._round_cache: dict = {}
+
+    def _shard_mapped(self, fn):
+        """shard_map + jit `fn(state, inbox, group_of, lane_of)` with the
+        cluster's lane-sharded in/out specs (dropped counter replicated)."""
+        lane = P("groups")
+
+        def spec_like(tree):
+            return jax.tree.map(lambda _: lane, tree)
+
+        sm = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(
+                spec_like(self.state),
+                spec_like(self._pending),
+                lane,
+                P(),
+            ),
+            out_specs=(
+                spec_like(self.state),
+                spec_like(self._pending),
+                P(),
+            ),
+        )
+        return jax.jit(sm)
 
     def _sharded_round(self, do_tick: bool):
         if do_tick not in self._round_cache:
-            lane = P("groups")
+            def one(state, inbox, group_of, lane_of):
+                state, nxt, d = _round_body(
+                    state, inbox, group_of, lane_of,
+                    m_in=self.m_in, do_tick=do_tick,
+                    lanes_per_shard=self.lanes_per_shard,
+                )
+                return state, nxt, jax.lax.psum(d, "groups")
 
-            def spec_like(tree):
-                return jax.tree.map(lambda _: lane, tree)
-
-            body = partial(
-                _round_body,
-                m_in=self.m_in,
-                do_tick=do_tick,
-                lanes_per_shard=self.lanes_per_shard,
-            )
-            sm = shard_map(
-                body,
-                mesh=self.mesh,
-                in_specs=(
-                    spec_like(self.state),
-                    spec_like(jax.tree.map(jnp.asarray, self._pending)),
-                    lane,
-                    P(),
-                ),
-                out_specs=(
-                    spec_like(self.state),
-                    spec_like(jax.tree.map(jnp.asarray, self._pending)),
-                    P(),
-                ),
-            )
-            self._round_cache[do_tick] = jax.jit(sm)
+            self._round_cache[do_tick] = self._shard_mapped(one)
         return self._round_cache[do_tick]
 
     def _do_round(self, do_tick: bool):
         inbox = jax.tree.map(jnp.asarray, self._pending)
         fn = self._sharded_round(do_tick)
+        self.state, nxt, dropped = fn(
+            self.state, inbox, self.group_of, self.lane_of
+        )
+        self._pending = jax.tree.map(lambda x: np.array(x), nxt)
+        self.dropped += int(dropped)
+
+    def _sharded_rounds(self, do_tick: bool, n_rounds: int):
+        """shard_map over a lax.scan of the round body: n_rounds rounds per
+        dispatch per shard, one compiled collective program."""
+        key = ("scan", do_tick, n_rounds)
+        if key not in self._round_cache:
+            def scanned(state, inbox, group_of, lane_of):
+                def body(carry, _):
+                    st, inb, drops = carry
+                    st, nxt, d = _round_body(
+                        st, inb, group_of, lane_of,
+                        m_in=self.m_in, do_tick=do_tick,
+                        lanes_per_shard=self.lanes_per_shard,
+                    )
+                    return (st, nxt, drops + d), None
+
+                # shard-local (axis-varying) accumulator for dropped counts
+                zero = jax.lax.pcast(
+                    jnp.zeros((), I32), ("groups",), to="varying"
+                )
+                (state, inbox, dropped), _ = jax.lax.scan(
+                    body, (state, inbox, zero), length=n_rounds,
+                )
+                # dropped accumulates shard-locally in the carry; one
+                # all-reduce per dispatch, not per round
+                return state, inbox, jax.lax.psum(dropped, "groups")
+
+            self._round_cache[key] = self._shard_mapped(scanned)
+        return self._round_cache[key]
+
+    def run_scanned(self, rounds: int, do_tick: bool = True):
+        """`rounds` sharded rounds in one dispatch."""
+        fn = self._sharded_rounds(do_tick, rounds)
+        inbox = jax.tree.map(jnp.asarray, self._pending)
         self.state, nxt, dropped = fn(
             self.state, inbox, self.group_of, self.lane_of
         )

@@ -60,3 +60,36 @@ def test_device_resident_rounds(devices):
     sh.run_device_rounds(40, do_tick=True)
     assert len(sh.leader_lanes()) == 8
     sh.check_no_errors()
+
+
+def test_scanned_rounds_match_stepwise(devices):
+    """cluster_rounds/run_scanned (one dispatch per block) must land in the
+    same state as per-round dispatch."""
+    g, v = 8, 3
+    a = ShardedCluster(g, v, devices=devices, seed=11)
+    b = ShardedCluster(g, v, devices=devices, seed=11)
+    a.tick(24)
+    b.run_scanned(24, do_tick=True)
+    for name in ("term", "state", "lead", "committed", "last"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.state, name)),
+            np.asarray(getattr(b.state, name)),
+            err_msg=name,
+        )
+    a.check_no_errors()
+    b.check_no_errors()
+
+
+def test_scanned_rounds_single_device():
+    from raft_tpu.cluster import Cluster
+
+    a = Cluster(6, 3, seed=13)
+    b = Cluster(6, 3, seed=13)
+    a.tick(20)
+    b.run_scanned(20, do_tick=True)
+    for name in ("term", "state", "lead", "committed"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.state, name)),
+            np.asarray(getattr(b.state, name)),
+            err_msg=name,
+        )
