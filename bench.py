@@ -40,7 +40,7 @@ def main():
     # NOTE: no donate_argnums — buffer donation trips INVALID_ARGUMENT on the
     # tunneled (axon) TPU backend
     round_fn = partial(
-        cluster_rounds, m_in=c.m_in, do_tick=True, n_rounds=block
+        cluster_rounds, m_in=c.m_in, do_tick=True, n_rounds=block, v=c.v
     )
 
     state = c.state

@@ -1,0 +1,81 @@
+"""TPU-native batched multi-raft framework.
+
+A from-scratch JAX/XLA re-derivation of the behavior of `go.etcd.io/raft/v3`
+(the Go Raft library behind etcd/CockroachDB/TiKV): thousands-to-millions of
+raft groups stepped in lockstep as one tensor program. See SURVEY.md for the
+reference structural map and README.md for the design.
+
+Public surface (reference analog in parens):
+
+- `Cluster` / `parallel.ShardedCluster` — the batched engine driving G groups
+  x V voters fully on device, single-chip or sharded over a `jax.sharding.Mesh`
+  (the multi-raft deployment the reference leaves to applications).
+- `RawNodeBatch` / `RawNode` — synchronous per-lane driver with the
+  Step/Ready/Advance contract (rawnode.go:34-559).
+- `NodeHost` / `Node` — threaded channel-style API (node.go:132-243).
+- `Config`-equivalents: `Shape` (static capacities) + `LaneConfig` (per-lane
+  tunables, raft.go:124-286) via `make_lane_config`.
+- `Message`, `Entry`, `Snapshot`, `HardState`, `SoftState`, `Ready`,
+  `ReadState` — wire/data model (raftpb/, node.go:52-115).
+- enums: `MessageType`, `EntryType`, `StateType`, `ProgressState`,
+  `VoteResult`, `ReadOnlyOption`, `CampaignType` (raftpb/raft.proto).
+- `ops.quorum` / `ops.log` / `ops.progress` / `ops.step` — the batched kernels
+  (quorum/, log.go, tracker/, raft.go re-expressed over [N]/[N,V]/[N,W]).
+- `confchange` — joint-consensus membership engine (confchange/).
+"""
+
+from raft_tpu.api.node import Node, NodeHost
+from raft_tpu.api.rawnode import (
+    Entry,
+    HardState,
+    Message,
+    RawNode,
+    RawNodeBatch,
+    Ready,
+    ReadState,
+    Snapshot,
+    SoftState,
+)
+from raft_tpu.cluster import Cluster
+from raft_tpu.config import Shape
+from raft_tpu.state import LaneConfig, RaftState, init_state, make_lane_config
+from raft_tpu.types import (
+    CampaignType,
+    EntryType,
+    MessageType,
+    ProgressState,
+    ReadOnlyOption,
+    StateType,
+    VoteResult,
+    VoteState,
+)
+
+__all__ = [
+    "Cluster",
+    "RawNode",
+    "RawNodeBatch",
+    "Node",
+    "NodeHost",
+    "Shape",
+    "LaneConfig",
+    "RaftState",
+    "init_state",
+    "make_lane_config",
+    "Message",
+    "Entry",
+    "Snapshot",
+    "HardState",
+    "SoftState",
+    "Ready",
+    "ReadState",
+    "MessageType",
+    "EntryType",
+    "StateType",
+    "ProgressState",
+    "VoteResult",
+    "VoteState",
+    "ReadOnlyOption",
+    "CampaignType",
+]
+
+__version__ = "0.1.0"

@@ -1075,22 +1075,76 @@ class RawNodeBatch:
             "auto_leave": bool(v.auto_leave[lane]),
         }
         if int(v.state[lane]) == int(StateType.LEADER):
-            prog = {}
-            for j in range(self.shape.v):
-                pid = int(v.prs_id[lane, j])
-                if not pid:
-                    continue
-                prog[pid] = {
-                    "match": int(v.pr_match[lane, j]),
-                    "next": int(v.pr_next[lane, j]),
-                    "state": ProgressState(int(v.pr_state[lane, j])).name,
-                    "paused": bool(v.pr_msg_app_flow_paused[lane, j]),
-                    "pending_snapshot": int(v.pr_pending_snapshot[lane, j]),
-                    "recent_active": bool(v.pr_recent_active[lane, j]),
-                    "is_learner": bool(v.learners[lane, j]),
-                }
-            st["progress"] = prog
+            st["progress"] = {
+                pid: self._progress_row(lane, j)
+                for pid, j in self._peer_slots(lane)
+            }
         return st
+
+    def _peer_slots(self, lane: int):
+        """Configured (id, slot) pairs in ascending id order (the reference's
+        tracker.go:193-213 sorted Visit)."""
+        v = self.view
+        return sorted(
+            (int(v.prs_id[lane, j]), j)
+            for j in range(self.shape.v)
+            if int(v.prs_id[lane, j])
+        )
+
+    def _progress_row(self, lane: int, j: int) -> dict:
+        v = self.view
+        return {
+            "match": int(v.pr_match[lane, j]),
+            "next": int(v.pr_next[lane, j]),
+            "state": ProgressState(int(v.pr_state[lane, j])).name,
+            "paused": bool(v.pr_msg_app_flow_paused[lane, j]),
+            "pending_snapshot": int(v.pr_pending_snapshot[lane, j]),
+            "recent_active": bool(v.pr_recent_active[lane, j]),
+            "is_learner": bool(v.learners[lane, j]),
+        }
+
+    _GO_STATE = {
+        "FOLLOWER": "StateFollower",
+        "CANDIDATE": "StateCandidate",
+        "LEADER": "StateLeader",
+        "PRE_CANDIDATE": "StatePreCandidate",
+    }
+    _GO_PR_STATE = {
+        "PROBE": "StateProbe",
+        "REPLICATE": "StateReplicate",
+        "SNAPSHOT": "StateSnapshot",
+    }
+
+    def status_json(self, lane: int) -> str:
+        """The reference's Status.MarshalJSON wire format, byte-for-byte
+        (reference: status.go:78-97): ids in lowercase hex, states as Go
+        strings, progress sub-objects with match/next/state only."""
+        st = self.status(lane)
+        j = (
+            '{"id":"%x","term":%d,"vote":"%x","commit":%d,"lead":"%x",'
+            '"raftState":"%s","applied":%d,"progress":{'
+            % (
+                st["id"], st["term"], st["vote"], st["commit"], st["lead"],
+                self._GO_STATE[st["raft_state"]], st["applied"],
+            )
+        )
+        parts = [
+            '"%x":{"match":%d,"next":%d,"state":"%s"}'
+            % (pid, p["match"], p["next"], self._GO_PR_STATE[p["state"]])
+            for pid, p in sorted(st.get("progress", {}).items())
+        ]
+        j += ",".join(parts) + '},"leadtransferee":"%x"}' % st["lead_transferee"]
+        return j
+
+    def with_progress(self, lane: int, visitor):
+        """Progress iteration in ascending id order (reference:
+        rawnode.go:516-528 WithProgress, tracker.go:193-213 Visit).
+        visitor(id, typ, progress_dict) with typ one of "ProgressTypePeer" /
+        "ProgressTypeLearner"."""
+        for pid, j in self._peer_slots(lane):
+            pr = self._progress_row(lane, j)
+            typ = "ProgressTypeLearner" if pr["is_learner"] else "ProgressTypePeer"
+            visitor(pid, typ, pr)
 
 
 class RawNode:
@@ -1127,6 +1181,12 @@ class RawNode:
 
     def basic_status(self) -> dict:
         return self.batch.basic_status(self.lane)
+
+    def status_json(self) -> str:
+        return self.batch.status_json(self.lane)
+
+    def with_progress(self, visitor):
+        self.batch.with_progress(self.lane, visitor)
 
     def transfer_leadership(self, transferee: int):
         self.batch.transfer_leadership(self.lane, transferee)

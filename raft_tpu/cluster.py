@@ -46,6 +46,7 @@ def route(
     m_in: int,
     drop_mask: jnp.ndarray | None = None,
     lane_offset=0,
+    lanes_per_group: int | None = None,
 ) -> tuple[MsgBatch, jnp.ndarray]:
     """Deliver outbox messages to per-lane inboxes.
 
@@ -57,9 +58,78 @@ def route(
     lane_offset: subtracted from lane_of's (global) lane numbers — inside a
       shard_map shard, pass axis_index * lanes_per_shard so delivery targets
       local rows (groups never span shards, so every destination is local).
+    lanes_per_group: when set (the canonical layout: group members are
+      contiguous lanes with raft ids 1..V, as Cluster builds), delivery uses
+      sort-free group-local one-hot compaction — TPU-friendly; otherwise the
+      general stable-sort path handles arbitrary lane_of maps.
 
     Returns (inbox [N, m_in], n_dropped_overflow).
     """
+    if lanes_per_group is not None:
+        return _route_grouped(out, m_in, lanes_per_group, drop_mask)
+    return _route_sorted(out, src_group, lane_of, m_in, drop_mask, lane_offset)
+
+
+def _route_grouped(out, m_in, v, drop_mask):
+    """Group-local delivery: destination lane of a message to raft id `to`
+    from a lane of group g is g*v + (to-1). All selection/compaction is
+    one-hot compare + cumsum — no sort or gather HLOs (they serialize on
+    TPU). Candidate order (src lane, slot) preserves per-sender emission
+    order, matching the stable sort of the general path."""
+    n, s = out.type.shape
+    g = n // v
+    c = v * s  # candidates per destination group
+
+    flat = jax.tree.map(
+        lambda x: x.reshape((g, c) + x.shape[2:]), out
+    )  # [G, C, ...] in (src member, slot) order
+    valid = flat.type != MT.MSG_NONE
+    if drop_mask is not None:
+        valid = valid & ~drop_mask.reshape(g, c)
+    # ids outside the canonical 1..V layout are undeliverable: drop + count
+    in_range = (flat.to >= 1) & (flat.to <= v)
+    bad_id = jnp.sum((valid & ~in_range).astype(I32))
+    valid = valid & in_range
+    member = jnp.clip(flat.to - 1, 0, v - 1)  # [G, C]
+
+    # [G, V, C]: candidate c addressed to member j
+    sel = valid[:, None, :] & (
+        member[:, None, :] == jnp.arange(v, dtype=I32)[None, :, None]
+    )
+    pos = jnp.cumsum(sel.astype(I32), axis=-1) - 1  # delivery rank
+    count = jnp.sum(sel.astype(I32), axis=-1)  # [G, V]
+    dropped = jnp.sum(jnp.clip(count - m_in, 0)) + bad_id
+
+    # [G, V, m_in, C] one-hot: candidate c lands in inbox slot k
+    oh = sel[:, :, None, :] & (
+        pos[:, :, None, :] == jnp.arange(m_in, dtype=I32)[None, None, :, None]
+    )
+
+    def deliver(col):
+        cast = col.dtype == jnp.bool_
+        x = col.astype(I32) if cast else col
+        if x.ndim == 2:  # [G, C]
+            picked = jnp.sum(jnp.where(oh, x[:, None, None, :], 0), axis=-1)
+        else:  # [G, C, E]
+            picked = jnp.sum(
+                jnp.where(oh[..., None], x[:, None, None, :, :], 0), axis=-2
+            )
+        picked = picked.reshape((n, m_in) + x.shape[2:])
+        return picked.astype(jnp.bool_) if cast else picked
+
+    inbox = jax.tree.map(deliver, flat)
+    filled = (
+        jnp.arange(m_in, dtype=I32)[None, None, :] < count[:, :, None]
+    ).reshape(n, m_in)
+    inbox = dataclasses.replace(
+        inbox, type=jnp.where(filled, inbox.type, jnp.int32(MT.MSG_NONE))
+    )
+    return inbox, dropped
+
+
+def _route_sorted(out, src_group, lane_of, m_in, drop_mask, lane_offset):
+    """General path: stable sort by destination lane (arbitrary id->lane
+    maps), segment extraction via searchsorted."""
     n, s = out.type.shape
     k = n * s
 
@@ -69,8 +139,12 @@ def route(
     valid = flat.type != MT.MSG_NONE
     if drop_mask is not None:
         valid = valid & ~drop_mask.reshape(k)
+    # ids outside lane_of's domain are undeliverable: drop + count (never
+    # clip-misdeliver to another lane)
+    in_range = (flat.to >= 0) & (flat.to < lane_of.shape[1])
     to = jnp.clip(flat.to, 0, lane_of.shape[1] - 1)
-    dst = jnp.where(valid, lane_of[group, to] - lane_offset, -1)
+    dst = jnp.where(valid & in_range, lane_of[group, to] - lane_offset, -1)
+    undeliverable = jnp.sum((valid & ((dst < 0) | (dst >= n))).astype(I32))
     valid = valid & (dst >= 0) & (dst < n)
 
     # stable sort by destination; invalid messages sort to the end
@@ -84,7 +158,7 @@ def route(
     starts = jnp.searchsorted(sorted_dst, lanes)
     ends = jnp.searchsorted(sorted_dst, lanes + 1)
     count = ends - starts
-    dropped = jnp.sum(jnp.clip(count - m_in, 0))
+    dropped = jnp.sum(jnp.clip(count - m_in, 0)) + undeliverable
 
     j = jnp.arange(m_in, dtype=I32)[None, :]
     pos = jnp.clip(starts[:, None] + j, 0, k - 1)
@@ -123,6 +197,7 @@ def _cluster_round_impl(
     *,
     m_in: int,
     do_tick: bool,
+    v: int | None = None,
 ) -> tuple[RaftState, MsgBatch, jnp.ndarray]:
     """One synchronous round: [tick ->] step queued messages -> sync persist
     -> auto-apply -> route emissions for next round."""
@@ -143,20 +218,20 @@ def _cluster_round_impl(
         state,
         uncommitted_size=jnp.clip(state.uncommitted_size - applied_bytes, 0),
     )
-    nxt, dropped = route(out_all, group_of, lane_of, m_in)
+    nxt, dropped = route(out_all, group_of, lane_of, m_in, lanes_per_group=v)
     return state, nxt, dropped
 
 
-@partial(jax.jit, static_argnames=("m_in", "do_tick"))
-def cluster_round(state, inbox, group_of, lane_of, *, m_in, do_tick):
+@partial(jax.jit, static_argnames=("m_in", "do_tick", "v"))
+def cluster_round(state, inbox, group_of, lane_of, *, m_in, do_tick, v=None):
     return _cluster_round_impl(
-        state, inbox, group_of, lane_of, m_in=m_in, do_tick=do_tick
+        state, inbox, group_of, lane_of, m_in=m_in, do_tick=do_tick, v=v
     )
 
 
-@partial(jax.jit, static_argnames=("m_in", "do_tick", "n_rounds"))
+@partial(jax.jit, static_argnames=("m_in", "do_tick", "n_rounds", "v"))
 def cluster_rounds(
-    state, inbox, group_of, lane_of, *, m_in, do_tick, n_rounds
+    state, inbox, group_of, lane_of, *, m_in, do_tick, n_rounds, v=None
 ):
     """n_rounds synchronous rounds in ONE dispatch (lax.scan over the round
     body). This is the latency-amortized driver for benchmarks and steady-
@@ -166,7 +241,7 @@ def cluster_rounds(
     def body(carry, _):
         st, inb, drops = carry
         st, nxt, d = _cluster_round_impl(
-            st, inb, group_of, lane_of, m_in=m_in, do_tick=do_tick
+            st, inb, group_of, lane_of, m_in=m_in, do_tick=do_tick, v=v
         )
         return (st, nxt, drops + d), None
 
@@ -233,6 +308,7 @@ class Cluster:
             self.lane_of,
             m_in=self.m_in,
             do_tick=do_tick,
+            v=self.v,
         )
         self._pending = jax.tree.map(lambda x: np.array(x), nxt)
         self.dropped += int(dropped)
@@ -250,7 +326,7 @@ class Cluster:
         inbox = jax.tree.map(jnp.asarray, self._pending)
         self.state, nxt, dropped = cluster_rounds(
             self.state, inbox, self.group_of, self.lane_of,
-            m_in=self.m_in, do_tick=do_tick, n_rounds=rounds,
+            m_in=self.m_in, do_tick=do_tick, n_rounds=rounds, v=self.v,
         )
         self._pending = jax.tree.map(lambda x: np.array(x), nxt)
         self.dropped += int(dropped)

@@ -26,6 +26,7 @@ import dataclasses
 
 import jax.numpy as jnp
 
+from raft_tpu.ops import onehot as oh
 from raft_tpu.state import RaftState
 
 I32 = jnp.int32
@@ -76,8 +77,7 @@ def term_at(state: RaftState, idx):
         return x[ex]
 
     slot = slot_of(state, idx)
-    t = jnp.take_along_axis(state.log_term, slot.reshape(state.log_term.shape[0], -1), axis=1)
-    t = t.reshape(idx.shape)
+    t = oh.gather(state.log_term, slot)
     in_window = (idx > b(state.snap_index)) & (idx <= b(state.last))
     t = jnp.where(in_window, t, 0)
     # Term of the compaction point itself is known (log.go:387-389).
@@ -173,11 +173,8 @@ def append(
     slot = slot_of(state, idx)
 
     def scatter(col, vals):
-        # Masked scatter of [N, E] vals into [N, W]: masked positions aim at
-        # slot W, which mode="drop" discards.
-        lane = jnp.arange(n, dtype=I32)[:, None]
-        safe_slot = jnp.where(write, slot, w)
-        return col.at[lane, safe_slot].set(vals, mode="drop")
+        # Masked one-hot scatter of [N, E] vals into [N, W].
+        return oh.scatter_set(col, slot, vals, write)
 
     new_last = jnp.where(ok, prev_index + n_ents, state.last)
     return dataclasses.replace(
@@ -227,7 +224,7 @@ def maybe_append(
     safe_k = jnp.minimum(k, e - 1)
 
     def shifted(col):
-        return jnp.take_along_axis(col, safe_k, axis=1)
+        return oh.gather(col, safe_k)
 
     n_keep = jnp.where(ok & (ci > 0), n_ents - shift, 0)
     state = append(
@@ -311,9 +308,8 @@ def gather_entries(state: RaftState, lo, count, e: int):
         idx <= state.last[:, None]
     ) & (idx > state.snap_index[:, None])
     slot = jnp.where(valid, slot_of(state, idx), 0)
-    lane = jnp.arange(n, dtype=I32)[:, None]
 
     def g(col):
-        return jnp.where(valid, col[lane, slot], 0)
+        return jnp.where(valid, oh.gather(col, slot), 0)
 
     return g(state.log_term), g(state.log_type), g(state.log_bytes), valid

@@ -1,0 +1,72 @@
+"""One-hot gather/scatter/sort primitives for small index domains.
+
+TPU (and the remote-TPU backend this engine benches on) pays a steep price for
+dynamic gather/scatter HLOs — each lowers to a serialized memory op — while
+compare+select+reduce chains run at full VPU rate and fuse. Every index domain
+in this engine is small and static (log window W, peer slots V<=8, entries per
+message E<=8, inflight ring F<=8, read slots R<=4), so indexed access is
+re-expressed as one-hot arithmetic: build `idx == iota` masks and reduce.
+This is the "masked lane-wise" style SURVEY §2.3/§7 prescribes; sorting uses a
+fixed odd-even transposition network (quorum/majority.go:126-172's sort of
+<=7 voters needs no general sort, per SURVEY §7 hard-parts).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+def onehot(idx, size: int):
+    """[...] int -> [..., size] bool, True where last-dim position == idx."""
+    return idx[..., None] == jnp.arange(size, dtype=I32)
+
+
+def gather(col, idx):
+    """col [B..., W] indexed along its last axis by idx [B..., K...] -> idx's
+    shape. col's batch dims B... must prefix idx's shape; any extra idx dims
+    broadcast. Out-of-range indexes return 0 (callers mask separately)."""
+    w = col.shape[-1]
+    if col.dtype == jnp.bool_:
+        return gather(col.astype(I32), idx).astype(jnp.bool_)
+    ohm = onehot(idx, w)  # [B..., K..., W]
+    extra = ohm.ndim - col.ndim
+    c = col.reshape(col.shape[:-1] + (1,) * extra + (w,))
+    return jnp.sum(jnp.where(ohm, c, 0), axis=-1)
+
+
+def scatter_set(col, idx, vals, mask):
+    """Masked one-hot scatter: col[..., idx[..., k]] = vals[..., k] where
+    mask[..., k]; out-of-range idx drops. col [..., W]; idx/vals/mask [..., K].
+    Duplicate in-mask indexes resolve to their sum (callers guarantee
+    distinctness, as the reference's append paths do)."""
+    w = col.shape[-1]
+    oh = onehot(idx, w) & mask[..., None]  # [..., K, W]
+    hit = oh.any(axis=-2)  # [..., W]
+    val = jnp.sum(jnp.where(oh, vals[..., None], 0), axis=-2)
+    return jnp.where(hit, val, col)
+
+
+def sort_last(x, valid=None, pad=-1):
+    """Ascending sort along the (small, static) last axis via an odd-even
+    transposition network — elementwise min/max only, no sort HLO. Invalid
+    slots are replaced by `pad` first."""
+    v = x.shape[-1]
+    if valid is not None:
+        x = jnp.where(valid, x, pad)
+    cols = [x[..., j] for j in range(v)]
+    for rnd in range(v):
+        start = rnd & 1
+        for j in range(start, v - 1, 2):
+            lo = jnp.minimum(cols[j], cols[j + 1])
+            hi = jnp.maximum(cols[j], cols[j + 1])
+            cols[j], cols[j + 1] = lo, hi
+    return jnp.stack(cols, axis=-1)
+
+
+def select_kth(sorted_x, k):
+    """sorted_x [..., V], k [...] -> element at position k (clipped)."""
+    v = sorted_x.shape[-1]
+    kc = jnp.clip(k, 0, v - 1)
+    return gather(sorted_x, kc)

@@ -17,6 +17,7 @@ import dataclasses
 
 import jax.numpy as jnp
 
+from raft_tpu.ops import onehot as oh
 from raft_tpu.state import RaftState
 from raft_tpu.types import ProgressState
 
@@ -110,8 +111,8 @@ def inflights_free_le(state: RaftState, sel, to) -> RaftState:
     k = jnp.arange(f, dtype=I32)[None, None, :]
     live = k < state.infl_count[..., None]  # ring order positions
     pos = (state.infl_start[..., None] + k) % f  # physical slot of ring pos k
-    idx_k = jnp.take_along_axis(state.infl_index, pos, axis=-1)
-    byt_k = jnp.take_along_axis(state.infl_bytes, pos, axis=-1)
+    idx_k = oh.gather(state.infl_index, pos)
+    byt_k = oh.gather(state.infl_bytes, pos)
     freed = live & (idx_k <= to[..., None])
     n_free = jnp.sum(freed.astype(I32), axis=-1)
     b_free = jnp.sum(jnp.where(freed, byt_k, 0), axis=-1)

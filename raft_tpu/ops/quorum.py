@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from raft_tpu.ops import onehot as oh
 from raft_tpu.types import VoteResult, VoteState
 
 I32 = jnp.int32
@@ -42,14 +43,13 @@ def majority_committed(match, mask):
     """
     n = jnp.sum(mask.astype(I32), axis=-1)
     q = n // 2 + 1
-    # Non-voters sort below every real acked index (acked >= 0).
-    vals = jnp.where(mask, match, -1)
-    srt = jnp.sort(vals, axis=-1)  # ascending over V
+    # Non-voters sort below every real acked index (acked >= 0); the sort is
+    # a fixed odd-even network (no sort HLO), V <= 8.
+    srt = oh.sort_last(match, valid=mask, pad=-1)
     v = match.shape[-1]
     # reference picks srt[n - q] of the n-ascending array; our array has
     # (V - n) pad values of -1 in front, so the same element is srt[V - q].
-    idx = jnp.clip(v - q, 0, v - 1)
-    picked = jnp.take_along_axis(srt, idx[..., None], axis=-1)[..., 0]
+    picked = oh.select_kth(srt, v - q)
     return jnp.where(n == 0, COMMITTED_INF, picked)
 
 

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from raft_tpu.messages import MsgBatch, empty_batch
 from raft_tpu.ops import log as lg
+from raft_tpu.ops import onehot as ohm
 from raft_tpu.ops import progress as pg
 from raft_tpu.ops import quorum as qr
 from raft_tpu.state import RaftState
@@ -88,8 +89,7 @@ def promotable(state: RaftState):
     snapshot."""
     ss = self_slot(state)
     in_cfg = ss >= 0
-    safe = jnp.clip(ss, 0)
-    is_lr = jnp.take_along_axis(state.learners, safe[:, None], axis=1)[:, 0]
+    is_lr = ohm.gather(state.learners, jnp.clip(ss, 0))
     return in_cfg & ~is_lr & (state.pending_snap_index == 0)
 
 
@@ -110,49 +110,49 @@ def _rng_next(rng):
 
 
 class Outbox:
-    """Write-once-per-slot SoA builder over [N, V+2] message slots."""
+    """Write-once-per-slot SoA builder over [N, V+2] message slots.
+
+    Fan-out slots [0, V) are kept as [N, V] arrays (put_peers); the self slot
+    (V) and reply slot (V+1) are kept as dicts of [N] columns merged with
+    cheap elementwise `where` chains — assembling the [N, V+2] batch happens
+    exactly once, in `msgs`. (Full-array scatter per put was the step kernel's
+    dominant copy cost on TPU.)
+    """
 
     def __init__(self, state: RaftState, max_entries: int):
         n, v = state.prs_id.shape
         self.n, self.v, self.e = n, v, max_entries
-        self.msgs = empty_batch((n, v + 2), max_entries)
+        self._proto = empty_batch((n,), max_entries)
+        self._peers = empty_batch((n, v), max_entries)
+        self._self = {f.name: getattr(self._proto, f.name) for f in dataclasses.fields(self._proto)}
+        self._reply = dict(self._self)
 
-    def _put(self, slot_idx, mask, fields):
-        """mask: [N]; slot_idx: int (static)."""
-        m = self.msgs
+    def _bc_mask(self, mask, like):
+        ms = mask
+        while ms.ndim < like.ndim:
+            ms = ms[..., None]
+        return ms
 
-        def upd(name, old):
-            if name in fields:
-                new = jnp.asarray(fields[name])
-                if new.dtype == jnp.bool_ and old.dtype != jnp.bool_:
-                    new = new.astype(old.dtype)
-                col = old[:, slot_idx]
-                if new.ndim < col.ndim:
-                    new = jnp.broadcast_to(new, col.shape)
-                return old.at[:, slot_idx].set(jnp.where(_bc(mask, col), new, col))
-            return old
-
-        def _bc(mask, like):
-            ms = mask
-            while ms.ndim < like.ndim:
-                ms = ms[..., None]
-            return ms
-
-        updates = {}
-        for f in dataclasses.fields(m):
-            updates[f.name] = upd(f.name, getattr(m, f.name))
-        self.msgs = MsgBatch(**updates)
+    def _put_row(self, row: dict, mask, fields):
+        """mask: [N]; row: dict of [N]/[N, E] columns."""
+        for name, val in fields.items():
+            old = row[name]
+            new = jnp.asarray(val)
+            if new.dtype == jnp.bool_ and old.dtype != jnp.bool_:
+                new = new.astype(old.dtype)
+            new = jnp.broadcast_to(new, old.shape)
+            row[name] = jnp.where(self._bc_mask(mask, old), new, old)
 
     def put_reply(self, mask, **fields):
-        self._put(self.v + 1, mask, fields)
+        self._put_row(self._reply, mask, fields)
 
     def put_self(self, mask, **fields):
-        self._put(self.v, mask, fields)
+        self._put_row(self._self, mask, fields)
 
     def put_peers(self, mask_nv, **fields_nv):
         """Write per-peer messages into fan-out slots. fields values are
         [N, V] (or broadcastable [N] -> same message to every peer)."""
-        m = self.msgs
+        m = self._peers
 
         def _bc(x, like):
             x = jnp.asarray(x)
@@ -164,18 +164,26 @@ class Outbox:
         for f in dataclasses.fields(m):
             old = getattr(m, f.name)
             if f.name in fields_nv:
-                new = fields_nv[f.name]
-                col = old[:, : self.v]
-                new = _bc(new, col)
-                if new.dtype == jnp.bool_ and col.dtype != jnp.bool_:
-                    new = new.astype(col.dtype)
-                mask = mask_nv
-                while mask.ndim < col.ndim:
-                    mask = mask[..., None]
-                updates[f.name] = old.at[:, : self.v].set(jnp.where(mask, new, col))
+                new = _bc(fields_nv[f.name], old)
+                if new.dtype == jnp.bool_ and old.dtype != jnp.bool_:
+                    new = new.astype(old.dtype)
+                updates[f.name] = jnp.where(
+                    self._bc_mask(mask_nv, old), new, old
+                )
             else:
                 updates[f.name] = old
-        self.msgs = MsgBatch(**updates)
+        self._peers = MsgBatch(**updates)
+
+    @property
+    def msgs(self) -> MsgBatch:
+        """Assemble the [N, V+2] slot batch (fan-out slots, self, reply)."""
+        cols = {}
+        for f in dataclasses.fields(self._peers):
+            p = getattr(self._peers, f.name)
+            s = self._self[f.name][:, None]
+            r = self._reply[f.name][:, None]
+            cols[f.name] = jnp.concatenate([p, s, r], axis=1)
+        return MsgBatch(**cols)
 
 
 # --------------------------------------------------------------------------
@@ -350,9 +358,7 @@ def maybe_send_append(
         k = jnp.arange(e, dtype=I32)[None, None, :]
         validk = k < n_send[..., None]
         slot = jnp.where(validk, idx & (state.log_term.shape[-1] - 1), 0)
-        flat = slot.reshape(out.n, -1)
-        g = jnp.take_along_axis(col, flat, axis=1).reshape(out.n, v, e)
-        return jnp.where(validk, g, 0)
+        return jnp.where(validk, ohm.gather(col, slot), 0)
 
     ent_term = gather_peer(state.log_term)
     ent_type = gather_peer(state.log_type)
@@ -798,6 +804,22 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     ss = self_slot(state)
     is_self = lanes_v == ss[:, None]
 
+    # Append-send accumulator: each lane steps exactly one message of one
+    # type, so the handler blocks below select disjoint lanes — their
+    # maybe_send_append requests commute and are coalesced into ONE
+    # fan-out construction at the end (the gather-heaviest op in the step).
+    send_sel = jnp.zeros_like(state.pr_match, dtype=bool)
+    send_sie = jnp.zeros_like(state.pr_match, dtype=bool)
+
+    def want_send(cells, sie=True):
+        nonlocal send_sel, send_sie
+        send_sel = send_sel | cells
+        if sie is True:
+            send_sie = send_sie | cells
+        else:
+            sie_nv = sie if sie.ndim == 2 else sie[:, None]
+            send_sie = send_sie | (cells & sie_nv)
+
     # MsgBeat (reference: raft.go:1228-1230)
     state = bcast_heartbeat(state, mask & (t == MT.MSG_BEAT), out)
 
@@ -861,9 +883,7 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     state, appended = append_entry(
         state, ok_prop, msg.ent_term, ent_type, ent_bytes, msg.n_ents, out
     )
-    state = maybe_send_append(
-        state, appended[:, None] & jnp.ones_like(state.pr_match, bool), True, out
-    )
+    want_send(appended[:, None] & jnp.ones_like(state.pr_match, bool))
 
     # MsgReadIndex (reference: raft.go:1303-1332, read_only.go). Known
     # deviations (documented for the judge): requests arriving before the
@@ -911,7 +931,7 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     sel_from = (lanes_v == fs[:, None]) & has_pr[:, None]  # [N, V] sender cell
 
     def at_from(arr_nv):
-        return jnp.take_along_axis(arr_nv, fs[:, None], axis=1)[:, 0]
+        return ohm.gather(arr_nv, fs)
 
     # MsgAppResp (raft.go:1333-1526)
     ar = mask & (t == MT.MSG_APP_RESP) & has_pr
@@ -935,7 +955,7 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     )
     dec_repl = decreased & (state.pr_state == ProgressState.REPLICATE)
     state = pg.become_probe(state, dec_repl)
-    state = maybe_send_append(state, decreased, True, out)
+    want_send(decreased)
 
     #   accept path (raft.go:1455-1526)
     acc = ar & ~msg.reject
@@ -974,16 +994,11 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         state, jnp.where(advanced_lane, mci, 0), state.term
     )
     all_peers = jnp.ones_like(state.pr_match, bool)
-    state = maybe_send_append(state, committed_adv[:, None] & all_peers, True, out)
+    want_send(committed_adv[:, None] & all_peers)
     #   no commit advance: maybe unblock just the sender
     not_self = msg.frm != state.id
     retry_sender = advanced_lane & ~committed_adv & not_self
-    state = maybe_send_append(
-        state,
-        retry_sender[:, None] & sel_from,
-        old_paused,
-        out,
-    )
+    want_send(retry_sender[:, None] & sel_from, old_paused)
     #   leadership transfer completion (raft.go:1519-1524)
     xfer = (
         acc
@@ -1014,7 +1029,7 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         (at_from(state.pr_match) < state.last)
         | (at_from(state.pr_state) == ProgressState.PROBE)
     )
-    state = maybe_send_append(state, need_app[:, None] & sel_from, True, out)
+    want_send(need_app[:, None] & sel_from)
 
     # ReadIndex ack via heartbeat ctx (reference: raft.go:1548-1561,
     # read_only.go:68-112). Each request's own broadcast acks it; the
@@ -1030,10 +1045,10 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     )  # [N, R]
     release = hit_r & (ro_res == VoteResult.VOTE_WON)
     rel_any = release.any(axis=1)
-    rel_r = jnp.argmax(release, axis=1)[:, None]  # [N, 1]
+    rel_r = jnp.argmax(release, axis=1).astype(I32)  # [N]
 
     def at_rel(arr_nr):
-        return jnp.take_along_axis(arr_nr, rel_r, axis=1)[:, 0]
+        return ohm.gather(arr_nr, rel_r)
 
     out.put_reply(
         rel_any,
@@ -1096,9 +1111,10 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         frm=state.id[:, None],
         term=state.term[:, None],
     )
-    state = maybe_send_append(
-        state, (tl_go & ~ready_now)[:, None] & sel_from, True, out
-    )
+    want_send((tl_go & ~ready_now)[:, None] & sel_from)
+
+    # the single coalesced fan-out for every request accumulated above
+    state = maybe_send_append(state, send_sel, send_sie, out)
     return state
 
 
@@ -1227,15 +1243,11 @@ def post_conf_change(state: RaftState, mask, max_entries: int) -> StepResult:
     )
     state, adv = lg.maybe_commit(state, jnp.where(act, mci, 0), state.term)
     all_peers = jnp.ones_like(state.pr_match, bool)
-    state = maybe_send_append(state, (act & adv)[:, None] & all_peers, True, out)
-    state = maybe_send_append(state, (act & ~adv)[:, None] & all_peers, False, out)
-    t_slot = find_slot(state, state.lead_transferee)
-    t_voter = (
-        jnp.take_along_axis(
-            voter_mask(state), jnp.clip(t_slot, 0)[:, None], axis=1
-        )[:, 0]
-        & (t_slot >= 0)
+    state = maybe_send_append(
+        state, act[:, None] & all_peers, (act & adv)[:, None] & all_peers, out
     )
+    t_slot = find_slot(state, state.lead_transferee)
+    t_voter = ohm.gather(voter_mask(state), jnp.clip(t_slot, 0)) & (t_slot >= 0)
     gone = mask & (state.lead_transferee != 0) & ~t_voter
     state = dataclasses.replace(
         state, lead_transferee=_w(gone, 0, state.lead_transferee)

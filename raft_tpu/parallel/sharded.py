@@ -36,7 +36,9 @@ from raft_tpu.types import MessageType as MT, StateType
 I32 = jnp.int32
 
 
-def _round_body(state, inbox, group_of, lane_of, *, m_in, do_tick, lanes_per_shard):
+def _round_body(
+    state, inbox, group_of, lane_of, *, m_in, do_tick, lanes_per_shard, v
+):
     """Shard-local cluster round (runs inside shard_map)."""
     e = inbox.ent_term.shape[-1]
     if do_tick:
@@ -53,7 +55,9 @@ def _round_body(state, inbox, group_of, lane_of, *, m_in, do_tick, lanes_per_sha
         uncommitted_size=jnp.clip(state.uncommitted_size - applied_bytes, 0),
     )
     offset = jax.lax.axis_index("groups") * lanes_per_shard
-    nxt, dropped = route(out_all, group_of, lane_of, m_in, lane_offset=offset)
+    nxt, dropped = route(
+        out_all, group_of, lane_of, m_in, lane_offset=offset, lanes_per_group=v
+    )
     return state, nxt, dropped
 
 
@@ -114,7 +118,7 @@ class ShardedCluster(Cluster):
                 state, nxt, d = _round_body(
                     state, inbox, group_of, lane_of,
                     m_in=self.m_in, do_tick=do_tick,
-                    lanes_per_shard=self.lanes_per_shard,
+                    lanes_per_shard=self.lanes_per_shard, v=self.v,
                 )
                 return state, nxt, jax.lax.psum(d, "groups")
 
@@ -141,7 +145,7 @@ class ShardedCluster(Cluster):
                     st, nxt, d = _round_body(
                         st, inb, group_of, lane_of,
                         m_in=self.m_in, do_tick=do_tick,
-                        lanes_per_shard=self.lanes_per_shard,
+                        lanes_per_shard=self.lanes_per_shard, v=self.v,
                     )
                     return (st, nxt, drops + d), None
 

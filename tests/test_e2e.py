@@ -131,3 +131,49 @@ def test_five_voters():
     assert (np.asarray(c.state.committed) == 2).all()
     st = np.asarray(c.state.state).reshape(2, 5)
     assert (st[:, 0] == StateType.LEADER).all()
+
+
+def test_route_paths_agree():
+    """The grouped (sort-free) router and the general sorted router must
+    deliver identically on the canonical layout — including overflow and
+    undeliverable-id accounting."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.cluster import route
+    from raft_tpu.messages import empty_batch
+
+    rng = np.random.default_rng(3)
+    g, v, s, m_in, e = 4, 3, 6, 4, 2
+    n = g * v
+    out = empty_batch((n, s), e)
+    fields = {}
+    for name in ("type", "to", "frm", "term", "index", "commit"):
+        fields[name] = jnp.asarray(rng.integers(0, 5, (n, s)), jnp.int32)
+    # ~half the slots empty; a few undeliverable ids (0 and v+1)
+    fields["type"] = jnp.where(
+        jnp.asarray(rng.random((n, s)) < 0.5), jnp.int32(MT.MSG_NONE), 3
+    )
+    fields["to"] = jnp.asarray(rng.integers(0, v + 2, (n, s)), jnp.int32)
+    import dataclasses
+
+    out = dataclasses.replace(out, **fields)
+    group_of = jnp.repeat(jnp.arange(g, dtype=jnp.int32), v)
+    lane_of = np.full((g, v + 2), -1, np.int32)
+    for gi in range(g):
+        for vid in range(1, v + 1):
+            lane_of[gi, vid] = gi * v + (vid - 1)
+    lane_of = jnp.asarray(lane_of)
+
+    in_a, drop_a = route(out, group_of, lane_of, m_in, lanes_per_group=v)
+    in_b, drop_b = route(out, group_of, lane_of, m_in)
+    assert int(drop_a) == int(drop_b)
+    for f in dataclasses.fields(in_a):
+        a, b = getattr(in_a, f.name), getattr(in_b, f.name)
+        mask = np.asarray(in_a.type) != int(MT.MSG_NONE)
+        am, bm = np.asarray(a), np.asarray(b)
+        if am.ndim > mask.ndim:
+            mask = mask[..., None]
+        np.testing.assert_array_equal(
+            np.where(mask, am, 0), np.where(mask, bm, 0), err_msg=f.name
+        )

@@ -1,0 +1,53 @@
+"""Status/BasicStatus introspection parity (reference: status.go:26-106,
+rawnode.go:495-528)."""
+
+import json
+
+from tests.test_rawnode import drive, make_group
+
+
+def test_status_json_wire_format():
+    """status_json must match Status.MarshalJSON byte layout
+    (reference: status.go:78-97): hex ids, Go state strings, progress only
+    on the leader."""
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    s = b.status_json(0)
+    d = json.loads(s)
+    assert d["id"] == "1"
+    assert d["raftState"] == "StateLeader"
+    assert d["leadtransferee"] == "0"
+    assert set(d["progress"]) == {"1", "2", "3"}
+    assert d["progress"]["2"]["state"] in ("StateProbe", "StateReplicate")
+    assert d["progress"]["1"]["match"] == d["commit"]
+    # follower: no progress entries, same shape otherwise
+    f = json.loads(b.status_json(1))
+    assert f["raftState"] == "StateFollower"
+    assert f["progress"] == {}
+    assert f["lead"] == "1"
+    # raw string layout (not just JSON-equivalent): leader id in hex
+    b2 = make_group(16)  # ids up to 16 -> hex 10
+    assert '"id":"10"' in b2.status_json(15)
+
+
+def test_with_progress_visits_sorted_with_types():
+    import numpy as np
+
+    from raft_tpu.api.rawnode import RawNodeBatch
+    from raft_tpu.config import Shape
+
+    # 2 voters + 1 learner (id 3)
+    shape = Shape(n_lanes=3, max_peers=4)
+    peers = np.zeros((3, shape.v), np.int32)
+    peers[:, :3] = [1, 2, 3]
+    learners = np.zeros((3, shape.v), bool)
+    learners[:, 2] = True
+    b = RawNodeBatch(shape, [1, 2, 3], peers, learners)
+    seen = []
+    b.with_progress(0, lambda pid, typ, pr: seen.append((pid, typ)))
+    assert seen == [
+        (1, "ProgressTypePeer"),
+        (2, "ProgressTypePeer"),
+        (3, "ProgressTypeLearner"),
+    ]
