@@ -2,14 +2,19 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Workload = BASELINE.json config 5 in spirit: many independent 3-voter groups,
-election + steady-state replication with randomized timeouts. Every round is
-one tick over all groups plus a full step of all queued messages, with
-delivery as an in-device permutation. Everything stays device-resident; the
-host only sequences rounds (donated buffers, no host mirrors).
+Workload = BASELINE.json config 5 in spirit: many independent voter groups,
+election + steady-state replication with randomized timeouts; every round is
+one tick of every group plus full message delivery and handling, with one
+committed entry per group per round (auto-propose) and continuous
+snapshot+compaction of the device log window. Everything stays
+device-resident; the host only sequences blocks of rounds.
 
-`vs_baseline` is measured against the BASELINE.md target of 1M groups*ticks/s
-(the reference publishes no numbers; see BASELINE.md for the Go harnesses).
+Engines (BENCH_ENGINE): "fused" (default) = the one-invocation-per-round
+kernel with transpose routing (ops/fused.py); "serial" = the per-message
+step scan + grouped router (cluster.py), the conformance-exact path.
+
+`vs_baseline` is measured against the BASELINE.md target of 1M
+groups*ticks/s (the reference publishes no numbers; see BASELINE.md).
 """
 
 from __future__ import annotations
@@ -17,56 +22,82 @@ from __future__ import annotations
 import json
 import os
 import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 
-def main():
+def run_fused(n_groups, n_voters, n_iters, block):
+    from raft_tpu.ops.fused import FusedCluster
+
+    c = FusedCluster(n_groups, n_voters, seed=42)
+
+    t0 = time.perf_counter()
+    c.run(block, auto_propose=True, auto_compact_lag=8)
+    jax.block_until_ready(c.state.term)
+    compile_s = time.perf_counter() - t0
+
+    # warm through the election phase so the timed region is steady state
+    while len(c.leader_lanes()) < n_groups:
+        c.run(block, auto_propose=True, auto_compact_lag=8)
+
+    com0 = int(jnp.sum(c.state.committed))
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        c.run(block, auto_propose=True, auto_compact_lag=8)
+    jax.block_until_ready(c.state.term)
+    dt = time.perf_counter() - t0
+    commits = int(jnp.sum(c.state.committed)) - com0
+    c.check_no_errors()
+    return dt, compile_s, len(c.leader_lanes()), commits
+
+
+def run_serial(n_groups, n_voters, n_iters, block):
+    from functools import partial
+
     from raft_tpu.cluster import Cluster, cluster_rounds
 
+    c = Cluster(n_groups, n_voters, seed=42)
+    round_fn = partial(
+        cluster_rounds, m_in=c.m_in, do_tick=True, n_rounds=block, v=c.v
+    )
+    state = c.state
+    pending = jax.tree.map(jnp.asarray, c._pending)
+
+    t0 = time.perf_counter()
+    state, pending, dropped = round_fn(state, pending, c.group_of, c.lane_of)
+    jax.block_until_ready(state.term)
+    compile_s = time.perf_counter() - t0
+
+    warm_blocks = max(0, -(-32 // block) - 1)
+    for _ in range(warm_blocks):
+        state, pending, dropped = round_fn(state, pending, c.group_of, c.lane_of)
+    jax.block_until_ready(state.term)
+
+    com0 = int(jnp.sum(state.committed))
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, pending, dropped = round_fn(state, pending, c.group_of, c.lane_of)
+    jax.block_until_ready(state.term)
+    dt = time.perf_counter() - t0
+    commits = int(jnp.sum(state.committed)) - com0
+    n_leaders = int(jnp.sum(state.state == 2))
+    return dt, compile_s, n_leaders, commits
+
+
+def main():
     platform = jax.devices()[0].platform
+    engine = os.environ.get("BENCH_ENGINE", "fused")
     n_groups = int(
         os.environ.get("BENCH_GROUPS", 16384 if platform == "tpu" else 512)
     )
     n_iters = int(os.environ.get("BENCH_ITERS", 10))
-    # rounds fused into one dispatch: the host pays tunnel/dispatch latency
-    # once per block (lax.scan over the round body)
     block = int(os.environ.get("BENCH_BLOCK", 32))
-    n_voters = 3
-    c = Cluster(n_groups, n_voters, seed=42)
+    n_voters = int(os.environ.get("BENCH_VOTERS", 3))
 
-    # NOTE: no donate_argnums — buffer donation trips INVALID_ARGUMENT on the
-    # tunneled (axon) TPU backend
-    round_fn = partial(
-        cluster_rounds, m_in=c.m_in, do_tick=True, n_rounds=block, v=c.v
-    )
+    runner = run_fused if engine == "fused" else run_serial
+    dt, compile_s, n_leaders, commits = runner(n_groups, n_voters, n_iters, block)
 
-    state = c.state
-    pending = jax.tree.map(jnp.asarray, c._pending)
-    group_of, lane_of = c.group_of, c.lane_of
-
-    # warmup/compile + leader elections
-    t0 = time.perf_counter()
-    state, pending, dropped = round_fn(state, pending, group_of, lane_of)
-    jax.block_until_ready(state.term)
-    compile_s = time.perf_counter() - t0
-
-    # warm past the election phase (~20+ rounds) so the timed region
-    # measures steady-state replication regardless of block size
-    warm_blocks = max(0, -(-32 // block) - 1)
-    for _ in range(warm_blocks):
-        state, pending, dropped = round_fn(state, pending, group_of, lane_of)
-    jax.block_until_ready(state.term)
-
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
-        state, pending, dropped = round_fn(state, pending, group_of, lane_of)
-    jax.block_until_ready(state.term)
-    dt = time.perf_counter() - t0
-
-    n_leaders = int(jnp.sum(state.state == 2))
     groups_ticks_per_sec = n_groups * n_iters * block / dt
     target = 1_000_000.0
     print(
@@ -77,8 +108,13 @@ def main():
                 "unit": "groups*ticks/s",
                 "vs_baseline": round(groups_ticks_per_sec / target, 4),
                 "extra": {
+                    "engine": engine,
                     "groups": n_groups,
+                    "voters": n_voters,
                     "leaders_elected": n_leaders,
+                    "commits_per_group_round": round(
+                        commits / (n_groups * n_voters * n_iters * block), 3
+                    ),
                     "round_ms": round(1000 * dt / (n_iters * block), 3),
                     "block": block,
                     "compile_s": round(compile_s, 1),

@@ -38,6 +38,7 @@ from raft_tpu.api.rawnode import (
 )
 from raft_tpu.cluster import Cluster
 from raft_tpu.config import Shape
+from raft_tpu.ops.fused import FusedCluster
 from raft_tpu.state import LaneConfig, RaftState, init_state, make_lane_config
 from raft_tpu.types import (
     CampaignType,
@@ -52,6 +53,7 @@ from raft_tpu.types import (
 
 __all__ = [
     "Cluster",
+    "FusedCluster",
     "RawNode",
     "RawNodeBatch",
     "Node",

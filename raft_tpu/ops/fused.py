@@ -1,0 +1,1140 @@
+"""The fused multi-raft round kernel: one kernel invocation per round.
+
+The serial engine (ops/step.py + cluster.scan_step) replays the reference's
+one-message-at-a-time `Step` contract (raft.go:1051) — m_in sequential step
+invocations per round plus a routing pass. This module is the TPU-native
+re-derivation SURVEY §3.2 calls the north-star "single vmapped kernel": the
+whole round — tick, delivery, every handler, the MsgAppResp fan-in +
+maybeCommit pair (raft.go:1333-1526), vote tally (raft.go:1041), heartbeat
+fan-in, and the coalesced append fan-out (raft.go:600-715) — is ONE tensor
+program over [N] / [N, V] arrays with no scan, no sort, and no gather.
+
+Key structural ideas:
+
+- **Channel fabric.** Messages live in per-(source lane, destination member)
+  slots: three channels (replication, heartbeat, vote) of [N, V] SoA columns
+  plus a [N] self slot (the reference's msgsAfterAppend, raft.go:534-580).
+  A lane emits at most one message per (dst, channel) per round — which is
+  exactly what one pass of the reference's handlers can produce — so slots
+  never collide.
+- **Routing is a transpose.** Member j of group g receives from member i
+  whatever i wrote into dst-slot j: inbox[g, j, i] = outbox[g, i, j]. One
+  [G, V, V] axis swap per field; zero routing compute. This replaces the
+  deliver-by-sort/compaction of cluster.route.
+- **Fan-in is elementwise.** An incoming response from member i lands in
+  cell [lane, i] — the same cell as the leader's Progress for that peer
+  (canonical layout: member i's raft id is i+1 and its progress slot is i),
+  so MaybeUpdate/Inflights/vote recording are [N, V] elementwise updates
+  followed by one quorum reduction per lane.
+- **At most one append per round.** Only one valid leader exists per term, so
+  the winning MsgApp/MsgSnap per lane is selected by a V-way reduction and
+  handled once, reusing the serial handlers (handle_append_entries etc.) on a
+  composed [N] message view. Losers are stale-term messages the ladder
+  already answered.
+
+Scope: fixed membership (conf changes stay on the host-driven RawNode path),
+canonical id layout (ids 1..V, contiguous lanes). Everything else —
+elections with PreVote/CheckQuorum, randomized timeouts, replication with
+probe/replicate/snapshot flow control and inflight windows, commit/apply,
+in-fabric snapshot catch-up, leadership transfer, linearizable ReadIndex at
+the leader, auto-proposals for steady-state serving — runs on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from types import SimpleNamespace
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops import log as lg
+from raft_tpu.ops import onehot as ohm
+from raft_tpu.ops import progress as pg
+from raft_tpu.ops import quorum as qr
+from raft_tpu.ops import step as stepmod
+from raft_tpu.state import RaftState
+from raft_tpu.types import (
+    CampaignType,
+    MessageType as MT,
+    ProgressState,
+    StateType,
+    VoteResult,
+    VoteState,
+)
+
+I32 = jnp.int32
+BOOL = jnp.bool_
+
+
+def _dc(cls):
+    fields = [f.name for f in dataclasses.fields(cls)]
+    return jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+
+
+# --------------------------------------------------------------------------
+# the channel fabric
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class RepChan:
+    """Replication channel: MsgApp / MsgSnap / MsgAppResp per (src, dst)."""
+
+    kind: Any  # [N, V] i32 MessageType (MSG_NONE = empty)
+    term: Any  # [N, V]
+    index: Any  # [N, V] APP: prev index; APPRESP: acked/rejected index
+    log_term: Any  # [N, V] APP: prev term; APPRESP(rej): hint term
+    commit: Any  # [N, V]
+    reject: Any  # [N, V] bool
+    reject_hint: Any  # [N, V]
+    n_ents: Any  # [N, V]
+    ent_term: Any  # [N, V, E]
+    ent_type: Any  # [N, V, E]
+    ent_bytes: Any  # [N, V, E]
+    snap_index: Any  # [N, V]
+    snap_term: Any  # [N, V]
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class HbChan:
+    """Heartbeat channel: MsgHeartbeat / MsgHeartbeatResp."""
+
+    kind: Any  # [N, V]
+    term: Any  # [N, V]
+    commit: Any  # [N, V]
+    context: Any  # [N, V] ReadIndex ctx ticket
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class VoteChan:
+    """Vote-request channel: MsgVote / MsgPreVote / MsgTimeoutNow."""
+
+    kind: Any  # [N, V]
+    term: Any  # [N, V]
+    index: Any  # [N, V] candidate lastIndex
+    log_term: Any  # [N, V] candidate lastTerm
+    reject: Any  # [N, V] bool (unused for requests; kept for adapter shape)
+    context: Any  # [N, V] campaign-transfer flag
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class VoteRespChan:
+    """Vote-response channel: Msg(Pre)VoteResp. Separate from requests so a
+    lane that answers a vote AND campaigns in the same round never collides
+    (the reference emits both as distinct messages)."""
+
+    kind: Any  # [N, V]
+    term: Any  # [N, V]
+    reject: Any  # [N, V] bool
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class SelfMsg:
+    """The after-append self slot (MsgAppResp / Msg(Pre)VoteResp to self)."""
+
+    kind: Any  # [N]
+    term: Any  # [N]
+    index: Any  # [N]
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class Fabric:
+    rep: RepChan
+    hb: HbChan
+    vote: VoteChan
+    vresp: VoteRespChan
+    self_: SelfMsg
+
+
+def empty_fabric(n: int, v: int, e: int) -> Fabric:
+    z = jnp.zeros((n, v), I32)
+    zb = jnp.zeros((n, v), BOOL)
+    ze = jnp.zeros((n, v, e), I32)
+    none = jnp.full((n, v), MT.MSG_NONE, I32)
+    return Fabric(
+        rep=RepChan(none, z, z, z, z, zb, z, z, ze, ze, ze, z, z),
+        hb=HbChan(none, z, z, z),
+        vote=VoteChan(none, z, z, z, zb, z),
+        vresp=VoteRespChan(none, z, zb),
+        self_=SelfMsg(jnp.full((n,), MT.MSG_NONE, I32), jnp.zeros((n,), I32), jnp.zeros((n,), I32)),
+    )
+
+
+def route_fabric(out: Fabric, v: int, mute=None) -> Fabric:
+    """Deliver: inbox[g, j, i] = outbox[g, i, j]. Pure transpose per field;
+    the self slot passes through (it is the lane's own queued ack).
+
+    mute: optional [N] bool — a muted lane neither sends nor receives (the
+    fabric analog of rafttest/network.go:122-144 disconnect)."""
+
+    def t(x):
+        g = x.shape[0] // v
+        y = x.reshape((g, v, v) + x.shape[2:])
+        y = jnp.swapaxes(y, 1, 2)
+        return y.reshape(x.shape)
+
+    def deliver(chan):
+        chan = jax.tree.map(t, chan)
+        if mute is None:
+            return chan
+        n = mute.shape[0]
+        g = n // v
+        # after transpose, cell [dst, i] came from lane (dst//v)*v + i
+        src_mute = jnp.broadcast_to(
+            mute.reshape(g, 1, v), (g, v, v)
+        ).reshape(n, v)
+        cut = src_mute | mute[:, None]
+        return dataclasses.replace(
+            chan, kind=jnp.where(cut, jnp.int32(MT.MSG_NONE), chan.kind)
+        )
+
+    return Fabric(
+        rep=deliver(out.rep),
+        hb=deliver(out.hb),
+        vote=deliver(out.vote),
+        vresp=deliver(out.vresp),
+        self_=out.self_,
+    )
+
+
+# --------------------------------------------------------------------------
+# Outbox adapter: step.py's emission helpers write into the fabric
+
+
+_REP_TYPES = (MT.MSG_APP, MT.MSG_SNAP, MT.MSG_APP_RESP)
+_HB_TYPES = (MT.MSG_HEARTBEAT, MT.MSG_HEARTBEAT_RESP)
+_VOTE_TYPES = (MT.MSG_VOTE, MT.MSG_PRE_VOTE, MT.MSG_TIMEOUT_NOW)
+_VRESP_TYPES = (MT.MSG_VOTE_RESP, MT.MSG_PRE_VOTE_RESP)
+
+
+def _family(types, mtype):
+    m = jnp.zeros_like(mtype, dtype=BOOL)
+    for t in types:
+        m = m | (mtype == t)
+    return m
+
+
+class ChannelOutbox:
+    """Implements the Outbox protocol (put_peers/put_self/put_reply) expected
+    by step.py's maybe_send_append / bcast_heartbeat / campaign /
+    handle_append_entries / ..., writing into the channel fabric. Message
+    type is data, so dispatch is by per-element family masks."""
+
+    def __init__(self, state: RaftState, max_entries: int):
+        n, v = state.prs_id.shape
+        self.n, self.v, self.e = n, v, max_entries
+        self.fab = empty_fabric(n, v, max_entries)
+
+    # -- internals --------------------------------------------------------
+
+    def _merge_chan(self, chan, sel, fields):
+        upd = {}
+        for f in dataclasses.fields(chan):
+            old = getattr(chan, f.name)
+            src = fields.get("type" if f.name == "kind" else f.name)
+            if src is None:
+                continue
+            new = jnp.asarray(src)
+            if new.dtype == BOOL and old.dtype != BOOL:
+                new = new.astype(old.dtype)
+            m = sel
+            while m.ndim < old.ndim:
+                m = m[..., None]
+            new = jnp.broadcast_to(new, old.shape)
+            upd[f.name] = jnp.where(m, new, old)
+        return dataclasses.replace(chan, **upd)
+
+    def _put_nv(self, sel_nv, fields_nv):
+        """Write [N, V]-shaped messages into their family channels."""
+        mtype = jnp.broadcast_to(
+            jnp.asarray(fields_nv["type"]), sel_nv.shape
+        ).astype(I32)
+        fields = dict(fields_nv, type=mtype)
+        rep_sel = sel_nv & _family(_REP_TYPES, mtype)
+        hb_sel = sel_nv & _family(_HB_TYPES, mtype)
+        vote_sel = sel_nv & _family(_VOTE_TYPES, mtype)
+        vresp_sel = sel_nv & _family(_VRESP_TYPES, mtype)
+        self.fab = dataclasses.replace(
+            self.fab,
+            rep=self._merge_chan(self.fab.rep, rep_sel, fields),
+            hb=self._merge_chan(self.fab.hb, hb_sel, fields),
+            vote=self._merge_chan(self.fab.vote, vote_sel, fields),
+            vresp=self._merge_chan(self.fab.vresp, vresp_sel, fields),
+        )
+
+    # -- Outbox protocol --------------------------------------------------
+
+    def put_peers(self, mask_nv, **fields):
+        def bc(x):
+            x = jnp.asarray(x)
+            if x.ndim == 1 and x.shape[0] == self.n:
+                return x[:, None]
+            return x
+
+        self._put_nv(mask_nv, {k: bc(v) for k, v in fields.items()})
+
+    def put_reply(self, mask, **fields):
+        """Reply to raft id fields['to'] — dst slot = to-1 (canonical)."""
+        to = jnp.broadcast_to(jnp.asarray(fields["to"]), mask.shape)
+        dst = jnp.clip(to - 1, 0, self.v - 1)
+        sel = (
+            mask[:, None]
+            & ohm.onehot(dst, self.v)
+            & ((to >= 1) & (to <= self.v))[:, None]
+        )
+        fields_nv = {}
+        for k, v in fields.items():
+            if k == "to":
+                continue
+            x = jnp.asarray(v)
+            if x.ndim >= 1 and x.shape[0] == self.n:
+                x = x[:, None] if x.ndim == 1 else x[:, None, ...]
+            fields_nv[k] = x
+        self._put_nv(sel, fields_nv)
+
+    def put_self(self, mask, **fields):
+        """Queue the after-append self-ack (kind/term/index only)."""
+        s = self.fab.self_
+        mtype = jnp.broadcast_to(jnp.asarray(fields["type"]), mask.shape).astype(I32)
+        term = jnp.broadcast_to(jnp.asarray(fields.get("term", 0)), mask.shape).astype(I32)
+        index = jnp.broadcast_to(jnp.asarray(fields.get("index", 0)), mask.shape).astype(I32)
+        self.fab = dataclasses.replace(
+            self.fab,
+            self_=SelfMsg(
+                kind=jnp.where(mask, mtype, s.kind),
+                term=jnp.where(mask, term, s.term),
+                index=jnp.where(mask, index, s.index),
+            ),
+        )
+
+
+# --------------------------------------------------------------------------
+# helpers
+
+
+def _w(mask, new, old):
+    return jnp.where(mask, new, old)
+
+
+def _select_row(chan, win, any_win):
+    """Compose the [N] message view of each lane's winning member slot:
+    one-hot select over the member axis; absent lanes read zeros."""
+    v = chan.kind.shape[1]
+    sel = ohm.onehot(jnp.clip(win, 0), v) & any_win[:, None]  # [N, V]
+
+    def g(x):
+        cast = x.dtype == BOOL
+        xi = x.astype(I32) if cast else x
+        s = sel if x.ndim == 2 else sel[:, :, None]
+        got = jnp.sum(jnp.where(s, xi, 0), axis=1)
+        return got.astype(BOOL) if cast else got
+
+    return jax.tree.map(g, chan)
+
+
+class LocalOps(NamedTuple):
+    """Host-injected per-round local inputs (all optional zeros)."""
+
+    hup: Any  # [N] bool - MsgHup
+    prop_n: Any  # [N] i32 number of entries to propose this round
+    prop_bytes: Any  # [N] i32 payload size per entry
+    transfer_to: Any  # [N] i32 raft id (0 = none) - MsgTransferLeader
+    read_ctx: Any  # [N] i32 ctx ticket (0 = none) - MsgReadIndex at leader
+    forget: Any  # [N] bool - MsgForgetLeader
+
+
+def no_ops(n: int) -> LocalOps:
+    z = jnp.zeros((n,), I32)
+    zb = jnp.zeros((n,), BOOL)
+    return LocalOps(zb, z, z, z, z, zb)
+
+
+# --------------------------------------------------------------------------
+# the fused round
+
+
+def fused_round(
+    state: RaftState,
+    inb: Fabric,
+    ops: LocalOps,
+    mute=None,
+    *,
+    do_tick: bool = True,
+    auto_propose: bool = False,
+    auto_compact_lag: int | None = None,
+) -> tuple[RaftState, Fabric]:
+    """One complete synchronous round for every lane. Returns the next state
+    and the outbox fabric (route with route_fabric before the next round)."""
+    n, v = state.prs_id.shape
+    e = inb.rep.ent_term.shape[-1]
+    out = ChannelOutbox(state, e)
+    lanes_v = jnp.arange(v, dtype=I32)[None, :]
+    ss = stepmod.self_slot(state)
+    is_self = lanes_v == ss[:, None]
+
+    send_sel = jnp.zeros((n, v), BOOL)
+    send_sie = jnp.zeros((n, v), BOOL)
+
+    def want_send(cells, sie=None):
+        nonlocal send_sel, send_sie
+        send_sel = send_sel | cells
+        send_sie = send_sie | (cells if sie is None else (cells & sie))
+
+    # ---- tick (reference: raft.go:823-862) ----
+    fire_hup = jnp.zeros((n,), BOOL)
+    fire_beat = jnp.zeros((n,), BOOL)
+    fire_cq = jnp.zeros((n,), BOOL)
+    if do_tick:
+        is_leader0 = state.state == StateType.LEADER
+        ee = state.election_elapsed + 1
+        he = jnp.where(is_leader0, state.heartbeat_elapsed + 1, state.heartbeat_elapsed)
+        fire_hup = (
+            ~is_leader0
+            & stepmod.promotable(state)
+            & (ee >= state.randomized_election_timeout)
+        )
+        lead_etick = is_leader0 & (ee >= state.cfg.election_tick)
+        fire_cq = lead_etick & state.cfg.check_quorum
+        ee = jnp.where(fire_hup | lead_etick, 0, ee)
+        fire_beat = is_leader0 & (he >= state.cfg.heartbeat_tick)
+        he = jnp.where(fire_beat, 0, he)
+        state = dataclasses.replace(
+            state,
+            election_elapsed=ee,
+            heartbeat_elapsed=he,
+            lead_transferee=_w(lead_etick, 0, state.lead_transferee),
+        )
+
+    # ---- presence ----
+    rep_p = inb.rep.kind != MT.MSG_NONE
+    hb_p = inb.hb.kind != MT.MSG_NONE
+    vote_p = inb.vote.kind != MT.MSG_NONE
+    vresp_p = inb.vresp.kind != MT.MSG_NONE
+    self_p = inb.self_.kind != MT.MSG_NONE
+
+    # ---- term ladder (reference: raft.go:1053-1139) ----
+    # keep-term messages never bump us: PreVote requests and granted
+    # PreVote responses (raft.go:1069-1086).
+    keep_vote = inb.vote.kind == MT.MSG_PRE_VOTE
+    keep_vresp = (inb.vresp.kind == MT.MSG_PRE_VOTE_RESP) & ~inb.vresp.reject
+    # in-lease vote-request rejection (raft.go:1057-1066)
+    force = inb.vote.context == CampaignType.TRANSFER
+    in_lease = (
+        state.cfg.check_quorum
+        & (state.lead != 0)
+        & (state.election_elapsed < state.cfg.election_tick)
+    )
+    is_vreq = (inb.vote.kind == MT.MSG_VOTE) | (inb.vote.kind == MT.MSG_PRE_VOTE)
+    lease_ignored = (
+        vote_p
+        & is_vreq
+        & (inb.vote.term > state.term[:, None])
+        & ~force
+        & in_lease[:, None]
+    )
+
+    rep_bump = jnp.max(jnp.where(rep_p, inb.rep.term, 0), axis=1)
+    hb_bump = jnp.max(jnp.where(hb_p, inb.hb.term, 0), axis=1)
+    vote_bump = jnp.max(
+        jnp.where(vote_p & ~keep_vote & ~lease_ignored, inb.vote.term, 0), axis=1
+    )
+    vresp_bump = jnp.max(
+        jnp.where(vresp_p & ~keep_vresp, inb.vresp.term, 0), axis=1
+    )
+    self_bump = jnp.where(
+        self_p & (inb.self_.kind == MT.MSG_APP_RESP), inb.self_.term, 0
+    )
+    t_new = jnp.maximum(
+        jnp.maximum(rep_bump, hb_bump),
+        jnp.maximum(jnp.maximum(vote_bump, vresp_bump), self_bump),
+    )
+    step_down = t_new > state.term
+    # leader attribution: an append-family or heartbeat sender at t_new
+    from_ldr_rep = rep_p & (inb.rep.term == t_new[:, None]) & (
+        (inb.rep.kind == MT.MSG_APP) | (inb.rep.kind == MT.MSG_SNAP)
+    )
+    from_ldr_hb = hb_p & (inb.hb.term == t_new[:, None]) & (
+        inb.hb.kind == MT.MSG_HEARTBEAT
+    )
+    ldr_member = jnp.max(
+        jnp.where(from_ldr_rep | from_ldr_hb, lanes_v + 1, 0), axis=1
+    )  # raft id or 0
+    state = stepmod.become_follower(state, step_down, t_new, ldr_member)
+
+    # lower-term handling (raft.go:1087-1139)
+    low_rep = rep_p & (inb.rep.term < state.term[:, None])
+    low_hb = hb_p & (inb.hb.term < state.term[:, None])
+    low_vote = vote_p & (inb.vote.term < state.term[:, None])
+    ping = (state.cfg.check_quorum | state.cfg.pre_vote)[:, None] & (
+        (low_rep & (inb.rep.kind == MT.MSG_APP)) | (low_hb & (inb.hb.kind == MT.MSG_HEARTBEAT))
+    )
+    out._put_nv(
+        ping,
+        {
+            "type": jnp.full((n, v), MT.MSG_APP_RESP, I32),
+            "term": state.term[:, None],
+        },
+    )
+    low_prevote = low_vote & (inb.vote.kind == MT.MSG_PRE_VOTE)
+    out._put_nv(
+        low_prevote,
+        {
+            "type": jnp.full((n, v), MT.MSG_PRE_VOTE_RESP, I32),
+            "term": state.term[:, None],
+            "reject": jnp.ones((n, v), BOOL),
+        },
+    )
+    rep_live = rep_p & ~low_rep
+    hb_live = hb_p & ~low_hb
+    vote_live = vote_p & ~low_vote & ~lease_ignored
+
+    # ---- winning append-family message (reference: raft.go:1732-1795) ----
+    app_cell = rep_live & (
+        (inb.rep.kind == MT.MSG_APP) | (inb.rep.kind == MT.MSG_SNAP)
+    ) & (inb.rep.term == state.term[:, None])
+    any_app = app_cell.any(axis=1)
+    win = jnp.argmax(app_cell, axis=1).astype(I32)  # first hot slot
+    mrow = _select_row(inb.rep, win, any_app)
+    m_frm = jnp.where(any_app, win + 1, 0)
+
+    #   candidates step down on current-term append traffic (raft.go:1639-1647)
+    is_cand = (state.state == StateType.CANDIDATE) | (
+        state.state == StateType.PRE_CANDIDATE
+    )
+    state = stepmod.become_follower(state, any_app & is_cand, state.term, m_frm)
+    #   followers adopt the leader + reset timer (raft.go:1681-1692)
+    adopt = any_app & (state.state == StateType.FOLLOWER)
+    state = dataclasses.replace(
+        state,
+        lead=_w(adopt, m_frm, state.lead),
+        election_elapsed=_w(adopt, 0, state.election_elapsed),
+    )
+    msg_ns = SimpleNamespace(
+        frm=m_frm,
+        index=mrow.index,
+        log_term=mrow.log_term,
+        commit=mrow.commit,
+        n_ents=mrow.n_ents,
+        ent_term=mrow.ent_term,
+        ent_type=mrow.ent_type,
+        ent_bytes=mrow.ent_bytes,
+        snap_index=mrow.snap_index,
+        snap_term=mrow.snap_term,
+        context=jnp.zeros((n,), I32),
+    )
+    is_app = any_app & (mrow.kind == MT.MSG_APP) & (state.state == StateType.FOLLOWER)
+    state = stepmod.handle_append_entries(state, is_app, msg_ns, out)
+    is_snap = any_app & (mrow.kind == MT.MSG_SNAP)
+    state = stepmod.handle_snapshot(state, is_snap, msg_ns, out)
+    #   in-fabric snapshot transport is instantaneous: the restore IS the
+    #   persisted application (sync model), so clear the pending marker
+    applied_snap = is_snap & (state.pending_snap_index != 0)
+    state = dataclasses.replace(
+        state,
+        applied=_w(applied_snap, jnp.maximum(state.applied, state.pending_snap_index), state.applied),
+        applying=_w(applied_snap, jnp.maximum(state.applying, state.pending_snap_index), state.applying),
+        pending_snap_index=_w(applied_snap, 0, state.pending_snap_index),
+        pending_snap_term=_w(applied_snap, 0, state.pending_snap_term),
+    )
+
+    # ---- winning heartbeat (reference: raft.go:1772-1775) ----
+    hb_cell = hb_live & (inb.hb.kind == MT.MSG_HEARTBEAT) & (
+        inb.hb.term == state.term[:, None]
+    )
+    any_hb = hb_cell.any(axis=1)
+    hwin = jnp.argmax(hb_cell, axis=1).astype(I32)
+    hrow = _select_row(inb.hb, hwin, any_hb)
+    h_frm = jnp.where(any_hb, hwin + 1, 0)
+    state = stepmod.become_follower(state, any_hb & is_cand, state.term, h_frm)
+    adopt_h = any_hb & (state.state == StateType.FOLLOWER)
+    state = dataclasses.replace(
+        state,
+        lead=_w(adopt_h, h_frm, state.lead),
+        election_elapsed=_w(adopt_h, 0, state.election_elapsed),
+    )
+    hb_ns = SimpleNamespace(frm=h_frm, commit=hrow.commit, context=hrow.context)
+    state = stepmod.handle_heartbeat(
+        state, any_hb & (state.state == StateType.FOLLOWER), hb_ns, out
+    )
+
+    # ---- vote casting: grant at most one candidate (raft.go:1164-1212) ----
+    vreq_cell = vote_live & is_vreq
+    # VOTE requests bumped us to their term already; PREVOTE asks for term+1
+    cur = vreq_cell & (
+        ((inb.vote.kind == MT.MSG_VOTE) & (inb.vote.term == state.term[:, None]))
+        | ((inb.vote.kind == MT.MSG_PRE_VOTE) & (inb.vote.term > state.term[:, None]))
+    )
+    cand_id = lanes_v + 1
+    can_vote = (
+        (state.vote[:, None] == cand_id)
+        | ((state.vote == 0) & (state.lead == 0))[:, None]
+        | ((inb.vote.kind == MT.MSG_PRE_VOTE) & (inb.vote.term > state.term[:, None]))
+    )
+    # up-to-date evaluated per candidate cell (reference log.go:428-433)
+    lt = lg.last_term(state)[:, None]
+    up2d_cell = (inb.vote.log_term > lt) | (
+        (inb.vote.log_term == lt) & (inb.vote.index >= state.last[:, None])
+    )
+    grantable = cur & can_vote & up2d_cell
+    any_grant = grantable.any(axis=1)
+    gwin = jnp.argmax(grantable, axis=1).astype(I32)
+    grant_cell = grantable & (lanes_v == gwin[:, None]) & any_grant[:, None]
+    resp_kind = jnp.where(
+        inb.vote.kind == MT.MSG_PRE_VOTE,
+        jnp.int32(MT.MSG_PRE_VOTE_RESP),
+        jnp.int32(MT.MSG_VOTE_RESP),
+    )
+    out._put_nv(
+        grant_cell,
+        {"type": resp_kind, "term": inb.vote.term},
+    )
+    out._put_nv(
+        vreq_cell & ~grant_cell,
+        {
+            "type": resp_kind,
+            "term": state.term[:, None],
+            "reject": jnp.ones((n, v), BOOL),
+        },
+    )
+    real_grant = (grant_cell & (inb.vote.kind == MT.MSG_VOTE)).any(axis=1)
+    state = dataclasses.replace(
+        state,
+        vote=_w(real_grant, gwin + 1, state.vote),
+        election_elapsed=_w(real_grant, 0, state.election_elapsed),
+    )
+
+    # ---- TimeoutNow -> transfer campaign (raft.go:1713-1719) ----
+    ton = (
+        vote_live
+        & (inb.vote.kind == MT.MSG_TIMEOUT_NOW)
+        & (inb.vote.term == state.term[:, None])
+    ).any(axis=1) & (state.state == StateType.FOLLOWER)
+
+    # ---- leader fan-in -------------------------------------------------
+    is_leader = state.state == StateType.LEADER
+
+    # Transport feedback: the fabric IS the transport, so snapshot transfer
+    # outcomes are known at the next round — the reference's app-side
+    # ReportSnapshot -> MsgSnapStatus flow (raft.go:1562-1579) collapses to:
+    # muted peer => failure (clear PendingSnapshot), reachable peer =>
+    # success (keep it: BecomeProbe resumes at pending+1). Both: probe+pause.
+    in_snap = is_leader[:, None] & (state.pr_state == ProgressState.SNAPSHOT)
+    if mute is not None:
+        g = n // v
+        peer_mute = jnp.broadcast_to(mute.reshape(g, 1, v), (g, v, v)).reshape(n, v)
+        snap_fail = in_snap & (mute[:, None] | peer_mute)
+        state = dataclasses.replace(
+            state,
+            pr_pending_snapshot=_w(snap_fail, 0, state.pr_pending_snapshot),
+        )
+    state = pg.become_probe(state, in_snap)
+    state = dataclasses.replace(
+        state,
+        pr_msg_app_flow_paused=_w(in_snap, True, state.pr_msg_app_flow_paused),
+    )
+
+    # MsgAppResp cells, including the self-ack (reference: raft.go:1333-1526)
+    ar_cell = (
+        rep_live
+        & (inb.rep.kind == MT.MSG_APP_RESP)
+        & (inb.rep.term == state.term[:, None])
+        & is_leader[:, None]
+    )
+    self_ar = (
+        self_p
+        & (inb.self_.kind == MT.MSG_APP_RESP)
+        & (inb.self_.term == state.term)
+        & is_leader
+    )
+    ar_all = ar_cell | (self_ar[:, None] & is_self)
+    ar_index = jnp.where(
+        self_ar[:, None] & is_self, inb.self_.index[:, None], inb.rep.index
+    )
+    state = dataclasses.replace(
+        state, pr_recent_active=_w(ar_all, True, state.pr_recent_active)
+    )
+
+    rej_cell = ar_cell & inb.rep.reject
+    acc_cell = ar_all & ~(ar_cell & inb.rep.reject)
+
+    def handle_rejections(st):
+        next_probe = jnp.where(
+            inb.rep.log_term > 0,
+            _fcbt_nv(st, inb.rep.reject_hint, inb.rep.log_term),
+            inb.rep.reject_hint,
+        )
+        st, decreased = pg.maybe_decr_to(st, rej_cell, ar_index, next_probe)
+        dec_repl = decreased & (st.pr_state == ProgressState.REPLICATE)
+        st = pg.become_probe(st, dec_repl)
+        return st, decreased
+
+    # rejections are rare in steady state; the whole block is conditional
+    any_rej = rej_cell.any()
+    state, decreased = jax.lax.cond(
+        any_rej,
+        handle_rejections,
+        # derive the no-op mask from rej_cell so its type (incl. shard_map
+        # varying-axis annotation) matches the true branch
+        lambda st: (st, rej_cell & False),
+        state,
+    )
+    want_send(decreased)
+
+    old_paused = pg.is_paused(state)
+    state, updated = pg.maybe_update(state, acc_cell, ar_index)
+    probe_refresh = (
+        acc_cell
+        & (state.pr_match == ar_index)
+        & (state.pr_state == ProgressState.PROBE)
+    )
+    advanced = updated | probe_refresh
+    from_probe = advanced & (state.pr_state == ProgressState.PROBE)
+    state = pg.become_replicate(state, from_probe)
+    from_snap = (
+        advanced
+        & (state.pr_state == ProgressState.SNAPSHOT)
+        & (state.pr_match + 1 >= state.first_index[:, None])
+    )
+    state = pg.become_probe(state, from_snap)
+    state = pg.become_replicate(state, from_snap)
+    in_repl = advanced & (state.pr_state == ProgressState.REPLICATE)
+    state = pg.inflights_free_le(state, in_repl, ar_index)
+
+    advanced_lane = advanced.any(axis=1)
+    mci = qr.joint_committed(
+        jnp.where(stepmod.voter_mask(state), state.pr_match, 0),
+        state.voters_in,
+        state.voters_out,
+    )
+    state, committed_adv = lg.maybe_commit(
+        state, jnp.where(advanced_lane, mci, 0), state.term
+    )
+    all_peers = jnp.ones((n, v), BOOL)
+    want_send(committed_adv[:, None] & all_peers)
+    retry = advanced & ~committed_adv[:, None] & ~is_self
+    want_send(retry, old_paused)
+
+    # leadership transfer completion (raft.go:1519-1524)
+    xfer_cell = (
+        acc_cell
+        & advanced
+        & ((lanes_v + 1) == state.lead_transferee[:, None])
+        & (state.pr_match == state.last[:, None])
+    )
+    out._put_nv(
+        xfer_cell,
+        {"type": jnp.full((n, v), MT.MSG_TIMEOUT_NOW, I32), "term": state.term[:, None]},
+    )
+
+    # MsgHeartbeatResp cells (raft.go:1527-1561)
+    hr_cell = (
+        hb_live
+        & (inb.hb.kind == MT.MSG_HEARTBEAT_RESP)
+        & (inb.hb.term == state.term[:, None])
+        & is_leader[:, None]
+    )
+    state = dataclasses.replace(
+        state,
+        pr_recent_active=_w(hr_cell, True, state.pr_recent_active),
+        pr_msg_app_flow_paused=_w(hr_cell, False, state.pr_msg_app_flow_paused),
+    )
+    need_app = hr_cell & (
+        (state.pr_match < state.last[:, None])
+        | (state.pr_state == ProgressState.PROBE)
+    )
+    want_send(need_app)
+
+    # ReadIndex acks via heartbeat ctx (raft.go:1548-1561, read_only.go)
+    r_ax = state.ro_ctx.shape[1]
+    hit = (
+        hr_cell[:, None, :]
+        & (state.ro_ctx[:, :, None] == inb.hb.context[:, None, :])
+        & (state.ro_ctx[:, :, None] != 0)
+    )  # [N, R, V]
+    acks = state.ro_acks | hit
+    ro_votes = jnp.where(acks, jnp.int32(VoteState.GRANTED), jnp.int32(VoteState.PENDING))
+    ro_res = qr.joint_vote(
+        ro_votes, state.voters_in[:, None, :], state.voters_out[:, None, :]
+    )
+    release = (state.ro_ctx != 0) & (ro_res == VoteResult.VOTE_WON) & hit.any(axis=2)
+    # all released slots emit ReadStates this round (requester = self in the
+    # fused model); pack into the rs ring
+    rel_rank = jnp.cumsum(release.astype(I32), axis=1) - 1
+    dst_slot = state.rs_count[:, None] + rel_rank
+    put = release & (dst_slot < r_ax)
+    state = dataclasses.replace(
+        state,
+        rs_ctx=ohm.scatter_set(state.rs_ctx, jnp.clip(dst_slot, 0, r_ax - 1), state.ro_ctx, put),
+        rs_index=ohm.scatter_set(state.rs_index, jnp.clip(dst_slot, 0, r_ax - 1), state.ro_index, put),
+        rs_count=jnp.minimum(state.rs_count + jnp.sum(put.astype(I32), axis=1), r_ax),
+        ro_ctx=_w(release, 0, state.ro_ctx),
+        ro_from=_w(release, 0, state.ro_from),
+        ro_index=_w(release, 0, state.ro_index),
+        ro_acks=jnp.where(release[:, :, None], False, acks),
+    )
+
+    # Msg(Pre)VoteResp cells -> poll (raft.go:1041-1049, 1647-1666)
+    my_resp = jnp.where(
+        state.state == StateType.PRE_CANDIDATE,
+        jnp.int32(MT.MSG_PRE_VOTE_RESP),
+        jnp.int32(MT.MSG_VOTE_RESP),
+    )
+    vresp_live = vresp_p & ~(
+        vresp_p & (inb.vresp.term < state.term[:, None])
+    )
+    vr_cell = vresp_live & (inb.vresp.kind == my_resp[:, None]) & is_cand[:, None]
+    self_vr = self_p & (
+        (inb.self_.kind == my_resp) & is_cand
+    )
+    vr_all = vr_cell | (self_vr[:, None] & is_self)
+    vr_rej = vr_cell & inb.vresp.reject  # self vote never rejects
+    state = dataclasses.replace(
+        state,
+        votes=jnp.where(
+            vr_all & stepmod.voter_mask(state),
+            jnp.where(vr_rej, jnp.int32(VoteState.REJECTED), jnp.int32(VoteState.GRANTED)),
+            state.votes,
+        ),
+    )
+    res = qr.joint_vote(state.votes, state.voters_in, state.voters_out)
+    tallied = vr_all.any(axis=1) & is_cand
+    won = tallied & (res == VoteResult.VOTE_WON)
+    lost = tallied & (res == VoteResult.VOTE_LOST)
+    pre_won = won & (state.state == StateType.PRE_CANDIDATE)
+    real_won = won & (state.state == StateType.CANDIDATE)
+    state = stepmod.become_leader(state, real_won, out)
+    want_send(real_won[:, None] & all_peers)
+    state = stepmod.become_follower(state, lost, state.term, jnp.zeros((n,), I32))
+
+    # ---- local inputs ---------------------------------------------------
+    # campaign: ticks, injected hups, TimeoutNow transfers, PreVote wins
+    ctype = jnp.where(
+        state.cfg.pre_vote,
+        jnp.int32(CampaignType.PRE_ELECTION),
+        jnp.int32(CampaignType.ELECTION),
+    )
+    ctype = jnp.where(ton, jnp.int32(CampaignType.TRANSFER), ctype)
+    ctype = jnp.where(pre_won, jnp.int32(CampaignType.ELECTION), ctype)
+    # hup() itself guards against leaders/learners/pending conf changes
+    state = stepmod.hup(state, fire_hup | ops.hup | ton | pre_won, ctype, out)
+
+    # CheckQuorum (raft.go:1231-1243)
+    is_leader = state.state == StateType.LEADER
+    cq = fire_cq & is_leader
+    active_m = state.pr_recent_active | is_self
+    alive = qr.joint_active(active_m, state.voters_in, state.voters_out)
+    state = stepmod.become_follower(state, cq & ~alive, state.term, jnp.zeros((n,), I32))
+    state = dataclasses.replace(
+        state,
+        pr_recent_active=_w(cq[:, None] & ~is_self, False, state.pr_recent_active),
+    )
+
+    # heartbeats (MsgBeat, raft.go:1228-1230)
+    is_leader = state.state == StateType.LEADER
+    state = stepmod.bcast_heartbeat(state, fire_beat & is_leader, out)
+
+    # proposals (raft.go:1244-1302; conf-change entries excluded by scope)
+    prop_n = jnp.where(auto_propose, jnp.maximum(ops.prop_n, is_leader.astype(I32)), ops.prop_n)
+    prop = (
+        (prop_n > 0)
+        & is_leader
+        & (state.lead_transferee == 0)
+        & (ss >= 0)
+    )
+    k = jnp.arange(e, dtype=I32)[None, :]
+    pn = jnp.minimum(prop_n, e)
+    ent_bytes = jnp.where(
+        (k < pn[:, None]) & prop[:, None], ops.prop_bytes[:, None], 0
+    )
+    zeros_e = jnp.zeros((n, e), I32)
+    state, appended = stepmod.append_entry(
+        state, prop, zeros_e, zeros_e, ent_bytes, pn, out
+    )
+    want_send(appended[:, None] & all_peers)
+
+    # transfer-leadership request (raft.go:1587-1618), injected at the leader
+    tt = ops.transfer_to
+    t_ok = (
+        is_leader
+        & (tt != 0)
+        & (tt != state.lead_transferee)
+        & (tt != state.id)
+        & (tt >= 1)
+        & (tt <= v)
+    )
+    t_slot = jnp.clip(tt - 1, 0, v - 1)
+    t_cell = ohm.onehot(t_slot, v) & t_ok[:, None]
+    state = dataclasses.replace(
+        state,
+        election_elapsed=_w(t_ok, 0, state.election_elapsed),
+        lead_transferee=_w(t_ok, tt, state.lead_transferee),
+    )
+    t_ready = t_cell & (state.pr_match == state.last[:, None])
+    out._put_nv(
+        t_ready,
+        {"type": jnp.full((n, v), MT.MSG_TIMEOUT_NOW, I32), "term": state.term[:, None]},
+    )
+    want_send(t_cell & ~t_ready)
+
+    # ReadIndex at the leader (raft.go:1303-1332); single-voter/lease-based
+    # groups answer immediately, else enqueue + ctx'd heartbeat broadcast
+    ri = (ops.read_ctx != 0) & is_leader
+    committed_in_term = lg.term_at(state, state.committed) == state.term
+    ri_ok = ri & committed_in_term
+    n_in = jnp.sum(state.voters_in.astype(I32), axis=1)
+    n_out = jnp.sum(state.voters_out.astype(I32), axis=1)
+    single = (n_in <= 1) & (n_out == 0)
+    immediate = ri_ok & (single | state.cfg.read_only_lease_based)
+    enq = ri_ok & ~immediate
+    free = state.ro_ctx == 0
+    first_free = jnp.argmax(free, axis=1).astype(I32)
+    can_enq = enq & free.any(axis=1)
+    put_r = ohm.onehot(first_free, r_ax) & can_enq[:, None]
+    state = dataclasses.replace(
+        state,
+        ro_ctx=_w(put_r, ops.read_ctx[:, None], state.ro_ctx),
+        ro_from=_w(put_r, state.id[:, None], state.ro_from),
+        ro_index=_w(put_r, state.committed[:, None], state.ro_index),
+        ro_acks=_w(put_r[:, :, None], is_self[:, None, :], state.ro_acks),
+    )
+    state = stepmod.bcast_heartbeat(state, can_enq, out, ctx=ops.read_ctx)
+    # immediate release -> rs ring
+    imm_slot = jnp.clip(state.rs_count, 0, r_ax - 1)
+    imm_put = ohm.onehot(imm_slot, r_ax) & (immediate & (state.rs_count < r_ax))[:, None]
+    state = dataclasses.replace(
+        state,
+        rs_ctx=_w(imm_put, ops.read_ctx[:, None], state.rs_ctx),
+        rs_index=_w(imm_put, state.committed[:, None], state.rs_index),
+        rs_count=_w(
+            immediate & (state.rs_count < r_ax), state.rs_count + 1, state.rs_count
+        ),
+    )
+
+    # forget leader (raft.go:1700-1708)
+    state = dataclasses.replace(
+        state,
+        lead=_w(
+            ops.forget & (state.state == StateType.FOLLOWER) & (state.lead != 0),
+            0,
+            state.lead,
+        ),
+    )
+
+    # ---- the single coalesced append fan-out ----
+    state = stepmod.maybe_send_append(state, send_sel, send_sie, out)
+
+    # ---- synchronous persist + apply (doc.go:79-103 in the sync model) ----
+    state = dataclasses.replace(state, stabled=state.last)
+    applied_bytes = _bytes_between(state, state.applied, state.committed)
+    state = lg.applied_to(state, state.committed)
+    state = dataclasses.replace(
+        state,
+        uncommitted_size=jnp.clip(state.uncommitted_size - applied_bytes, 0),
+    )
+    if auto_compact_lag is not None:
+        # the continuous-serving analog of the app's CreateSnapshot/Compact
+        # loop (storage.go:227-272): snapshot at `applied` (what
+        # Storage.Snapshot() returns — always fresh in the sync model, so
+        # restored stragglers land within the retained window and switch to
+        # streaming), then compact the window keeping `lag` entries.
+        state = dataclasses.replace(
+            state,
+            avail_snap_index=state.applied,
+            avail_snap_term=lg.term_at(state, state.applied),
+        )
+        target = jnp.maximum(
+            state.snap_index, state.applied - jnp.int32(auto_compact_lag)
+        )
+        state = lg.compact(state, target, lg.term_at(state, target))
+    return state, out.fab
+
+
+def _fcbt_nv(state: RaftState, index_nv, term_nv):
+    """find_conflict_by_term over [N, V] (leader-side rejection hints;
+    reference log.go:166-194). Masked max over the window per cell."""
+    n, v = index_nv.shape
+    idx_w, valid_w = lg.window_indexes(state)  # [N, W]
+    cand = (
+        valid_w[:, None, :]
+        & (idx_w[:, None, :] <= index_nv[:, :, None])
+        & (state.log_term[:, None, :] <= term_nv[:, :, None])
+    )
+    best = jnp.max(jnp.where(cand, idx_w[:, None, :], -1), axis=-1)
+    snap_ok = (state.snap_index[:, None] <= index_nv) & (
+        state.snap_term[:, None] <= term_nv
+    )
+    best = jnp.maximum(best, jnp.where(snap_ok, state.snap_index[:, None], -1))
+    above = index_nv > state.last[:, None]
+    best = jnp.where(above, index_nv, best)
+    below = jnp.minimum(index_nv, state.snap_index[:, None] - 1)
+    best = jnp.where(best < 0, jnp.maximum(below, 0), best)
+    return jnp.maximum(best, 0)
+
+
+def _bytes_between(state: RaftState, lo, hi):
+    idx, valid = lg.window_indexes(state)
+    m = valid & (idx > lo[:, None]) & (idx <= hi[:, None])
+    return jnp.sum(jnp.where(m, state.log_bytes, 0), axis=1)
+
+
+# --------------------------------------------------------------------------
+# scan driver
+
+
+def fused_rounds(
+    state: RaftState,
+    fab: Fabric,
+    ops: LocalOps,
+    mute,
+    *,
+    v: int,
+    n_rounds: int,
+    do_tick: bool = True,
+    auto_propose: bool = False,
+    auto_compact_lag: int | None = None,
+    ops_first_round_only: bool = True,
+):
+    """n_rounds fused rounds in one dispatch. `ops` applies to the first
+    round only (one-shot injections) unless ops_first_round_only=False."""
+
+    def body(carry, i):
+        st, f = carry
+        o = ops
+        if ops_first_round_only:
+            first = i == 0
+            o = jax.tree.map(
+                lambda x: jnp.where(
+                    first, x, jnp.zeros_like(x)
+                ),
+                ops,
+            )
+        inb = route_fabric(f, v, mute)
+        st, f = fused_round(
+            st,
+            inb,
+            o,
+            mute,
+            do_tick=do_tick,
+            auto_propose=auto_propose,
+            auto_compact_lag=auto_compact_lag,
+        )
+        return (st, f), None
+
+    (state, fab), _ = jax.lax.scan(
+        body, (state, fab), jnp.arange(n_rounds, dtype=I32)
+    )
+    return state, fab
+
+
+_fused_rounds_jit = jax.jit(
+    fused_rounds,
+    static_argnames=(
+        "v",
+        "n_rounds",
+        "do_tick",
+        "auto_propose",
+        "auto_compact_lag",
+        "ops_first_round_only",
+    ),
+)
+
+
+class FusedCluster:
+    """G raft groups x V voters on the fused round kernel: one device
+    dispatch per block of rounds, message routing as an in-device transpose.
+    The throughput engine behind bench.py; the serial Cluster remains the
+    conformance-exact path."""
+
+    def __init__(self, n_groups: int, n_voters: int, seed: int = 1, shape=None, **cfg):
+        import numpy as np
+
+        from raft_tpu.config import Shape
+        from raft_tpu.state import init_state, make_lane_config
+
+        self.g, self.v = n_groups, n_voters
+        n = n_groups * n_voters
+        self.shape = shape or Shape(n_lanes=n, max_peers=n_voters)
+        if self.shape.n_lanes != n or self.shape.v != n_voters:
+            raise ValueError("fused layout requires n_lanes=G*V, max_peers=V")
+        ids = np.tile(np.arange(1, n_voters + 1, dtype=np.int32), n_groups)
+        peers = np.zeros((n, n_voters), np.int32)
+        peers[:, :] = np.arange(1, n_voters + 1, dtype=np.int32)[None, :]
+        lane_cfg = make_lane_config(self.shape, **cfg)
+        self.state = init_state(self.shape, ids, peers, seed=seed, cfg=lane_cfg)
+        self.fab = empty_fabric(n, n_voters, self.shape.max_msg_entries)
+        self.mute = jnp.zeros((n,), BOOL)
+
+    # -- driving ----------------------------------------------------------
+
+    def run(
+        self,
+        rounds: int = 1,
+        ops: LocalOps | None = None,
+        do_tick: bool = True,
+        auto_propose: bool = False,
+        auto_compact_lag: int | None = None,
+    ):
+        if ops is None:
+            ops = no_ops(self.state.id.shape[0])
+        self.state, self.fab = _fused_rounds_jit(
+            self.state,
+            self.fab,
+            ops,
+            self.mute,
+            v=self.v,
+            n_rounds=rounds,
+            do_tick=do_tick,
+            auto_propose=auto_propose,
+            auto_compact_lag=auto_compact_lag,
+        )
+
+    def ops(self, **kw) -> LocalOps:
+        """Build a LocalOps with the given per-lane columns set. Values may
+        be dicts {lane: value} or full arrays."""
+        import numpy as np
+
+        n = self.state.id.shape[0]
+        base = {f: np.zeros((n,), np.bool_ if f in ("hup", "forget") else np.int32)
+                for f in LocalOps._fields}
+        for k, val in kw.items():
+            if isinstance(val, dict):
+                for lane, x in val.items():
+                    base[k][lane] = x
+            else:
+                base[k][:] = val
+        return LocalOps(**{k: jnp.asarray(x) for k, x in base.items()})
+
+    def campaign(self, lane: int):
+        self.run(1, ops=self.ops(hup={lane: True}), do_tick=False)
+
+    def set_mute(self, lanes, on: bool = True):
+        import numpy as np
+
+        m = np.asarray(self.mute)
+        m = m.copy()
+        m[np.asarray(lanes)] = on
+        self.mute = jnp.asarray(m)
+
+    # -- inspection -------------------------------------------------------
+
+    def leader_lanes(self):
+        import numpy as np
+
+        return np.nonzero(np.asarray(self.state.state) == int(StateType.LEADER))[0]
+
+    def lanes_of_group(self, g: int):
+        return slice(g * self.v, (g + 1) * self.v)
+
+    def check_no_errors(self):
+        import numpy as np
+
+        bits = np.asarray(self.state.error_bits)
+        assert (bits == 0).all(), (
+            f"error_bits set: lanes {np.nonzero(bits)[0].tolist()}"
+        )

@@ -36,6 +36,27 @@ from raft_tpu.types import MessageType as MT, StateType
 I32 = jnp.int32
 
 
+def make_group_mesh(devices, n_lanes: int):
+    """(mesh, lane_sharding, shard_lanes): the standard 1-D "groups" mesh and
+    the device_put rule shared by every sharded engine — arrays whose leading
+    dim is the lane count shard over the mesh, everything else replicates."""
+    mesh = Mesh(np.asarray(devices), ("groups",))
+    lane_sharding = NamedSharding(mesh, P("groups"))
+    repl_sharding = NamedSharding(mesh, P())
+
+    def shard_lanes(x):
+        if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == n_lanes:
+            return jax.device_put(x, lane_sharding)
+        return jax.device_put(x, repl_sharding)
+
+    return mesh, lane_sharding, shard_lanes
+
+
+def lane_specs(tree):
+    """PartitionSpec tree: every leaf sharded over the "groups" axis."""
+    return jax.tree.map(lambda _: P("groups"), tree)
+
+
 def _round_body(
     state, inbox, group_of, lane_of, *, m_in, do_tick, lanes_per_shard, v
 ):
@@ -69,18 +90,12 @@ class ShardedCluster(Cluster):
         if n_groups % len(devices):
             raise ValueError("n_groups must divide evenly over devices")
         super().__init__(n_groups, n_voters, **kw)
-        self.mesh = Mesh(np.asarray(devices), ("groups",))
-        self.lane_sharding = NamedSharding(self.mesh, P("groups"))
-        self.repl_sharding = NamedSharding(self.mesh, P())
         n = self.shape.n
+        self.mesh, self.lane_sharding, shard_lanes = make_group_mesh(devices, n)
+        self.repl_sharding = NamedSharding(self.mesh, P())
         self.lanes_per_shard = n // len(devices)
         if (n_groups // len(devices)) * n_voters != self.lanes_per_shard:
             raise ValueError("groups must not straddle shard boundaries")
-
-        def shard_lanes(x):
-            if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == n:
-                return jax.device_put(x, self.lane_sharding)
-            return jax.device_put(x, self.repl_sharding)
 
         self.state = jax.tree.map(shard_lanes, self.state)
         self.group_of = jax.device_put(self.group_of, self.lane_sharding)
@@ -90,23 +105,18 @@ class ShardedCluster(Cluster):
     def _shard_mapped(self, fn):
         """shard_map + jit `fn(state, inbox, group_of, lane_of)` with the
         cluster's lane-sharded in/out specs (dropped counter replicated)."""
-        lane = P("groups")
-
-        def spec_like(tree):
-            return jax.tree.map(lambda _: lane, tree)
-
         sm = shard_map(
             fn,
             mesh=self.mesh,
             in_specs=(
-                spec_like(self.state),
-                spec_like(self._pending),
-                lane,
+                lane_specs(self.state),
+                lane_specs(self._pending),
+                P("groups"),
                 P(),
             ),
             out_specs=(
-                spec_like(self.state),
-                spec_like(self._pending),
+                lane_specs(self.state),
+                lane_specs(self._pending),
                 P(),
             ),
         )
@@ -193,3 +203,71 @@ class ShardedCluster(Cluster):
         self.state = state
         self._pending = jax.tree.map(lambda x: np.array(x), pending)
         self.dropped += int(total_dropped)
+
+
+class ShardedFusedCluster:
+    """The fused round kernel under shard_map over a device mesh.
+
+    Groups are distributed over the mesh's "groups" axis; the fused round
+    body — including its transpose-routing — touches only lanes of one
+    group, so the per-shard program has NO collectives at all and scales
+    linearly over ICI (the dropped-counter psum of the serial path does not
+    exist here: the fabric never drops).
+    """
+
+    def __init__(self, n_groups: int, n_voters: int, devices=None, seed: int = 1, **cfg):
+        from raft_tpu.ops.fused import FusedCluster, no_ops
+
+        devices = devices if devices is not None else jax.devices()
+        if n_groups % len(devices):
+            raise ValueError("n_groups must divide evenly over devices")
+        self.inner = FusedCluster(n_groups, n_voters, seed=seed, **cfg)
+        self.g, self.v = n_groups, n_voters
+        n = n_groups * n_voters
+        self.mesh, self.lane_sharding, shard_lanes = make_group_mesh(devices, n)
+        self.inner.state = jax.tree.map(shard_lanes, self.inner.state)
+        self.inner.fab = jax.tree.map(shard_lanes, self.inner.fab)
+        self.inner.mute = jax.device_put(self.inner.mute, self.lane_sharding)
+        self._no_ops = jax.tree.map(shard_lanes, no_ops(n))
+        self._shard_lanes = shard_lanes
+        self._cache = {}
+
+    def run(self, rounds: int = 1, ops=None, do_tick: bool = True,
+            auto_propose: bool = False, auto_compact_lag=None):
+        from raft_tpu.ops.fused import fused_rounds
+
+        ops = (
+            self._no_ops
+            if ops is None
+            else jax.tree.map(
+                lambda x: self._shard_lanes(jnp.asarray(x)), ops
+            )
+        )
+        key = (rounds, do_tick, auto_propose, auto_compact_lag)
+        if key not in self._cache:
+            fn = shard_map(
+                lambda st, f, o, m: fused_rounds(
+                    st, f, o, m,
+                    v=self.v, n_rounds=rounds, do_tick=do_tick,
+                    auto_propose=auto_propose,
+                    auto_compact_lag=auto_compact_lag,
+                ),
+                mesh=self.mesh,
+                in_specs=(
+                    lane_specs(self.inner.state),
+                    lane_specs(self.inner.fab),
+                    lane_specs(self._no_ops),
+                    P("groups"),
+                ),
+                out_specs=(
+                    lane_specs(self.inner.state),
+                    lane_specs(self.inner.fab),
+                ),
+            )
+            self._cache[key] = jax.jit(fn)
+        self.inner.state, self.inner.fab = self._cache[key](
+            self.inner.state, self.inner.fab, ops, self.inner.mute
+        )
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

@@ -1,0 +1,155 @@
+"""Fused round kernel: behavior and safety tests (ops/fused.py).
+
+The fused engine is the throughput path; these tests assert the same Raft
+behaviors the serial-path suites check (election safety, log matching,
+commit propagation, flow control fallback to snapshots, transfer,
+ReadIndex), driven entirely through the one-invocation-per-round kernel.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.ops.fused import FusedCluster
+from raft_tpu.types import ProgressState, StateType
+
+
+def leaders_per_group(c):
+    st = np.asarray(c.state.state)
+    out = {}
+    for g in range(c.g):
+        sl = c.lanes_of_group(g)
+        out[g] = [int(l) for l in range(sl.start, sl.stop) if st[l] == StateType.LEADER]
+    return out
+
+
+def test_ticks_elect_exactly_one_leader_per_group():
+    c = FusedCluster(8, 3, seed=5)
+    c.run(60)
+    c.check_no_errors()
+    lpg = leaders_per_group(c)
+    assert all(len(v) == 1 for v in lpg.values()), lpg
+    # followers acknowledge the same leader
+    lead = np.asarray(c.state.lead)
+    for g, (l,) in lpg.items():
+        sl = c.lanes_of_group(g)
+        assert set(lead[sl]) == {l % c.v + 1}
+
+
+def test_commit_propagates_and_members_agree():
+    c = FusedCluster(4, 3, seed=3)
+    c.run(40)
+    com0 = np.asarray(c.state.committed).copy()
+    c.run(50, auto_propose=True, auto_compact_lag=8)
+    c.check_no_errors()
+    com1 = np.asarray(c.state.committed)
+    assert (com1 - com0 > 20).all()
+    assert (np.asarray(c.state.applied) == com1).all()
+    # log matching: members of a group agree up to pipeline skew
+    for g in range(4):
+        sl = c.lanes_of_group(g)
+        assert com1[sl].max() - com1[sl].min() <= 2, com1[sl]
+
+
+def test_five_voters():
+    c = FusedCluster(4, 5, seed=11)
+    c.run(80)
+    c.check_no_errors()
+    assert all(len(v) == 1 for v in leaders_per_group(c).values())
+    c.run(30, auto_propose=True, auto_compact_lag=8)
+    assert (np.asarray(c.state.committed) > 5).all()
+
+
+def test_prevote_checkquorum_elects():
+    c = FusedCluster(4, 3, seed=9, pre_vote=True, check_quorum=True)
+    c.run(100)
+    c.check_no_errors()
+    assert all(len(v) == 1 for v in leaders_per_group(c).values())
+
+
+def test_simultaneous_candidates_election_safety():
+    """Two lanes hup in the same round: at most one wins; never two leaders
+    at the same term (paper §5.2)."""
+    c = FusedCluster(4, 3, seed=2)
+    hup = {g * 3 + 0: True for g in range(4)}
+    hup.update({g * 3 + 1: True for g in range(4)})
+    c.run(1, ops=c.ops(hup=hup), do_tick=False)
+    c.run(8, do_tick=False)
+    st = np.asarray(c.state.state)
+    term = np.asarray(c.state.term)
+    for g in range(4):
+        sl = c.lanes_of_group(g)
+        lt = [(term[l], st[l]) for l in range(sl.start, sl.stop)]
+        by_term = {}
+        for t, s in lt:
+            if s == StateType.LEADER:
+                by_term.setdefault(t, 0)
+                by_term[t] += 1
+        assert all(v <= 1 for v in by_term.values()), lt
+
+
+def test_leadership_transfer():
+    c = FusedCluster(2, 3, seed=4)
+    c.campaign(0)
+    c.campaign(3)
+    c.run(6, do_tick=False)
+    assert 0 in c.leader_lanes() and 3 in c.leader_lanes()
+    # transfer group 0's leadership to member 2 (lane 1)
+    c.run(1, ops=c.ops(transfer_to={0: 2}), do_tick=False)
+    c.run(8, do_tick=False)
+    c.check_no_errors()
+    assert 1 in c.leader_lanes(), c.leader_lanes()
+    assert 0 not in c.leader_lanes()
+
+
+def test_read_index_quorum_release():
+    c = FusedCluster(2, 3, seed=4)
+    c.campaign(0)
+    c.run(4, do_tick=False)
+    assert 0 in c.leader_lanes()
+    c.run(1, ops=c.ops(read_ctx={0: 77}), do_tick=False)
+    c.run(4, do_tick=False)
+    rs = np.asarray(c.state.rs_count)
+    assert rs[0] == 1, rs
+    assert int(np.asarray(c.state.rs_ctx)[0, 0]) == 77
+    assert int(np.asarray(c.state.rs_index)[0, 0]) >= 1
+
+
+def test_muted_follower_catches_up_via_snapshot():
+    """Partition a follower, advance + compact the log past it, heal: the
+    leader must fall back to MsgSnap and the follower must catch up
+    (reference raft.go:625-649 + restore). PreVote+CheckQuorum keep the
+    partitioned node from disrupting the leader on rejoin
+    (raft.go:226-229, 1057-1066)."""
+    c = FusedCluster(1, 3, seed=6, pre_vote=True, check_quorum=True)
+    c.campaign(0)
+    c.run(4, do_tick=False)
+    assert 0 in c.leader_lanes()
+    c.set_mute([2])
+    c.run(30, auto_propose=True, auto_compact_lag=2)
+    com = np.asarray(c.state.committed)
+    assert com[0] > com[2] + 5  # follower is far behind
+    snap = int(np.asarray(c.state.snap_index)[0])
+    assert snap > int(com[2])  # its next entry is compacted away
+    c.set_mute([2], on=False)
+    c.run(30, auto_propose=True, auto_compact_lag=2)
+    c.check_no_errors()
+    com = np.asarray(c.state.committed)
+    assert 0 in c.leader_lanes()  # no disruption on rejoin
+    assert com[2] >= com[0] - 2, com
+    assert int(np.asarray(c.state.pr_state)[0, 2]) == ProgressState.REPLICATE
+
+
+def test_partitioned_leader_deposed_and_rejoins():
+    c = FusedCluster(1, 3, seed=8)
+    c.campaign(0)
+    c.run(4, do_tick=False)
+    c.set_mute([0])
+    c.run(80)  # followers time out, elect a new leader
+    st = np.asarray(c.state.state)
+    assert StateType.LEADER in (st[1], st[2]), st
+    c.set_mute([0], on=False)
+    c.run(12)
+    c.check_no_errors()
+    st = np.asarray(c.state.state)
+    assert st[0] == StateType.FOLLOWER  # old leader stepped down
+    assert sum(1 for s in st if s == StateType.LEADER) == 1

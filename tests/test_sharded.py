@@ -93,3 +93,21 @@ def test_scanned_rounds_single_device():
             np.asarray(getattr(b.state, name)),
             err_msg=name,
         )
+
+
+def test_sharded_fused_cluster_elects_and_commits():
+    """The fused round kernel under shard_map: elections + steady-state
+    commits across an 8-device mesh, no collectives in the round body."""
+    import numpy as np
+
+    from raft_tpu.parallel.sharded import ShardedFusedCluster
+
+    sh = ShardedFusedCluster(n_groups=16, n_voters=3)
+    sh.run(60)
+    sh.check_no_errors()
+    assert len(sh.leader_lanes()) == 16
+    com0 = np.asarray(sh.state.committed).copy()
+    sh.run(20, auto_propose=True, auto_compact_lag=8)
+    sh.check_no_errors()
+    com1 = np.asarray(sh.state.committed)
+    assert (com1 - com0 >= 10).all()
