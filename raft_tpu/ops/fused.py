@@ -1116,9 +1116,8 @@ class FusedCluster:
     def set_mute(self, lanes, on: bool = True):
         import numpy as np
 
-        m = np.asarray(self.mute)
-        m = m.copy()
-        m[np.asarray(lanes)] = on
+        m = np.asarray(self.mute).copy()
+        m[np.asarray(lanes, dtype=np.int64)] = on
         self.mute = jnp.asarray(m)
 
     # -- inspection -------------------------------------------------------

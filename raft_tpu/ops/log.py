@@ -63,34 +63,49 @@ def window_indexes(state: RaftState):
     return idx, valid
 
 
-def term_at(state: RaftState, idx):
-    """Term of entry `idx` per lane; 0 when unknown (compacted/unavailable),
-    folding the reference's ErrCompacted/ErrUnavailable returns (log.go:380-404)
-    into the zero-term convention of zeroTermOnOutOfBounds.
-
-    idx: [N] or [N, K] — trailing dims broadcast against per-lane cursors.
-    """
+def _mask_terms(state: RaftState, idx, raw):
+    """Shared boundary rules for term lookups (reference log.go:380-404
+    folded into the zeroTermOnOutOfBounds convention): 0 outside the window,
+    the compaction point's own term is known (log.go:387-389), and a pending
+    snapshot answers its index (log_unstable.go maybeTerm). idx/raw share a
+    shape whose leading dim is the lane axis."""
     extra = idx.ndim - 1
     ex = (slice(None),) + (None,) * extra
 
     def b(x):
         return x[ex]
 
-    slot = slot_of(state, idx)
-    t = oh.gather(state.log_term, slot)
     in_window = (idx > b(state.snap_index)) & (idx <= b(state.last))
-    t = jnp.where(in_window, t, 0)
-    # Term of the compaction point itself is known (log.go:387-389).
+    t = jnp.where(in_window, raw, 0)
     t = jnp.where(idx == b(state.snap_index), b(state.snap_term), t)
-    # A pending (not yet applied) snapshot also answers term queries
-    # (log_unstable.go maybeTerm checks the snapshot index).
     has_pending = b(state.pending_snap_index) > 0
-    t = jnp.where(has_pending & (idx == b(state.pending_snap_index)), b(state.pending_snap_term), t)
+    t = jnp.where(
+        has_pending & (idx == b(state.pending_snap_index)),
+        b(state.pending_snap_term),
+        t,
+    )
     return t
+
+
+def term_at(state: RaftState, idx):
+    """Term of entry `idx` per lane; 0 when unknown (compacted/unavailable).
+
+    idx: [N] or [N, K] — trailing dims broadcast against per-lane cursors.
+    """
+    raw = oh.gather(state.log_term, slot_of(state, idx))
+    return _mask_terms(state, idx, raw)
 
 
 def last_term(state: RaftState):
     return term_at(state, state.last)
+
+
+def terms_range(state: RaftState, start, e: int):
+    """term_at for the contiguous indexes start..start+e-1 ([N] -> [N, e]) —
+    one one-hot + e rolls instead of an [N, e, W] gather tensor."""
+    idx = start[:, None] + jnp.arange(e, dtype=I32)[None, :]
+    raw = oh.gather_range(state.log_term, slot_of(state, start), e)
+    return _mask_terms(state, idx, raw)
 
 
 def match_term(state: RaftState, idx, term):
@@ -168,13 +183,12 @@ def append(
     state = _err(state, overflow, ERR_WINDOW_OVERFLOW)
     ok = act & (prev_index >= state.committed) & ~overflow
 
-    idx = prev_index[:, None] + 1 + jnp.arange(e, dtype=I32)[None, :]  # [N, E]
     write = ok[:, None] & (jnp.arange(e, dtype=I32)[None, :] < n_ents[:, None])
-    slot = slot_of(state, idx)
+    slot0 = slot_of(state, prev_index + 1)
 
     def scatter(col, vals):
-        # Masked one-hot scatter of [N, E] vals into [N, W].
-        return oh.scatter_set(col, slot, vals, write)
+        # Contiguous circular scatter of [N, E] vals into [N, W].
+        return oh.scatter_range_set(col, slot0, vals, write)
 
     new_last = jnp.where(ok, prev_index + n_ents, state.last)
     return dataclasses.replace(
@@ -195,7 +209,7 @@ def find_conflict(state: RaftState, prev_index, ent_term, n_ents):
     e = ent_term.shape[-1]
     idx = prev_index[:, None] + 1 + jnp.arange(e, dtype=I32)[None, :]
     valid = jnp.arange(e, dtype=I32)[None, :] < n_ents[:, None]
-    mism = valid & (term_at(state, idx) != ent_term)
+    mism = valid & (terms_range(state, prev_index + 1, e) != ent_term)
     big = jnp.int32(2**31 - 1)
     ci = jnp.min(jnp.where(mism, idx, big), axis=-1)
     return jnp.where(ci == big, 0, ci)
@@ -220,11 +234,11 @@ def maybe_append(
     # columns left by (ci - index - 1) so entry ci lands first.
     shift = jnp.where(ci > 0, ci - index - 1, 0)  # [N]
     e = ent_term.shape[-1]
-    k = jnp.arange(e, dtype=I32)[None, :] + shift[:, None]  # source position
-    safe_k = jnp.minimum(k, e - 1)
 
     def shifted(col):
-        return oh.gather(col, safe_k)
+        # contiguous in the source; wrapped reads land only in slots the
+        # n_keep write mask excludes
+        return oh.gather_range(col, shift, e)
 
     n_keep = jnp.where(ok & (ci > 0), n_ents - shift, 0)
     state = append(
@@ -302,14 +316,13 @@ def gather_entries(state: RaftState, lo, count, e: int):
     """Read entry columns [lo, lo+count) into [N, e] SoA (for building MsgApp
     payloads on device — reference log.go:406-412 entries()). count must be
     <= e; invalid positions zeroed."""
-    n = state.log_term.shape[0]
     idx = lo[:, None] + jnp.arange(e, dtype=I32)[None, :]
     valid = (jnp.arange(e, dtype=I32)[None, :] < count[:, None]) & (
         idx <= state.last[:, None]
     ) & (idx > state.snap_index[:, None])
-    slot = jnp.where(valid, slot_of(state, idx), 0)
+    slot0 = slot_of(state, lo)
 
     def g(col):
-        return jnp.where(valid, oh.gather(col, slot), 0)
+        return jnp.where(valid, oh.gather_range(col, slot0, e), 0)
 
     return g(state.log_term), g(state.log_type), g(state.log_bytes), valid

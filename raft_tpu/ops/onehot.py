@@ -48,6 +48,41 @@ def scatter_set(col, idx, vals, mask):
     return jnp.where(hit, val, col)
 
 
+def gather_range(col, start, e: int):
+    """Contiguous circular gather: out[..., k] = col[..., (start+k) mod W]
+    for k in [0, e). col [B..., W]; start [B...] (or with extra leading-dim
+    broadcast like `gather`). One one-hot + e static rolls — peak memory is
+    one [..., W] mask instead of the [..., e, W] tensor a general gather
+    needs (the difference between fitting in HBM and spilling at 1M lanes)."""
+    w = col.shape[-1]
+    if col.dtype == jnp.bool_:
+        return gather_range(col.astype(I32), start, e).astype(jnp.bool_)
+    oh0 = onehot(start % w, w)  # [..., W]
+    extra = oh0.ndim - col.ndim
+    c = col.reshape(col.shape[:-1] + (1,) * extra + (w,))
+    outs = [
+        jnp.sum(jnp.where(jnp.roll(oh0, k, axis=-1), c, 0), axis=-1)
+        for k in range(e)
+    ]
+    return jnp.stack(outs, axis=-1)
+
+
+def scatter_range_set(col, start, vals, mask):
+    """Contiguous circular scatter: col[..., (start+k) mod W] = vals[..., k]
+    where mask[..., k]. col [..., W]; start [...]; vals/mask [..., K].
+    Same roll trick as gather_range: peak memory stays [..., W]."""
+    w = col.shape[-1]
+    k_count = vals.shape[-1]
+    oh0 = onehot(start % w, w)
+    hit = jnp.zeros(col.shape, dtype=jnp.bool_)
+    acc = jnp.zeros(col.shape, dtype=col.dtype)
+    for k in range(k_count):
+        ohk = jnp.roll(oh0, k, axis=-1) & mask[..., k : k + 1]
+        hit = hit | ohk
+        acc = jnp.where(ohk, vals[..., k : k + 1], acc)
+    return jnp.where(hit, acc, col)
+
+
 def sort_last(x, valid=None, pad=-1):
     """Ascending sort along the (small, static) last axis via an odd-even
     transposition network — elementwise min/max only, no sort HLO. Invalid

@@ -352,13 +352,14 @@ def maybe_send_append(
     e = out.e
     n_send = jnp.where(throttled, 0, jnp.minimum(n_avail, e))
 
-    # gather entry columns per peer: [N, V, E]
+    # gather entry columns per peer, contiguous from pr_next: [N, V, E]
+    w = state.log_term.shape[-1]
+    slot0 = state.pr_next & (w - 1)
+
     def gather_peer(col):
-        idx = state.pr_next[..., None] + jnp.arange(e, dtype=I32)[None, None, :]
         k = jnp.arange(e, dtype=I32)[None, None, :]
         validk = k < n_send[..., None]
-        slot = jnp.where(validk, idx & (state.log_term.shape[-1] - 1), 0)
-        return jnp.where(validk, ohm.gather(col, slot), 0)
+        return jnp.where(validk, ohm.gather_range(col, slot0, e), 0)
 
     ent_term = gather_peer(state.log_term)
     ent_type = gather_peer(state.log_type)
