@@ -1,0 +1,90 @@
+"""Cross-host message bridge: raft groups whose members live on different
+engine instances ("hosts").
+
+The reference deliberately ships no transport (README.md:10-14): the
+application must carry `Ready.Messages` to peers, after persisting, and feed
+them to `Step`. Inside one chip/mesh this framework does that with the
+in-device router (cluster.route) or the fused transpose fabric; ACROSS
+hosts, message batches ride DCN and this bridge is that application-side
+layer for `RawNodeBatch` instances (SURVEY §5.8): it drains each host's
+Ready output — honoring the persist-before-send ordering the contract
+requires (doc.go:79-86; `RawNodeBatch.ready()` only surfaces messages the
+sync persist already covers) — and steps them into the destination host.
+
+Addressing: a global raft id space; each bridge member registers which ids
+it hosts and at which lane. Delivery between hosts is per-message here
+(clarity over throughput — cross-host groups are the rare tail; co-resident
+groups never touch the bridge).
+"""
+
+from __future__ import annotations
+
+from raft_tpu.api.rawnode import Message, RawNodeBatch
+
+
+class HostBridge:
+    """Synchronous bridge over any number of RawNodeBatch "hosts"."""
+
+    def __init__(self):
+        self._hosts: list[RawNodeBatch] = []
+        self._route: dict[int, tuple[int, int]] = {}  # raft id -> (host, lane)
+        self.delivered = 0
+        self.dropped = 0
+        # committed entries surfaced by pump(), keyed (host, lane) — the
+        # application's state-machine input; ready()/advance() page entries
+        # out exactly once, so pump must never drop them
+        self.committed: dict[tuple[int, int], list] = {}
+
+    def add_host(self, batch: RawNodeBatch, ids_to_lanes: dict[int, int]) -> int:
+        """Register a host and the (global raft id -> lane) map it serves."""
+        h = len(self._hosts)
+        self._hosts.append(batch)
+        for nid, lane in ids_to_lanes.items():
+            if nid in self._route:
+                raise ValueError(f"id {nid} already hosted")
+            self._route[nid] = (h, lane)
+        return h
+
+    def deliver(self, msgs: list[Message]):
+        for m in msgs:
+            tgt = self._route.get(m.to)
+            if tgt is None:
+                self.dropped += 1
+                continue
+            h, lane = tgt
+            self._hosts[h].step(lane, m)
+            self.delivered += 1
+
+    def pump(self, max_iters: int = 100, on_commit=None) -> int:
+        """Drain every host's Ready output and deliver until quiescent (the
+        multi-host analog of the reference tests' network fixture,
+        raft_test.go:4844). Committed entries — which ready()/advance() page
+        out exactly once — go to `on_commit(host, lane, entry)` when given,
+        else accumulate in `self.committed[(host, lane)]`. Returns the
+        number of iterations used."""
+        for it in range(max_iters):
+            moved = False
+            for h, b in enumerate(self._hosts):
+                for lane in range(b.shape.n):
+                    if not b.has_ready(lane):
+                        continue
+                    rd = b.ready(lane)
+                    msgs = rd.messages
+                    for e in rd.committed_entries:
+                        if on_commit is not None:
+                            on_commit(h, lane, e)
+                        else:
+                            self.committed.setdefault((h, lane), []).append(e)
+                    # sync model: ready() already reflects the persisted
+                    # prefix, so sending now preserves persist-before-send
+                    b.advance(lane)
+                    self.deliver(msgs)
+                    moved = True
+            if not moved:
+                return it
+        raise RuntimeError("bridge did not quiesce")
+
+    def tick_all(self):
+        for b in self._hosts:
+            for lane in range(b.shape.n):
+                b.tick(lane)
