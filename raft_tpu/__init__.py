@@ -38,6 +38,7 @@ from raft_tpu.api.rawnode import (
 )
 from raft_tpu.cluster import Cluster
 from raft_tpu.config import Shape
+from raft_tpu.logging import DefaultLogger, DiscardLogger, Logger, set_logger
 from raft_tpu.ops.fused import FusedCluster
 from raft_tpu.state import LaneConfig, RaftState, init_state, make_lane_config
 from raft_tpu.types import (
@@ -78,6 +79,10 @@ __all__ = [
     "VoteState",
     "ReadOnlyOption",
     "CampaignType",
+    "Logger",
+    "DefaultLogger",
+    "DiscardLogger",
+    "set_logger",
 ]
 
 __version__ = "0.1.0"

@@ -1,0 +1,65 @@
+"""Pluggable logger (reference: logger.go:25-66).
+
+The reference exposes a `Logger` interface with a process-global
+`SetLogger`. Device kernels can't log (they are traced once and compiled),
+so runtime logging here covers the host-side control plane — conf changes,
+snapshot/compaction operations, cross-host delivery problems — while the
+*in-algorithm* log lines the reference emits (campaign notices, term bumps,
+...) are reproduced byte-exactly by the conformance harness's log oracle
+(testing/logoracle.py), which is what the golden suite asserts against.
+"""
+
+from __future__ import annotations
+
+import logging as _pylogging
+from typing import Protocol
+
+
+class Logger(Protocol):
+    """reference: logger.go:25-43."""
+
+    def debug(self, msg: str, *args) -> None: ...
+    def info(self, msg: str, *args) -> None: ...
+    def warning(self, msg: str, *args) -> None: ...
+    def error(self, msg: str, *args) -> None: ...
+
+
+class DefaultLogger:
+    """stdlib-backed default (reference: DefaultLogger, logger.go:62)."""
+
+    def __init__(self, name: str = "raft_tpu"):
+        self._log = _pylogging.getLogger(name)
+
+    def debug(self, msg, *args):
+        self._log.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self._log.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self._log.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self._log.error(msg, *args)
+
+
+class DiscardLogger:
+    """reference: discardLogger, logger.go:64-66."""
+
+    def debug(self, msg, *args): ...
+    def info(self, msg, *args): ...
+    def warning(self, msg, *args): ...
+    def error(self, msg, *args): ...
+
+
+_logger: Logger = DefaultLogger()
+
+
+def set_logger(l: Logger) -> None:
+    """reference: SetLogger, logger.go:45."""
+    global _logger
+    _logger = l
+
+
+def get_logger() -> Logger:
+    return _logger

@@ -1,0 +1,443 @@
+// raftpb wire codec: byte-exact gogoproto encoding of raftpb.Message.
+//
+// The reference's wire format is produced by gogoproto-generated Go
+// (raftpb/raft.pb.go): proto2, ascending field order, non-nullable scalars
+// emitted unconditionally (even when zero), nullable bytes/messages only
+// when present, repeated fields in order. Field numbers from
+// raftpb/raft.proto:21-108,136-151. This codec is the DCN transport layer's
+// serializer for cross-host message batches (SURVEY §5.8) and the interop
+// boundary with Go-raft peers; Python binds via ctypes (runtime/codec.py).
+//
+// Scope: Message with entries, snapshot (data + metadata + ConfState), and
+// one level of responses (storage-thread responses are scalar-only in the
+// reference; nested entries/snapshots inside responses are rejected).
+//
+// Build: make -C raft_tpu/native (produces libraft_tpu_native.so).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+inline size_t varint_size(uint64_t v) {
+  size_t n = 1;
+  while (v >= 0x80) {
+    v >>= 7;
+    n++;
+  }
+  return n;
+}
+
+inline void put_varint(std::vector<uint8_t>& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back(static_cast<uint8_t>(v) | 0x80);
+    v >>= 7;
+  }
+  out.push_back(static_cast<uint8_t>(v));
+}
+
+inline void put_key(std::vector<uint8_t>& out, int field, int wire) {
+  put_varint(out, static_cast<uint64_t>(field) << 3 | wire);
+}
+
+inline void put_scalar(std::vector<uint8_t>& out, int field, uint64_t v) {
+  put_key(out, field, 0);
+  put_varint(out, v);
+}
+
+inline void put_bytes(std::vector<uint8_t>& out, int field, const uint8_t* p,
+                      size_t n) {
+  put_key(out, field, 2);
+  put_varint(out, n);
+  out.insert(out.end(), p, p + n);
+}
+
+// ---- ConfState (raft.proto:136-151) ----
+struct ConfStateView {
+  const uint64_t* voters;
+  int32_t n_voters;
+  const uint64_t* learners;
+  int32_t n_learners;
+  const uint64_t* voters_outgoing;
+  int32_t n_outgoing;
+  const uint64_t* learners_next;
+  int32_t n_next;
+  uint64_t auto_leave;
+};
+
+void marshal_confstate(std::vector<uint8_t>& out, const ConfStateView& cs) {
+  for (int32_t i = 0; i < cs.n_voters; i++) put_scalar(out, 1, cs.voters[i]);
+  for (int32_t i = 0; i < cs.n_learners; i++) put_scalar(out, 2, cs.learners[i]);
+  for (int32_t i = 0; i < cs.n_outgoing; i++)
+    put_scalar(out, 3, cs.voters_outgoing[i]);
+  for (int32_t i = 0; i < cs.n_next; i++) put_scalar(out, 4, cs.learners_next[i]);
+  // auto_leave: non-nullable bool, always emitted
+  put_key(out, 5, 0);
+  out.push_back(cs.auto_leave ? 1 : 0);
+}
+
+// ---- Entry (raft.proto:21-26); wire order Type(1) Term(2) Index(3) Data(4)
+void marshal_entry(std::vector<uint8_t>& out, uint64_t type, uint64_t term,
+                   uint64_t index, const uint8_t* data, int64_t data_len) {
+  put_scalar(out, 1, type);
+  put_scalar(out, 2, term);
+  put_scalar(out, 3, index);
+  if (data_len > 0 || data != nullptr) {
+    // gogoproto emits Data only when non-nil; the caller signals nil with
+    // data == nullptr (empty-but-present encodes a zero-length field)
+    if (data != nullptr) put_bytes(out, 4, data, static_cast<size_t>(data_len));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scalar slots in the `scalars` array of msg_marshal/msg_unmarshal.
+// [0]=type [1]=to [2]=from [3]=term [4]=logTerm [5]=index [6]=commit
+// [7]=reject [8]=rejectHint [9]=vote [10]=has_snapshot
+enum { kType, kTo, kFrom, kTerm, kLogTerm, kIndex, kCommit, kReject,
+       kRejectHint, kVote, kHasSnap, kNumScalars };
+
+// Marshal one raftpb.Message. Entries are SoA: ent_scalars[i*3+{0,1,2}] =
+// {type, term, index}; payload bytes concatenated in ent_data with
+// per-entry lengths (-1 = nil Data). Snapshot (when scalars[kHasSnap]):
+// snap_meta = {index, term, auto_leave}; ids packed voters|learners|
+// outgoing|next with counts in snap_counts[4]; snap_data_len -1 = nil.
+// Responses: scalar-only nested messages, resp_scalars[kNumScalars] each
+// (has_snapshot must be 0). Returns bytes written, or -needed if out_cap is
+// too small.
+int64_t msg_marshal(const uint64_t* scalars, const uint8_t* context,
+                    int64_t context_len, int32_t n_entries,
+                    const uint64_t* ent_scalars, const int64_t* ent_data_lens,
+                    const uint8_t* ent_data, const uint64_t* snap_meta,
+                    const uint8_t* snap_data, int64_t snap_data_len,
+                    const int32_t* snap_counts, const uint64_t* snap_ids,
+                    int32_t n_responses, const uint64_t* resp_scalars,
+                    uint8_t* out, int64_t out_cap) {
+  std::vector<uint8_t> buf;
+  buf.reserve(256);
+  put_scalar(buf, 1, scalars[kType]);
+  put_scalar(buf, 2, scalars[kTo]);
+  put_scalar(buf, 3, scalars[kFrom]);
+  put_scalar(buf, 4, scalars[kTerm]);
+  put_scalar(buf, 5, scalars[kLogTerm]);
+  put_scalar(buf, 6, scalars[kIndex]);
+  // entries (field 7)
+  const uint8_t* dp = ent_data;
+  for (int32_t i = 0; i < n_entries; i++) {
+    std::vector<uint8_t> ent;
+    int64_t dl = ent_data_lens[i];
+    marshal_entry(ent, ent_scalars[i * 3], ent_scalars[i * 3 + 1],
+                  ent_scalars[i * 3 + 2], dl < 0 ? nullptr : dp,
+                  dl < 0 ? 0 : dl);
+    if (dl > 0) dp += dl;
+    put_key(buf, 7, 2);
+    put_varint(buf, ent.size());
+    buf.insert(buf.end(), ent.begin(), ent.end());
+  }
+  put_scalar(buf, 8, scalars[kCommit]);
+  // snapshot (field 9, nullable)
+  if (scalars[kHasSnap]) {
+    std::vector<uint8_t> meta;
+    ConfStateView cs;
+    const uint64_t* ids = snap_ids;
+    cs.voters = ids; cs.n_voters = snap_counts[0]; ids += snap_counts[0];
+    cs.learners = ids; cs.n_learners = snap_counts[1]; ids += snap_counts[1];
+    cs.voters_outgoing = ids; cs.n_outgoing = snap_counts[2]; ids += snap_counts[2];
+    cs.learners_next = ids; cs.n_next = snap_counts[3];
+    cs.auto_leave = snap_meta[2];
+    std::vector<uint8_t> csbuf;
+    marshal_confstate(csbuf, cs);
+    // SnapshotMetadata: conf_state(1, always), index(2), term(3)
+    put_key(meta, 1, 2);
+    put_varint(meta, csbuf.size());
+    meta.insert(meta.end(), csbuf.begin(), csbuf.end());
+    put_scalar(meta, 2, snap_meta[0]);
+    put_scalar(meta, 3, snap_meta[1]);
+    std::vector<uint8_t> snap;
+    if (snap_data_len >= 0 && snap_data != nullptr)
+      put_bytes(snap, 1, snap_data, static_cast<size_t>(snap_data_len));
+    put_key(snap, 2, 2);  // metadata: non-nullable, always emitted
+    put_varint(snap, meta.size());
+    snap.insert(snap.end(), meta.begin(), meta.end());
+    put_key(buf, 9, 2);
+    put_varint(buf, snap.size());
+    buf.insert(buf.end(), snap.begin(), snap.end());
+  }
+  // reject(10), rejectHint(11): non-nullable, always emitted
+  put_key(buf, 10, 0);
+  buf.push_back(scalars[kReject] ? 1 : 0);
+  put_scalar(buf, 11, scalars[kRejectHint]);
+  if (context_len >= 0 && context != nullptr)
+    put_bytes(buf, 12, context, static_cast<size_t>(context_len));
+  put_scalar(buf, 13, scalars[kVote]);
+  // responses (field 14): scalar-only nested messages
+  for (int32_t r = 0; r < n_responses; r++) {
+    const uint64_t* rs = resp_scalars + r * kNumScalars;
+    std::vector<uint8_t> rb;
+    put_scalar(rb, 1, rs[kType]);
+    put_scalar(rb, 2, rs[kTo]);
+    put_scalar(rb, 3, rs[kFrom]);
+    put_scalar(rb, 4, rs[kTerm]);
+    put_scalar(rb, 5, rs[kLogTerm]);
+    put_scalar(rb, 6, rs[kIndex]);
+    put_scalar(rb, 8, rs[kCommit]);
+    put_key(rb, 10, 0);
+    rb.push_back(rs[kReject] ? 1 : 0);
+    put_scalar(rb, 11, rs[kRejectHint]);
+    put_scalar(rb, 13, rs[kVote]);
+    put_key(buf, 14, 2);
+    put_varint(buf, rb.size());
+    buf.insert(buf.end(), rb.begin(), rb.end());
+  }
+  int64_t n = static_cast<int64_t>(buf.size());
+  if (n > out_cap) return -n;
+  std::memcpy(out, buf.data(), buf.size());
+  return n;
+}
+
+namespace {
+
+bool read_varint(const uint8_t* p, int64_t len, int64_t& off, uint64_t& v) {
+  v = 0;
+  int shift = 0;
+  while (off < len) {
+    uint8_t b = p[off++];
+    v |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) return true;
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+// Reads one field header + value, fully bounds-checked against `end`.
+// wire 0: v = varint value. wire 2: v = payload length (payload verified in
+// bounds, NOT consumed — caller consumes or skips with off += v). wire 1/5:
+// fixed bytes consumed into v. Returns 0 ok, -1 truncated, -2 bad wire type.
+int next_field(const uint8_t* in, int64_t end, int64_t& off, int& field,
+               int& wire, uint64_t& v) {
+  uint64_t key;
+  if (!read_varint(in, end, off, key)) return -1;
+  field = static_cast<int>(key >> 3);
+  wire = static_cast<int>(key & 7);
+  switch (wire) {
+    case 0:
+      return read_varint(in, end, off, v) ? 0 : -1;
+    case 2: {
+      if (!read_varint(in, end, off, v)) return -1;
+      if (off + static_cast<int64_t>(v) > end) return -1;
+      return 0;
+    }
+    case 1: {
+      if (off + 8 > end) return -1;
+      std::memcpy(&v, in + off, 8);
+      off += 8;
+      return 0;
+    }
+    case 5: {
+      if (off + 4 > end) return -1;
+      uint32_t t;
+      std::memcpy(&t, in + off, 4);
+      v = t;
+      off += 4;
+      return 0;
+    }
+    default:
+      return -2;
+  }
+}
+
+// Unknown field (or known field with unexpected wire type): consume any
+// unconsumed payload — proto2 forward-compatibility skipping.
+inline void skip_payload(int wire, uint64_t v, int64_t& off) {
+  if (wire == 2) off += static_cast<int64_t>(v);
+}
+
+}  // namespace
+
+// Unmarshal one raftpb.Message previously produced by this codec or by the
+// Go reference. Outputs mirror msg_marshal's inputs; capacities guard every
+// variable-size output (max_entries, ent_data_cap, context_cap,
+// snap_data_cap, max_snap_ids, max_responses). Unknown fields are skipped
+// per proto2 rules. Returns 0 on success, negative error code otherwise.
+int64_t msg_unmarshal(const uint8_t* in, int64_t len, uint64_t* scalars,
+                      uint8_t* context, int64_t context_cap,
+                      int64_t* context_len, int32_t* n_entries,
+                      int32_t max_entries, uint64_t* ent_scalars,
+                      int64_t* ent_data_lens, uint8_t* ent_data,
+                      int64_t ent_data_cap, uint64_t* snap_meta,
+                      uint8_t* snap_data, int64_t snap_data_cap,
+                      int64_t* snap_data_len, int32_t* snap_counts,
+                      uint64_t* snap_ids, int32_t max_snap_ids,
+                      int32_t* n_responses, int32_t max_responses,
+                      uint64_t* resp_scalars) {
+  std::memset(scalars, 0, sizeof(uint64_t) * kNumScalars);
+  *context_len = -1;
+  *n_entries = 0;
+  *snap_data_len = -1;
+  *n_responses = 0;
+  std::memset(snap_counts, 0, sizeof(int32_t) * 4);
+  int64_t ent_data_off = 0;
+  int64_t off = 0;
+  while (off < len) {
+    int field, wire;
+    uint64_t v;
+    int rc = next_field(in, len, off, field, wire, v);
+    if (rc) return rc;
+    // varint scalar fields (known only at wire type 0; anything else is
+    // treated as unknown and skipped, per proto2 tolerance)
+    if (wire == 0) {
+      switch (field) {
+        case 1: scalars[kType] = v; continue;
+        case 2: scalars[kTo] = v; continue;
+        case 3: scalars[kFrom] = v; continue;
+        case 4: scalars[kTerm] = v; continue;
+        case 5: scalars[kLogTerm] = v; continue;
+        case 6: scalars[kIndex] = v; continue;
+        case 8: scalars[kCommit] = v; continue;
+        case 10: scalars[kReject] = v; continue;
+        case 11: scalars[kRejectHint] = v; continue;
+        case 13: scalars[kVote] = v; continue;
+        default: continue;  // unknown varint field
+      }
+    }
+    if (wire != 2) {  // fixed32/64: no known raftpb field, skip (consumed)
+      continue;
+    }
+    switch (field) {
+      case 12: {  // context bytes
+        if (static_cast<int64_t>(v) > context_cap) return -3;
+        std::memcpy(context, in + off, v);
+        *context_len = static_cast<int64_t>(v);
+        off += static_cast<int64_t>(v);
+        break;
+      }
+      case 7: {  // entry
+        if (*n_entries >= max_entries) return -4;
+        int64_t end = off + static_cast<int64_t>(v);
+        uint64_t et = 0, term = 0, index = 0;
+        int64_t dlen = -1;
+        while (off < end) {
+          int ef, ew;
+          uint64_t ev;
+          rc = next_field(in, end, off, ef, ew, ev);
+          if (rc) return rc;
+          if (ew == 0) {
+            if (ef == 1) et = ev;
+            else if (ef == 2) term = ev;
+            else if (ef == 3) index = ev;
+          } else if (ew == 2 && ef == 4) {
+            if (ent_data_off + static_cast<int64_t>(ev) > ent_data_cap)
+              return -5;
+            std::memcpy(ent_data + ent_data_off, in + off, ev);
+            dlen = static_cast<int64_t>(ev);
+            ent_data_off += dlen;
+            off += static_cast<int64_t>(ev);
+          } else {
+            skip_payload(ew, ev, off);
+          }
+        }
+        int32_t i = (*n_entries)++;
+        ent_scalars[i * 3] = et;
+        ent_scalars[i * 3 + 1] = term;
+        ent_scalars[i * 3 + 2] = index;
+        ent_data_lens[i] = dlen;
+        break;
+      }
+      case 9: {  // snapshot
+        scalars[kHasSnap] = 1;
+        int64_t end = off + static_cast<int64_t>(v);
+        int32_t n_ids = 0;
+        while (off < end) {
+          int sf, sw;
+          uint64_t sv;
+          rc = next_field(in, end, off, sf, sw, sv);
+          if (rc) return rc;
+          if (sw == 2 && sf == 1) {  // data
+            if (static_cast<int64_t>(sv) > snap_data_cap) return -6;
+            std::memcpy(snap_data, in + off, sv);
+            *snap_data_len = static_cast<int64_t>(sv);
+            off += static_cast<int64_t>(sv);
+          } else if (sw == 2 && sf == 2) {  // metadata
+            int64_t mend = off + static_cast<int64_t>(sv);
+            while (off < mend) {
+              int mf, mw;
+              uint64_t mv;
+              rc = next_field(in, mend, off, mf, mw, mv);
+              if (rc) return rc;
+              if (mw == 2 && mf == 1) {  // conf_state
+                int64_t cend = off + static_cast<int64_t>(mv);
+                while (off < cend) {
+                  int cf, cw;
+                  uint64_t cv;
+                  rc = next_field(in, cend, off, cf, cw, cv);
+                  if (rc) return rc;
+                  if (cw == 0 && cf >= 1 && cf <= 4) {
+                    if (n_ids >= max_snap_ids) return -7;
+                    // the Go encoder emits the four repeated groups in
+                    // ascending field order, so grouped storage is safe
+                    snap_ids[n_ids++] = cv;
+                    snap_counts[cf - 1]++;
+                  } else if (cw == 0 && cf == 5) {
+                    snap_meta[2] = cv;
+                  } else {
+                    skip_payload(cw, cv, off);
+                  }
+                }
+              } else if (mw == 0 && mf == 2) {
+                snap_meta[0] = mv;
+              } else if (mw == 0 && mf == 3) {
+                snap_meta[1] = mv;
+              } else {
+                skip_payload(mw, mv, off);
+              }
+            }
+          } else {
+            skip_payload(sw, sv, off);
+          }
+        }
+        break;
+      }
+      case 14: {  // response (scalar-only)
+        if (*n_responses >= max_responses) return -8;
+        int64_t end = off + static_cast<int64_t>(v);
+        uint64_t* rs = resp_scalars + (*n_responses) * kNumScalars;
+        std::memset(rs, 0, sizeof(uint64_t) * kNumScalars);
+        while (off < end) {
+          int rf, rw;
+          uint64_t rv;
+          rc = next_field(in, end, off, rf, rw, rv);
+          if (rc) return rc;
+          if (rw == 0) {
+            switch (rf) {
+              case 1: rs[kType] = rv; break;
+              case 2: rs[kTo] = rv; break;
+              case 3: rs[kFrom] = rv; break;
+              case 4: rs[kTerm] = rv; break;
+              case 5: rs[kLogTerm] = rv; break;
+              case 6: rs[kIndex] = rv; break;
+              case 8: rs[kCommit] = rv; break;
+              case 10: rs[kReject] = rv; break;
+              case 11: rs[kRejectHint] = rv; break;
+              case 13: rs[kVote] = rv; break;
+            }
+          } else {
+            skip_payload(rw, rv, off);
+          }
+        }
+        (*n_responses)++;
+        break;
+      }
+      default: {  // unknown length-delimited field: skip
+        off += static_cast<int64_t>(v);
+        break;
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

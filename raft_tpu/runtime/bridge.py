@@ -23,13 +23,20 @@ from raft_tpu.api.rawnode import Message, RawNodeBatch
 
 
 class HostBridge:
-    """Synchronous bridge over any number of RawNodeBatch "hosts"."""
+    """Synchronous bridge over any number of RawNodeBatch "hosts".
 
-    def __init__(self):
+    wire=True serializes every delivery through the byte-exact raftpb codec
+    (runtime/codec.py, C++ native/raftpb_codec.cc) — what real DCN transport
+    does, and the same marshal/unmarshal copy the reference's test network
+    performs to catch aliasing (rafttest/network.go:92-101).
+    """
+
+    def __init__(self, wire: bool = False):
         self._hosts: list[RawNodeBatch] = []
         self._route: dict[int, tuple[int, int]] = {}  # raft id -> (host, lane)
         self.delivered = 0
         self.dropped = 0
+        self.wire = wire
         # committed entries surfaced by pump(), keyed (host, lane) — the
         # application's state-machine input; ready()/advance() page entries
         # out exactly once, so pump must never drop them
@@ -46,12 +53,27 @@ class HostBridge:
         return h
 
     def deliver(self, msgs: list[Message]):
+        from raft_tpu.logging import get_logger
+
+        codec = None
+        if self.wire and msgs:
+            # lazy: wire mode needs the native library; hosts without it use
+            # in-memory delivery
+            from raft_tpu.runtime import codec
+
+        log = get_logger()
         for m in msgs:
             tgt = self._route.get(m.to)
             if tgt is None:
                 self.dropped += 1
+                log.debug(
+                    "bridge: dropping message type=%s to unhosted id %s",
+                    m.type, m.to,
+                )
                 continue
             h, lane = tgt
+            if codec is not None:
+                m = codec.unmarshal_message(codec.marshal_message(m))
             self._hosts[h].step(lane, m)
             self.delivered += 1
 

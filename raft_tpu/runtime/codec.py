@@ -1,0 +1,205 @@
+"""raftpb.Message <-> wire bytes via the C++ codec (native/raftpb_codec.cc).
+
+Byte-exact gogoproto encoding (reference: raftpb/raft.pb.go generated
+marshal), so encoded messages interoperate with Go raft peers on the wire.
+This is the serializer for cross-host transport (runtime/bridge.py over
+DCN) and for applications that persist messages.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from raft_tpu.api.rawnode import Entry, Message, Snapshot
+from raft_tpu.runtime.native import _load
+
+_N_SCALARS = 11  # see raftpb_codec.cc scalar slots
+
+
+def _lib():
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    if not getattr(lib, "_codec_bound", False):
+        c = ctypes.c_void_p  # keep signatures loose; numpy buffers below
+        lib.msg_marshal.restype = ctypes.c_int64
+        lib.msg_unmarshal.restype = ctypes.c_int64
+        lib._codec_bound = True
+    return lib
+
+
+def _u64(x):
+    return np.ascontiguousarray(x, dtype=np.uint64)
+
+
+def _scalars(m: Message) -> np.ndarray:
+    return _u64(
+        [
+            int(m.type), m.to, m.frm, m.term, m.log_term, m.index, m.commit,
+            1 if m.reject else 0, m.reject_hint, getattr(m, "vote", 0),
+            1 if m.snapshot is not None else 0,
+        ]
+    )
+
+
+def marshal_message(m: Message) -> bytes:
+    lib = _lib()
+    scalars = _scalars(m)
+    ctx = int(m.context)
+    # Message.context on the wire is bytes; the engine keys requests with an
+    # int ticket — encode it as 8-byte big-endian when nonzero, absent when 0
+    ctx_b = ctx.to_bytes(8, "big") if ctx else None
+    ents = m.entries or []
+    ent_scalars = _u64(
+        [x for e in ents for x in (int(e.type), e.term, e.index)]
+        or [0]
+    )
+    ent_lens = np.ascontiguousarray(
+        [len(e.data) if e.data is not None else -1 for e in ents] or [0],
+        dtype=np.int64,
+    )
+    ent_data = b"".join(e.data or b"" for e in ents)
+    snap = m.snapshot
+    if snap is not None:
+        ids = (
+            list(snap.voters)
+            + list(snap.learners)
+            + list(snap.voters_outgoing)
+            + list(snap.learners_next)
+        )
+        snap_counts = np.ascontiguousarray(
+            [
+                len(snap.voters), len(snap.learners),
+                len(snap.voters_outgoing), len(snap.learners_next),
+            ],
+            dtype=np.int32,
+        )
+        snap_ids = _u64(ids or [0])
+        snap_meta = _u64([snap.index, snap.term, 1 if snap.auto_leave else 0])
+        snap_data = snap.data or b""
+        snap_data_len = len(snap_data) if snap.data is not None else -1
+    else:
+        snap_counts = np.zeros(4, np.int32)
+        snap_ids = _u64([0])
+        snap_meta = _u64([0, 0, 0])
+        snap_data, snap_data_len = b"", -1
+    resps = getattr(m, "responses", None) or []
+    resp_scalars = _u64(
+        [x for r in resps for x in _scalars(r).tolist()] or [0]
+    )
+
+    cap = 256 + len(ent_data) + 16 * max(1, len(ents)) + len(snap_data) + 512
+    while True:
+        out = np.zeros(cap, np.uint8)
+        n = lib.msg_marshal(
+            scalars.ctypes.data_as(ctypes.c_void_p),
+            ctx_b, ctypes.c_int64(len(ctx_b) if ctx_b else -1),
+            ctypes.c_int32(len(ents)),
+            ent_scalars.ctypes.data_as(ctypes.c_void_p),
+            ent_lens.ctypes.data_as(ctypes.c_void_p),
+            ent_data,
+            snap_meta.ctypes.data_as(ctypes.c_void_p),
+            snap_data, ctypes.c_int64(snap_data_len),
+            snap_counts.ctypes.data_as(ctypes.c_void_p),
+            snap_ids.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(len(resps)),
+            resp_scalars.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(cap),
+        )
+        if n >= 0:
+            return out[:n].tobytes()
+        cap = int(-n)
+
+
+def unmarshal_message(data: bytes, max_entries: int | None = None,
+                      max_responses: int | None = None) -> Message:
+    lib = _lib()
+    # size-derived capacities: every entry/response costs >= 2 wire bytes and
+    # every ConfState id >= 2, so these bounds admit any well-formed input
+    if max_entries is None:
+        max_entries = len(data) // 2 + 8
+    if max_responses is None:
+        max_responses = len(data) // 2 + 8
+    scalars = np.zeros(_N_SCALARS, np.uint64)
+    context = np.zeros(max(64, len(data)), np.uint8)
+    context_len = ctypes.c_int64(-1)
+    n_entries = ctypes.c_int32(0)
+    ent_scalars = np.zeros(max_entries * 3, np.uint64)
+    ent_lens = np.zeros(max_entries, np.int64)
+    ent_data = np.zeros(max(1, len(data)), np.uint8)
+    snap_meta = np.zeros(3, np.uint64)
+    snap_data = np.zeros(max(1, len(data)), np.uint8)
+    snap_data_len = ctypes.c_int64(-1)
+    snap_counts = np.zeros(4, np.int32)
+    snap_ids = np.zeros(len(data) // 2 + 16, np.uint64)
+    n_resp = ctypes.c_int32(0)
+    resp_scalars = np.zeros(max_responses * _N_SCALARS, np.uint64)
+
+    rc = lib.msg_unmarshal(
+        data, ctypes.c_int64(len(data)),
+        scalars.ctypes.data_as(ctypes.c_void_p),
+        context.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(context.size),
+        ctypes.byref(context_len),
+        ctypes.byref(n_entries), ctypes.c_int32(max_entries),
+        ent_scalars.ctypes.data_as(ctypes.c_void_p),
+        ent_lens.ctypes.data_as(ctypes.c_void_p),
+        ent_data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(ent_data.size),
+        snap_meta.ctypes.data_as(ctypes.c_void_p),
+        snap_data.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(snap_data.size),
+        ctypes.byref(snap_data_len),
+        snap_counts.ctypes.data_as(ctypes.c_void_p),
+        snap_ids.ctypes.data_as(ctypes.c_void_p), ctypes.c_int32(snap_ids.size),
+        ctypes.byref(n_resp), ctypes.c_int32(max_responses),
+        resp_scalars.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise ValueError(f"unmarshal failed: {rc}")
+
+    def mk(sc) -> Message:
+        return Message(
+            type=int(sc[0]), to=int(sc[1]), frm=int(sc[2]), term=int(sc[3]),
+            log_term=int(sc[4]), index=int(sc[5]), commit=int(sc[6]),
+            reject=bool(sc[7]), reject_hint=int(sc[8]),
+        )
+
+    m = mk(scalars)
+    m.vote = int(scalars[9])
+    if context_len.value > 0:
+        m.context = int.from_bytes(
+            context[: context_len.value].tobytes(), "big"
+        )
+    off = 0
+    for i in range(n_entries.value):
+        dl = int(ent_lens[i])
+        d = ent_data[off : off + dl].tobytes() if dl >= 0 else b""
+        if dl > 0:
+            off += dl
+        m.entries.append(
+            Entry(
+                type=int(ent_scalars[i * 3]), term=int(ent_scalars[i * 3 + 1]),
+                index=int(ent_scalars[i * 3 + 2]), data=d,
+            )
+        )
+    if scalars[10]:
+        nv, nl, no, nn = (int(x) for x in snap_counts)
+        ids = [int(x) for x in snap_ids[: nv + nl + no + nn]]
+        m.snapshot = Snapshot(
+            index=int(snap_meta[0]), term=int(snap_meta[1]),
+            data=snap_data[: max(0, snap_data_len.value)].tobytes(),
+            voters=tuple(ids[:nv]),
+            learners=tuple(ids[nv : nv + nl]),
+            voters_outgoing=tuple(ids[nv + nl : nv + nl + no]),
+            learners_next=tuple(ids[nv + nl + no :]),
+            auto_leave=bool(snap_meta[2]),
+        )
+    resps = []
+    for r in range(n_resp.value):
+        sc = resp_scalars[r * _N_SCALARS : (r + 1) * _N_SCALARS]
+        rm = mk(sc)
+        rm.vote = int(sc[9])
+        resps.append(rm)
+    if resps:
+        m.responses = resps
+    return m

@@ -29,9 +29,14 @@ def _load():
         if _lib is not None or _build_failed:
             return _lib
         try:
-            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
-                os.path.join(_DIR, "payload_store.cc")
-            ):
+            srcs = [
+                os.path.join(_DIR, "payload_store.cc"),
+                os.path.join(_DIR, "raftpb_codec.cc"),
+            ]
+            stale = not os.path.exists(_SO) or any(
+                os.path.getmtime(_SO) < os.path.getmtime(s) for s in srcs
+            )
+            if stale:
                 subprocess.run(
                     ["make", "-s"], cwd=_DIR, check=True, capture_output=True
                 )

@@ -74,3 +74,26 @@ def test_leader_host_failure_and_failover():
             break
     assert "LEADER" in states, states
     assert b2.dropped > 0  # traffic to the failed host was dropped
+
+
+def test_bridge_over_wire_codec():
+    """Same spanning-group election/commit, but every message crosses the
+    bridge as raftpb wire bytes through the C++ codec."""
+    from raft_tpu.runtime.native import native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native library not buildable")
+    bridge, hosts = make_spanning_group()
+    bridge.wire = True
+    hosts[0].campaign(0)
+    bridge.pump()
+    assert hosts[0].basic_status(0)["raft_state"] == "LEADER"
+    hosts[0].propose(0, b"wire-payload")
+    bridge.pump()
+    got = {
+        h: [e.data for e in ents if e.data]
+        for (h, lane), ents in bridge.committed.items()
+    }
+    assert got[0] == got[1] == got[2] == [b"wire-payload"], got
