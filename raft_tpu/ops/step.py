@@ -192,12 +192,11 @@ class Outbox:
 
 def reset(state: RaftState, mask, term) -> RaftState:
     """reference: raft.go:760-790."""
+    from raft_tpu.state import draw_timeout
+
     term_changed = mask & (state.term != term)
     rng = jnp.where(mask, _rng_next(state.rng), state.rng)
-    # high bits only: LCG low bits are lattice-correlated across lanes
-    rand_to = state.cfg.election_tick + (
-        (rng >> jnp.uint32(16)) % state.cfg.election_tick.astype(jnp.uint32)
-    ).astype(I32)
+    rand_to = draw_timeout(rng, state.cfg.election_tick)
 
     m1 = mask[:, None]
     present = peer_present(state)

@@ -196,7 +196,22 @@ def make_lane_config(shape: Shape, **overrides) -> LaneConfig:
     for k, v in overrides.items():
         base = defaults[k]
         defaults[k] = jnp.broadcast_to(jnp.asarray(v, base.dtype), base.shape)
+    # the reference's Config.validate (raft.go:288-336): tick values must be
+    # positive — a zero tick would make the randomized-timeout draw (% ET)
+    # undefined on device
+    for k in ("election_tick", "heartbeat_tick"):
+        if not bool(np.all(np.asarray(defaults[k]) >= 1)):
+            raise ValueError(f"{k} must be >= 1 for every lane")
     return LaneConfig(**defaults)
+
+
+def draw_timeout(rng, election_tick):
+    """Randomized election timeout in [ET, 2*ET) from the per-lane PRNG
+    (reference: raft.go:1984-1990). High bits only: the LCG's low bits are
+    lattice-correlated across lanes. Shared by init_state and the in-kernel
+    reset (ops/step.py); election_tick is validated >= 1 at config build."""
+    et = election_tick.astype(jnp.uint32)
+    return (et + (rng >> jnp.uint32(16)) % et).astype(I32)
 
 
 def init_state(
@@ -246,6 +261,12 @@ def init_state(
         np.uint32,
     )
 
+    # First randomized election timeout, drawn from the PER-LANE election
+    # tick (reference: newRaft -> becomeFollower -> resetRandomizedElection-
+    # Timeout uses Config.ElectionTick, raft.go:476+1984).
+    cfg = cfg if cfg is not None else make_lane_config(shape)
+    rand_to = draw_timeout(jnp.asarray(rng), cfg.election_tick)
+
     return RaftState(
         id=jnp.asarray(ids),
         term=zeros_n,
@@ -258,17 +279,7 @@ def init_state(
         uncommitted_size=zeros_n,
         election_elapsed=zeros_n,
         heartbeat_elapsed=zeros_n,
-        # becomeFollower resets this on first real transition; init like
-        # newRaft's becomeFollower call by sampling below via reset in step 0.
-        # High bits: the LCG's low bits are lattice-correlated across lanes
-        # (deltas stay fixed mod small ET), which can lock groups into
-        # synchronized split votes forever.
-        randomized_election_timeout=jnp.asarray(
-            DEFAULT_ELECTION_TICK
-            + ((rng >> np.uint32(16)) % np.uint32(DEFAULT_ELECTION_TICK)).astype(
-                np.int32
-            )
-        ),
+        randomized_election_timeout=jnp.asarray(rand_to),
         rng=jnp.asarray(rng),
         log_term=jnp.zeros((n, w), I32),
         log_type=jnp.zeros((n, w), I32),
@@ -310,5 +321,5 @@ def init_state(
         infl_count=zeros_nv,
         infl_total_bytes=zeros_nv,
         error_bits=zeros_n,
-        cfg=cfg if cfg is not None else make_lane_config(shape),
+        cfg=cfg,
     )

@@ -153,3 +153,47 @@ def test_partitioned_leader_deposed_and_rejoins():
     st = np.asarray(c.state.state)
     assert st[0] == StateType.FOLLOWER  # old leader stepped down
     assert sum(1 for s in st if s == StateType.LEADER) == 1
+
+
+def test_lease_based_reads_release_immediately():
+    """ReadOnlyLeaseBased skips the quorum-ack round trip (raft.go:56-68):
+    the leader answers from its lease in the same round."""
+    c = FusedCluster(1, 3, seed=12, read_only_lease_based=True)
+    c.campaign(0)
+    c.run(4, do_tick=False)
+    assert 0 in c.leader_lanes()
+    c.run(1, ops=c.ops(read_ctx={0: 55}), do_tick=False)
+    rs = np.asarray(c.state.rs_count)
+    assert rs[0] == 1  # released without waiting for heartbeat acks
+    assert int(np.asarray(c.state.rs_ctx)[0, 0]) == 55
+
+
+def test_heterogeneous_per_group_configs_share_one_program():
+    """LaneConfig is per-lane data, so groups with different election ticks
+    (and one group with PreVote) run in the same compiled round."""
+    import jax.numpy as jnp
+
+    g, v = 4, 3
+    n = g * v
+    et = np.full((n,), 10, np.int32)
+    et[0:3] = 6     # group 0: fast elections
+    et[3:6] = 20    # group 1: slow elections
+    pv = np.zeros((n,), bool)
+    pv[6:9] = True  # group 2: PreVote
+    c = FusedCluster(g, v, seed=13, election_tick=jnp.asarray(et),
+                     pre_vote=jnp.asarray(pv))
+    # after 15 ticks: group 0 (ET=6, randomized timeout in [6,12)) must have
+    # campaigned (term bumped) while group 1 (ET=20, timeout in [20,40))
+    # cannot have — proving the per-lane ticks actually apply
+    c.run(15)
+    term = np.asarray(c.state.term)
+    assert term[0:3].max() >= 1, term[0:3]
+    assert (term[3:6] == 0).all(), term[3:6]
+    # group 2 campaigns with PreVote: terms only move once a pre-election
+    # wins, and no lane may sit in CANDIDATE without a prior PRE_CANDIDATE
+    # pass; after convergence every group has exactly one leader
+    c.run(120)
+    c.check_no_errors()
+    assert all(len(x) == 1 for x in leaders_per_group(c).values())
+    # the PreVote group reached term >= 1 through a real election too
+    assert np.asarray(c.state.term)[6:9].max() >= 1
