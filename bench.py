@@ -52,8 +52,16 @@ def run_fused(n_groups, n_voters, n_iters, block):
     compile_s = time.perf_counter() - t0
 
     # warm through the election phase so the timed region is steady state
+    # (bounded: persistent split votes should fail loudly, not hang)
+    warm_rounds = 0
     while len(c.leader_lanes()) < n_groups:
         c.run(block, auto_propose=True, auto_compact_lag=lag)
+        warm_rounds += block
+        if warm_rounds > 40 * 16:
+            raise RuntimeError(
+                f"warm-up stalled: {len(c.leader_lanes())}/{n_groups} "
+                f"groups elected after {warm_rounds} rounds"
+            )
 
     com0 = int(jnp.sum(c.state.committed))
     t0 = time.perf_counter()

@@ -1,0 +1,70 @@
+"""Per-lane throughput scaling probe: where does the per-lane cost grow as
+the resident group count rises? (BASELINE.md measured ~3x from 49k to 300k
+lanes in round 1.) Prints one JSON line per shape."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
+    from raft_tpu.config import Shape
+    from raft_tpu.ops.fused import FusedCluster
+
+    shape = Shape(
+        n_lanes=n_groups * n_voters,
+        max_peers=n_voters,
+        log_window=w,
+        max_msg_entries=e,
+        max_inflight=min(8, e),
+    )
+    c = FusedCluster(n_groups, n_voters, seed=42, shape=shape)
+    lag = min(8, w // 2)
+    t0 = time.perf_counter()
+    c.run(block, auto_propose=True, auto_compact_lag=lag)
+    jax.block_until_ready(c.state.term)
+    compile_s = time.perf_counter() - t0
+    warm = 0
+    while len(c.leader_lanes()) < n_groups and warm < 40 * 16:
+        c.run(block, auto_propose=True, auto_compact_lag=lag)
+        warm += block
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        c.run(block, auto_propose=True, auto_compact_lag=lag)
+        jax.block_until_ready(c.state.term)
+        best = min(best, time.perf_counter() - t0)
+    lanes = n_groups * n_voters
+    round_ms = 1000 * best / block
+    print(
+        json.dumps(
+            {
+                "groups": n_groups,
+                "voters": n_voters,
+                "lanes": lanes,
+                "round_ms": round(round_ms, 3),
+                "groups_ticks_per_s": round(n_groups * block / best, 1),
+                "ns_per_lane_round": round(1e6 * best / block / lanes, 2),
+                "compile_s": round(compile_s, 1),
+            }
+        ),
+        flush=True,
+    )
+    del c
+
+
+if __name__ == "__main__":
+    voters = int(os.environ.get("PROBE_VOTERS", 3))
+    shapes = os.environ.get(
+        "PROBE_GROUPS", "4096,16384,65536,131072,262144"
+    )
+    for g in [int(x) for x in shapes.split(",")]:
+        measure(g, voters)

@@ -1,0 +1,370 @@
+//go:build multiraft_xla
+
+// Package multiraft exposes the batched TPU raft engine behind the
+// reference's RawNode API shape (reference: rawnode.go:34-559), over the C
+// ABI declared in raft_tpu/native/multiraft_xla.h. Build with
+//
+//	go build -tags multiraft_xla
+//
+// and link against libmultiraft_xla.so (which embeds CPython and the
+// JAX/XLA engine; set PYTHONPATH to the raft_tpu checkout + site-packages,
+// and JAX_PLATFORMS as appropriate).
+//
+// Messages cross the boundary as raftpb wire bytes — byte-identical to
+// go.etcd.io/raft/v3's own encoding (native/raftpb_codec.cc), so this
+// wrapper marshals/unmarshals with the ordinary raftpb types and a node
+// driven here interoperates with pure-Go raft peers on the wire.
+package multiraft
+
+/*
+#cgo LDFLAGS: -lmultiraft_xla
+#include <stdint.h>
+#include <stdlib.h>
+#include "multiraft_xla.h"
+*/
+import "C"
+
+import (
+	"encoding/binary"
+	"errors"
+	"fmt"
+	"unsafe"
+
+	pb "go.etcd.io/raft/v3/raftpb"
+)
+
+// ErrProposalDropped mirrors the reference's retryable proposal refusal
+// (reference: raft.go:30).
+var ErrProposalDropped = errors.New("raft proposal dropped")
+
+func lastError() error {
+	buf := make([]byte, 512)
+	C.mrx_last_error((*C.char)(unsafe.Pointer(&buf[0])), C.int64_t(len(buf)))
+	n := 0
+	for n < len(buf) && buf[n] != 0 {
+		n++
+	}
+	return fmt.Errorf("multiraft_xla: %s", string(buf[:n]))
+}
+
+// Engine hosts one raft group of n voters (ids 1..n) on the batched
+// engine; lane i drives voter i+1. One Engine per process group; RawNode
+// handles are thread-unsafe like the reference's (rawnode.go:31).
+type Engine struct {
+	h C.int64_t
+}
+
+func NewEngine(nodes int) (*Engine, error) {
+	if rc := C.mrx_init(); rc != 0 {
+		return nil, lastError()
+	}
+	h := C.mrx_engine_new(C.int32_t(nodes))
+	if h <= 0 {
+		return nil, lastError()
+	}
+	return &Engine{h: h}, nil
+}
+
+func (e *Engine) Close() {
+	C.mrx_engine_free(e.h)
+}
+
+// RawNode returns the driver for voter id (1-based), API-compatible with
+// the subset of the reference RawNode the contract requires
+// (doc.go:69-145): Tick/Campaign/Propose/Step/HasReady/Ready/Advance.
+func (e *Engine) RawNode(id uint64) *RawNode {
+	return &RawNode{eng: e, lane: C.int32_t(id - 1)}
+}
+
+type SoftState struct {
+	Lead      uint64
+	RaftState uint32
+}
+
+// Ready mirrors the reference's Ready bundle (node.go:52-115). Persist
+// Entries/HardState/Snapshot, send Messages, apply CommittedEntries, then
+// Advance.
+type Ready struct {
+	Messages         []pb.Message
+	Entries          []pb.Entry
+	CommittedEntries []pb.Entry
+	HardState        pb.HardState
+	HasHardState     bool
+	MustSync         bool
+	SoftState        *SoftState
+	Snapshot         *pb.Snapshot
+}
+
+type RawNode struct {
+	eng  *Engine
+	lane C.int32_t
+}
+
+func (r *RawNode) Tick() error {
+	if rc := C.mrx_tick(r.eng.h, r.lane); rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (r *RawNode) Campaign() error {
+	if rc := C.mrx_campaign(r.eng.h, r.lane); rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (r *RawNode) Propose(data []byte) error {
+	var p *C.uint8_t
+	if len(data) > 0 {
+		p = (*C.uint8_t)(unsafe.Pointer(&data[0]))
+	}
+	rc := C.mrx_propose(r.eng.h, r.lane, p, C.int64_t(len(data)))
+	switch rc {
+	case 0:
+		return nil
+	case 1:
+		return ErrProposalDropped
+	default:
+		return lastError()
+	}
+}
+
+// Step ingests a message from a peer (reference: rawnode.go:108-125).
+func (r *RawNode) Step(m pb.Message) error {
+	wire, err := m.Marshal()
+	if err != nil {
+		return err
+	}
+	var p *C.uint8_t
+	if len(wire) > 0 {
+		p = (*C.uint8_t)(unsafe.Pointer(&wire[0]))
+	}
+	rc := C.mrx_step_wire(r.eng.h, r.lane, p, C.int64_t(len(wire)))
+	switch rc {
+	case 0:
+		return nil
+	case 1:
+		return ErrProposalDropped
+	default:
+		return lastError()
+	}
+}
+
+func (r *RawNode) HasReady() bool {
+	return C.mrx_has_ready(r.eng.h, r.lane) == 1
+}
+
+// Ready accepts and returns the next Ready; pair with Advance (reference:
+// rawnode.go:141-200, 479-491).
+func (r *RawNode) Ready() (*Ready, error) {
+	cap := int64(1 << 16)
+	for {
+		buf := make([]byte, cap)
+		n := C.mrx_ready(r.eng.h, r.lane,
+			(*C.uint8_t)(unsafe.Pointer(&buf[0])), C.int64_t(cap))
+		if n >= 0 {
+			return parseReady(buf[:n])
+		}
+		if int64(-n) <= cap {
+			return nil, lastError()
+		}
+		cap = int64(-n)
+	}
+}
+
+func (r *RawNode) Advance() error {
+	if rc := C.mrx_advance(r.eng.h, r.lane); rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// StatusJSON returns the reference-compatible Status.MarshalJSON bytes
+// (status.go:78-97).
+func (r *RawNode) StatusJSON() ([]byte, error) {
+	buf := make([]byte, 1<<16)
+	n := C.mrx_status_json(r.eng.h, r.lane,
+		(*C.char)(unsafe.Pointer(&buf[0])), C.int64_t(len(buf)))
+	if n < 0 {
+		return nil, lastError()
+	}
+	return buf[:n], nil
+}
+
+// parseReady decodes the frame documented in raft_tpu/runtime/embed.py.
+func parseReady(b []byte) (*Ready, error) {
+	rd := &Ready{}
+	i := 0
+	u32 := func() (uint32, error) {
+		if i+4 > len(b) {
+			return 0, errors.New("ready frame truncated")
+		}
+		v := binary.LittleEndian.Uint32(b[i:])
+		i += 4
+		return v, nil
+	}
+	u64 := func() (uint64, error) {
+		if i+8 > len(b) {
+			return 0, errors.New("ready frame truncated")
+		}
+		v := binary.LittleEndian.Uint64(b[i:])
+		i += 8
+		return v, nil
+	}
+	u8 := func() (byte, error) {
+		if i+1 > len(b) {
+			return 0, errors.New("ready frame truncated")
+		}
+		v := b[i]
+		i++
+		return v, nil
+	}
+
+	nMsgs, err := u32()
+	if err != nil {
+		return nil, err
+	}
+	for k := uint32(0); k < nMsgs; k++ {
+		l, err := u32()
+		if err != nil {
+			return nil, err
+		}
+		if i+int(l) > len(b) {
+			return nil, errors.New("ready frame truncated")
+		}
+		var m pb.Message
+		if err := m.Unmarshal(b[i : i+int(l)]); err != nil {
+			return nil, err
+		}
+		i += int(l)
+		rd.Messages = append(rd.Messages, m)
+	}
+	readEntries := func() ([]pb.Entry, error) {
+		cnt, err := u32()
+		if err != nil {
+			return nil, err
+		}
+		ents := make([]pb.Entry, 0, cnt)
+		for k := uint32(0); k < cnt; k++ {
+			term, err := u64()
+			if err != nil {
+				return nil, err
+			}
+			index, err := u64()
+			if err != nil {
+				return nil, err
+			}
+			typ, err := u32()
+			if err != nil {
+				return nil, err
+			}
+			dlen, err := u32()
+			if err != nil {
+				return nil, err
+			}
+			if i+int(dlen) > len(b) {
+				return nil, errors.New("ready frame truncated")
+			}
+			var data []byte
+			if dlen > 0 {
+				data = append([]byte(nil), b[i:i+int(dlen)]...)
+			}
+			i += int(dlen)
+			ents = append(ents, pb.Entry{
+				Term: term, Index: index,
+				Type: pb.EntryType(typ), Data: data,
+			})
+		}
+		return ents, nil
+	}
+	if rd.Entries, err = readEntries(); err != nil {
+		return nil, err
+	}
+	if rd.CommittedEntries, err = readEntries(); err != nil {
+		return nil, err
+	}
+	hasHS, err := u8()
+	if err != nil {
+		return nil, err
+	}
+	if hasHS == 1 {
+		term, err := u64()
+		if err != nil {
+			return nil, err
+		}
+		vote, err := u64()
+		if err != nil {
+			return nil, err
+		}
+		commit, err := u64()
+		if err != nil {
+			return nil, err
+		}
+		rd.HardState = pb.HardState{Term: term, Vote: vote, Commit: commit}
+		rd.HasHardState = true
+	}
+	ms, err := u8()
+	if err != nil {
+		return nil, err
+	}
+	rd.MustSync = ms == 1
+	hasSS, err := u8()
+	if err != nil {
+		return nil, err
+	}
+	if hasSS == 1 {
+		lead, err := u64()
+		if err != nil {
+			return nil, err
+		}
+		st, err := u32()
+		if err != nil {
+			return nil, err
+		}
+		rd.SoftState = &SoftState{Lead: lead, RaftState: st}
+	}
+	hasSnap, err := u8()
+	if err != nil {
+		return nil, err
+	}
+	if hasSnap == 1 {
+		index, err := u64()
+		if err != nil {
+			return nil, err
+		}
+		term, err := u64()
+		if err != nil {
+			return nil, err
+		}
+		dlen, err := u32()
+		if err != nil {
+			return nil, err
+		}
+		if i+int(dlen) > len(b) {
+			return nil, errors.New("ready frame truncated")
+		}
+		data := append([]byte(nil), b[i:i+int(dlen)]...)
+		i += int(dlen)
+		nv, err := u32()
+		if err != nil {
+			return nil, err
+		}
+		voters := make([]uint64, 0, nv)
+		for k := uint32(0); k < nv; k++ {
+			v, err := u64()
+			if err != nil {
+				return nil, err
+			}
+			voters = append(voters, v)
+		}
+		rd.Snapshot = &pb.Snapshot{
+			Data: data,
+			Metadata: pb.SnapshotMetadata{
+				Index: index, Term: term,
+				ConfState: pb.ConfState{Voters: voters},
+			},
+		}
+	}
+	return rd, nil
+}

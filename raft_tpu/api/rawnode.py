@@ -49,6 +49,12 @@ from raft_tpu.types import (
 I32 = jnp.int32
 
 
+class ErrProposalDropped(Exception):
+    """The proposal was not appended or forwarded — retry later (reference:
+    raft.go:30 ErrProposalDropped; returned by Step/Propose so the caller
+    can react, node.go:469)."""
+
+
 # --------------------------------------------------------------------------
 # host-level data model (the raftpb analog)
 
@@ -91,7 +97,9 @@ class Message:
     vote: int = 0
     reject: bool = False
     reject_hint: int = 0
-    context: int = 0
+    # int = engine ticket; bytes = foreign wire context (e.g. a Go peer's
+    # ReadIndex id), interned to a negative ticket at the engine boundary
+    context: int | bytes = 0
     entries: list = dataclasses.field(default_factory=list)
     snapshot: Snapshot | None = None
     # async-storage-writes: messages to deliver once this message's work is
@@ -149,7 +157,7 @@ class ReadState:
     """reference: read_only.go:24-27."""
 
     index: int
-    request_ctx: int
+    request_ctx: int | bytes
 
 
 def _sov(x: int) -> int:
@@ -186,7 +194,9 @@ class EntryStore:
         self._snap: list[Snapshot | None] = [None] * n_lanes
 
     def put(self, lane: int, e: Entry):
-        self._d[lane][e.index] = (e.term, e.type, e.data)
+        # nil payloads (wire Data absent) normalize to b"" at the store
+        # boundary — the engine never distinguishes them (Go doesn't either)
+        self._d[lane][e.index] = (e.term, e.type, e.data or b"")
 
     def get(self, lane: int, index: int, term: int) -> tuple[int, bytes]:
         rec = self._d[lane].get(index)
@@ -242,14 +252,14 @@ def _msg_to_row(msg: Message, e: int) -> dict:
         for k, x in enumerate(msg.entries[:e]):
             if x.type == int(
                 EntryType.ENTRY_CONF_CHANGE_V2
-            ) and _ccm.decode(x.data, v1=False).leave_joint():
+            ) and _ccm.decode(x.data or b"", v1=False).leave_joint():
                 bits |= 1 << k
         row["context"] = bits
     ents = msg.entries[:e]
     row["n_ents"] = len(ents)
     row["ent_term"] = [x.term for x in ents] + [0] * (e - len(ents))
     row["ent_type"] = [x.type for x in ents] + [0] * (e - len(ents))
-    row["ent_bytes"] = [len(x.data) for x in ents] + [0] * (e - len(ents))
+    row["ent_bytes"] = [len(x.data or b"") for x in ents] + [0] * (e - len(ents))
     snap = msg.snapshot
     row["snap_index"] = snap.index if snap else 0
     row["snap_term"] = snap.term if snap else 0
@@ -288,6 +298,11 @@ def _compiled_kernels(max_entries: int):
         jax.jit(partial(stepmod.step, max_entries=max_entries)),
         jax.jit(lambda s, m: stepmod.tick(s, max_entries, m)),
         jax.jit(partial(stepmod.post_conf_change, max_entries=max_entries)),
+        jax.jit(
+            lambda s, m, p: stepmod.drain_appends(
+                s, m, p, max_entries=max_entries
+            )
+        ),
     )
 
 
@@ -332,10 +347,48 @@ class RawNodeBatch:
         self._prev_hs = [HardState() for _ in range(n)]
         self._prev_ss = [SoftState() for _ in range(n)]
         self._read_states: list[list[ReadState]] = [[] for _ in range(n)]
+        # foreign (bytes) contexts <-> negative device tickets; the device
+        # only ever needs equality on the i32 ticket (ro_ctx ring / heartbeat
+        # echo), the original bytes are restored on every host-visible surface
+        self._ctx_intern: dict[bytes, int] = {}
+        self._ctx_rev: dict[int, bytes] = {}
         e = shape.max_msg_entries
-        self._step_fn, self._tick_fn, self._post_cc_fn = _compiled_kernels(e)
+        (
+            self._step_fn,
+            self._tick_fn,
+            self._post_cc_fn,
+            self._drain_fn,
+        ) = _compiled_kernels(e)
 
     # -- kernel plumbing ---------------------------------------------------
+
+    def _ctx_ticket(self, ctx) -> int:
+        """Map a message context to the device's i32 ticket: ints pass
+        through; foreign byte strings intern to a negative ticket (app int
+        tickets are conventionally >= 0; engine-internal contexts are small
+        positives)."""
+        if not isinstance(ctx, bytes):
+            return int(ctx)
+        if not ctx:
+            return 0
+        t = self._ctx_intern.get(ctx)
+        if t is None:
+            t = -(len(self._ctx_intern) + 2)
+            self._ctx_intern[ctx] = t
+            self._ctx_rev[t] = ctx
+        return t
+
+    def _ctx_out(self, ticket: int):
+        """Restore the original bytes for interned tickets."""
+        return self._ctx_rev.get(ticket, ticket)
+
+    def _ctx_release(self, ticket: int):
+        """Drop an interned mapping once its last engine artifact (the
+        ReadState or the MsgReadIndexResp back to the requester) has been
+        surfaced — the intern table must not grow with request count."""
+        b = self._ctx_rev.pop(ticket, None)
+        if b is not None:
+            self._ctx_intern.pop(b, None)
 
     def _inbox_one(self, lane: int, msg: Message) -> MsgBatch:
         n, e = self.shape.n, self.shape.max_msg_entries
@@ -364,6 +417,7 @@ class RawNodeBatch:
         )}
         for lane, slot in zip(*hot):
             lane, slot = int(lane), int(slot)
+            ctx_ticket = int(cols["context"][lane, slot])
             m = Message(
                 type=int(cols["type"][lane, slot]),
                 to=int(cols["to"][lane, slot]),
@@ -374,8 +428,11 @@ class RawNodeBatch:
                 commit=int(cols["commit"][lane, slot]),
                 reject=bool(cols["reject"][lane, slot]),
                 reject_hint=int(cols["reject_hint"][lane, slot]),
-                context=int(cols["context"][lane, slot]),
+                context=self._ctx_out(ctx_ticket),
             )
+            if m.type == int(MT.MSG_READ_INDEX_RESP):
+                # the response is this ticket's final engine artifact
+                self._ctx_release(ctx_ticket)
             ne = int(cols["n_ents"][lane, slot])
             if ne and m.type == int(MT.MSG_PROP):
                 # proposal forwarded to the leader: entries ride verbatim with
@@ -429,6 +486,8 @@ class RawNodeBatch:
 
     def _run_step(self, lane: int, msg: Message):
         """One kernel invocation with a single hot lane; payload bookkeeping."""
+        if isinstance(msg.context, bytes):
+            msg = dataclasses.replace(msg, context=self._ctx_ticket(msg.context))
         pre = self.trace.snapshot(lane) if self.trace is not None else None
         old_last = int(self.view.last[lane])
         old_term = int(self.view.term[lane])
@@ -447,6 +506,53 @@ class RawNodeBatch:
         if self.trace is not None:
             self.trace.after_step(lane, msg, pre)
         self._collect_out(out, src_msg=msg)
+        # post-ack drain loop (reference: raft.go:1516-1518): an accepted
+        # MsgAppResp may have freed several inflight slots / switched the
+        # peer to replicate — keep sending until flow control pauses
+        if (
+            msg.type == int(MT.MSG_APP_RESP)
+            and not msg.reject
+            and msg.frm != self.id_of(lane)  # raft.go:1515 `r.id != m.From`
+        ):
+            self._drain(lane, msg.frm)
+
+    def _drain(self, lane: int, peer_id: int):
+        cap = int(np.asarray(self.state.cfg.max_inflight[lane])) + 1
+        mask = peer = None
+        for _ in range(cap):
+            if not self._has_send_backlog(lane, peer_id):
+                break
+            if mask is None:
+                mask = jnp.zeros((self.shape.n,), bool).at[lane].set(True)
+                peer = jnp.zeros((self.shape.n,), I32).at[lane].set(peer_id)
+            self.state, out = self._drain_fn(self.state, mask, peer)
+            self.view.refresh(self.state)
+            if not (np.asarray(out.type) != int(MT.MSG_NONE)).any():
+                break
+            self._collect_out(out)
+
+    def _has_send_backlog(self, lane: int, peer_id: int) -> bool:
+        """Host-side fast path for the drain loop: does the acking peer
+        still have unsent entries and room? (Mirrors maybe_send_append's
+        gate coarsely — the kernel re-checks exactly.)"""
+        v = self.view
+        if int(v.state[lane]) != int(StateType.LEADER):
+            return False
+        ids = v.prs_id[lane]
+        sel = ids == peer_id
+        if not sel.any() or peer_id == int(v.id[lane]):
+            return False
+        backlog = v.pr_next[lane] <= int(v.last[lane])
+        ps = v.pr_state[lane]
+        full = v.infl_count[lane] >= int(
+            np.asarray(self.state.cfg.max_inflight[lane])
+        )
+        paused = (
+            ((ps == int(ProgressState.PROBE)) & v.pr_msg_app_flow_paused[lane])
+            | ((ps == int(ProgressState.REPLICATE)) & full)
+            | (ps == int(ProgressState.SNAPSHOT))
+        )
+        return bool((sel & backlog & ~paused).any())
 
     def _rewind_inprog(self, lane: int, old_lt, old_stabled: int, old_last: int):
         """Mirror of unstable.truncateAndAppend's offsetInProgress rewind
@@ -507,9 +613,15 @@ class RawNodeBatch:
             msg = dataclasses.replace(
                 msg,
                 index=msg.entries[-1].index,
-                commit=sum(len(e.data) for e in msg.entries),
+                commit=sum(len(e.data or b"") for e in msg.entries),
                 entries=[],
             )
+        if msg.type == int(MT.MSG_PROP):
+            # Step(MsgProp) surfaces ErrProposalDropped like the reference
+            # (rawnode.go:108-125 -> raft.Step); transports deciding to
+            # drop-and-forget catch it
+            self._step_prop(lane, msg)
+            return
         self._run_step(lane, msg)
         # async mode: appliedTo may arm the auto-leave proposal
         # (reference: raft.go:717-745); sync mode does this in advance()
@@ -539,8 +651,11 @@ class RawNodeBatch:
         self._run_step(lane, Message(type=int(MT.MSG_HUP), to=self.id_of(lane)))
 
     def propose(self, lane: int, data: bytes):
+        """Raises ErrProposalDropped when the proposal neither lands in the
+        local log nor is forwarded to a leader (reference: node.go:469 /
+        raft.go:1244-1302, 1671-1680)."""
         nid = self.id_of(lane)
-        self._run_step(
+        self._step_prop(
             lane,
             Message(
                 type=int(MT.MSG_PROP), to=nid, frm=nid, entries=[Entry(data=data)]
@@ -550,7 +665,7 @@ class RawNodeBatch:
     def propose_conf_change(self, lane: int, cc_data: bytes, v2: bool = False):
         nid = self.id_of(lane)
         t = EntryType.ENTRY_CONF_CHANGE_V2 if v2 else EntryType.ENTRY_CONF_CHANGE
-        self._run_step(
+        self._step_prop(
             lane,
             Message(
                 type=int(MT.MSG_PROP),
@@ -559,6 +674,22 @@ class RawNodeBatch:
                 entries=[Entry(type=int(t), data=cc_data)],
             ),
         )
+
+    def _step_prop(self, lane: int, msg: Message):
+        """Step a MsgProp and surface ErrProposalDropped: accepted means the
+        lane's log grew (leader append) or a forwarded MsgProp was emitted
+        (follower with a known leader)."""
+        old_last = int(self.view.last[lane])
+        n_fwd_before = sum(
+            1 for m in self._msgs[lane] if m.type == int(MT.MSG_PROP)
+        )
+        self._run_step(lane, msg)
+        if int(self.view.last[lane]) > old_last:
+            return
+        n_fwd = sum(1 for m in self._msgs[lane] if m.type == int(MT.MSG_PROP))
+        if n_fwd > n_fwd_before:
+            return
+        raise ErrProposalDropped()
 
     def transfer_leadership(self, lane: int, transferee: int):
         self._run_step(
@@ -589,7 +720,7 @@ class RawNodeBatch:
             ),
         )
 
-    def read_index(self, lane: int, ctx: int):
+    def read_index(self, lane: int, ctx: int | bytes):
         nid = self.id_of(lane)
         self._run_step(
             lane, Message(type=int(MT.MSG_READ_INDEX), to=nid, frm=nid, context=ctx)
@@ -681,7 +812,10 @@ class RawNodeBatch:
         # drain the device-side ReadState ring (reference: raft.go:371)
         nrs = int(v.rs_count[lane])
         rd.read_states = [
-            ReadState(index=int(v.rs_index[lane, r]), request_ctx=int(v.rs_ctx[lane, r]))
+            ReadState(
+                index=int(v.rs_index[lane, r]),
+                request_ctx=self._ctx_out(int(v.rs_ctx[lane, r])),
+            )
             for r in range(nrs)
         ] + list(self._read_states[lane])
         # reference: rawnode.go:193-200 MustSync (entries, vote or term only)
@@ -712,6 +846,8 @@ class RawNodeBatch:
                 if rd.committed_entries:
                     self._applying[lane] = rd.committed_entries[-1].index
             if nrs:
+                for r_ in range(nrs):
+                    self._ctx_release(int(v.rs_ctx[lane, r_]))
                 self.state = dataclasses.replace(
                     self.state, rs_count=self.state.rs_count.at[lane].set(0)
                 )
@@ -842,9 +978,171 @@ class RawNodeBatch:
 
             if self.trace is not None:
                 self.trace.auto_leave_initiated(lane)
-            self.propose_conf_change(
-                lane, _ccm.encode(_ccm.ConfChangeV2()), v2=True
+            try:
+                self.propose_conf_change(
+                    lane, _ccm.encode(_ccm.ConfChangeV2()), v2=True
+                )
+            except ErrProposalDropped:
+                # retried on a later applied-advance (reference:
+                # raft.go:735-743 logs and moves on)
+                pass
+
+    # -- restart/recovery (reference: node.go:281-289 RestartNode,
+    # raft.go:432-477 newRaft from Storage, doc.go:46-67) ------------------
+
+    def restart_lane(self, lane: int, storage, applied: int = 0):
+        """Rebuild this lane from persisted state — the batched analog of
+        `RestartNode`/`NewRawNode` reading `Storage.InitialState` + stored
+        entries (reference: node.go:281-289, raft.go:432-477, doc.go:46-67).
+
+        `storage` is a `raft_tpu.storage.MemoryStorage` (or anything with
+        its read interface) recovered from disk; `applied` is the caller's
+        last applied index (Config.Applied, raft.go:181-186) — entries at or
+        below it are not re-emitted in CommittedEntries.
+        """
+        from raft_tpu import confchange as ccm
+        from raft_tpu.state import draw_timeout
+
+        hs, snap_meta = storage.initial_state()
+        snap_index = storage.first_index() - 1
+        snap_term = storage.term(snap_index) if snap_index else 0
+        last = storage.last_index()
+        w = self.shape.w
+        if last - snap_index > w - 1:
+            raise ValueError(
+                f"persisted log spans {last - snap_index} entries; device "
+                f"window holds {w - 1} — compact the storage before restart"
             )
+        if hs.commit > last:
+            raise ValueError(
+                f"hardstate commit {hs.commit} out of range [0, {last}]"
+            )  # reference: raft.go:1972-1976 loadState panic
+        applied = max(applied, snap_index)
+        if applied > hs.commit:
+            raise ValueError(
+                f"applied {applied} cannot exceed committed {hs.commit}"
+            )
+
+        nid = self.id_of(lane)
+        n, v = self.shape.n, self.shape.v
+        # log window columns from storage
+        log_term = np.zeros((w,), np.int32)
+        log_type = np.zeros((w,), np.int32)
+        log_bytes = np.zeros((w,), np.int32)
+        self.store.truncate_from(lane, 0)
+        for e in storage.entries(snap_index + 1, last + 1) if last > snap_index else []:
+            s = e.index & (w - 1)
+            log_term[s] = e.term
+            log_type[s] = e.type
+            log_bytes[s] = len(e.data or b"")
+            self.store.put(lane, e)
+
+        st = self.state
+        zero_v = jnp.zeros((v,), I32)
+        false_v = jnp.zeros((v,), jnp.bool_)
+        f = st.infl_index.shape[-1]
+        r = st.ro_ctx.shape[-1]
+        new_to = draw_timeout(
+            st.rng[lane][None], st.cfg.election_tick[lane][None]
+        )[0]
+        st = dataclasses.replace(
+            st,
+            # loadState + becomeFollower(term, None) (raft.go:470-476)
+            term=st.term.at[lane].set(hs.term),
+            vote=st.vote.at[lane].set(hs.vote),
+            state=st.state.at[lane].set(int(StateType.FOLLOWER)),
+            lead=st.lead.at[lane].set(0),
+            lead_transferee=st.lead_transferee.at[lane].set(0),
+            pending_conf_index=st.pending_conf_index.at[lane].set(0),
+            uncommitted_size=st.uncommitted_size.at[lane].set(0),
+            election_elapsed=st.election_elapsed.at[lane].set(0),
+            heartbeat_elapsed=st.heartbeat_elapsed.at[lane].set(0),
+            randomized_election_timeout=(
+                st.randomized_election_timeout.at[lane].set(new_to)
+            ),
+            log_term=st.log_term.at[lane].set(jnp.asarray(log_term)),
+            log_type=st.log_type.at[lane].set(jnp.asarray(log_type)),
+            log_bytes=st.log_bytes.at[lane].set(jnp.asarray(log_bytes)),
+            last=st.last.at[lane].set(last),
+            stabled=st.stabled.at[lane].set(last),
+            committed=st.committed.at[lane].set(hs.commit),
+            applying=st.applying.at[lane].set(applied),
+            applied=st.applied.at[lane].set(applied),
+            snap_index=st.snap_index.at[lane].set(snap_index),
+            snap_term=st.snap_term.at[lane].set(snap_term),
+            pending_snap_index=st.pending_snap_index.at[lane].set(0),
+            pending_snap_term=st.pending_snap_term.at[lane].set(0),
+            avail_snap_index=st.avail_snap_index.at[lane].set(0),
+            avail_snap_term=st.avail_snap_term.at[lane].set(0),
+            # empty config until restored below (raft.go:452-461)
+            prs_id=st.prs_id.at[lane].set(zero_v),
+            voters_in=st.voters_in.at[lane].set(false_v),
+            voters_out=st.voters_out.at[lane].set(false_v),
+            learners=st.learners.at[lane].set(false_v),
+            learners_next=st.learners_next.at[lane].set(false_v),
+            auto_leave=st.auto_leave.at[lane].set(False),
+            is_learner=st.is_learner.at[lane].set(False),
+            pr_match=st.pr_match.at[lane].set(zero_v),
+            pr_next=st.pr_next.at[lane].set(jnp.ones((v,), I32)),
+            pr_state=st.pr_state.at[lane].set(zero_v),
+            pr_pending_snapshot=st.pr_pending_snapshot.at[lane].set(zero_v),
+            pr_recent_active=st.pr_recent_active.at[lane].set(false_v),
+            pr_msg_app_flow_paused=st.pr_msg_app_flow_paused.at[lane].set(false_v),
+            votes=st.votes.at[lane].set(zero_v),
+            infl_index=st.infl_index.at[lane].set(jnp.zeros((v, f), I32)),
+            infl_bytes=st.infl_bytes.at[lane].set(jnp.zeros((v, f), I32)),
+            infl_start=st.infl_start.at[lane].set(zero_v),
+            infl_count=st.infl_count.at[lane].set(zero_v),
+            infl_total_bytes=st.infl_total_bytes.at[lane].set(zero_v),
+            ro_ctx=st.ro_ctx.at[lane].set(jnp.zeros((r,), I32)),
+            ro_from=st.ro_from.at[lane].set(jnp.zeros((r,), I32)),
+            ro_index=st.ro_index.at[lane].set(jnp.zeros((r,), I32)),
+            ro_acks=st.ro_acks.at[lane].set(jnp.zeros((r, v), jnp.bool_)),
+            ro_seq=st.ro_seq.at[lane].set(jnp.zeros((r,), I32)),
+            ro_next_seq=st.ro_next_seq.at[lane].set(1),
+            pri_ctx=st.pri_ctx.at[lane].set(jnp.zeros((r,), I32)),
+            pri_from=st.pri_from.at[lane].set(jnp.zeros((r,), I32)),
+            rs_ctx=st.rs_ctx.at[lane].set(jnp.zeros((r,), I32)),
+            rs_index=st.rs_index.at[lane].set(jnp.zeros((r,), I32)),
+            rs_count=st.rs_count.at[lane].set(0),
+            error_bits=st.error_bits.at[lane].set(0),
+        )
+        self.state = st
+        self.view.refresh(st)
+
+        # membership from the snapshot's ConfState via confchange.Restore
+        # (raft.go:452-461); empty ConfState = membership rebuilt by the app
+        # re-applying committed conf-change entries above `applied`
+        cs = ccm.ConfState(
+            voters=tuple(snap_meta.voters),
+            learners=tuple(snap_meta.learners),
+            voters_outgoing=tuple(snap_meta.voters_outgoing),
+            learners_next=tuple(snap_meta.learners_next),
+            auto_leave=bool(snap_meta.auto_leave),
+        )
+        if cs.voters or cs.learners or cs.voters_outgoing:
+            cfg, trk = ccm.restore(cs, last_index=last)
+            if nid in trk:
+                # the local node's progress is fully caught up with itself
+                # (confchange/restore.go:144-155 via Changer.initProgress)
+                trk[nid].match = last
+                trk[nid].next = last + 1
+            self._write_tracker(lane, cfg, trk)
+        if snap_meta.index:
+            self.set_app_snapshot(lane, snap_meta)
+
+        # host bookkeeping resets (fresh RawNode over recovered state;
+        # rawnode.go:51-66 seeds prev hard/soft state so the boot state
+        # does not surface as a spurious first Ready)
+        self._msgs[lane] = []
+        self._after_append[lane] = []
+        self._steps_on_advance[lane] = []
+        self._read_states[lane] = []
+        self._inprog[lane] = 0
+        self._applying[lane] = applied
+        self._prev_hs[lane] = HardState(hs.term, hs.vote, hs.commit)
+        self._prev_ss[lane] = SoftState(0, int(StateType.FOLLOWER))
+        getattr(self, "_accepted", {}).pop(lane, None)
 
     # -- snapshot/compaction (reference: storage.go:227-272) ---------------
 

@@ -127,25 +127,17 @@ def _route_grouped(out, m_in, v, drop_mask):
     return inbox, dropped
 
 
-def _route_sorted(out, src_group, lane_of, m_in, drop_mask, lane_offset):
-    """General path: stable sort by destination lane (arbitrary id->lane
-    maps), segment extraction via searchsorted."""
-    n, s = out.type.shape
-    k = n * s
+def deliver_flat(flat, dst, valid, n, m_in):
+    """Deliver a flat candidate pool into per-lane inboxes [n, m_in].
 
-    flat = jax.tree.map(lambda x: x.reshape((k,) + x.shape[2:]), out)
-    src_lane = jnp.repeat(jnp.arange(n, dtype=I32), s)
-    group = src_group[src_lane]
-    valid = flat.type != MT.MSG_NONE
-    if drop_mask is not None:
-        valid = valid & ~drop_mask.reshape(k)
-    # ids outside lane_of's domain are undeliverable: drop + count (never
-    # clip-misdeliver to another lane)
-    in_range = (flat.to >= 0) & (flat.to < lane_of.shape[1])
-    to = jnp.clip(flat.to, 0, lane_of.shape[1] - 1)
-    dst = jnp.where(valid & in_range, lane_of[group, to] - lane_offset, -1)
-    undeliverable = jnp.sum((valid & ((dst < 0) | (dst >= n))).astype(I32))
-    valid = valid & (dst >= 0) & (dst < n)
+    flat: pytree of [K, ...] message columns; dst: [K] local destination
+    lane (values outside [0, n) while valid count as dropped); valid: [K].
+    Stable sort by destination preserves candidate order. Returns
+    (inbox, n_dropped)."""
+    k = dst.shape[0]
+    out_of_range = valid & ((dst < 0) | (dst >= n))
+    undeliverable = jnp.sum(out_of_range.astype(I32))
+    valid = valid & ~out_of_range
 
     # stable sort by destination; invalid messages sort to the end
     key = jnp.where(valid, dst, n)
@@ -168,6 +160,26 @@ def _route_sorted(out, src_group, lane_of, m_in, drop_mask, lane_offset):
         inbox, type=jnp.where(ok, inbox.type, jnp.int32(MT.MSG_NONE))
     )
     return inbox, dropped
+
+
+def _route_sorted(out, src_group, lane_of, m_in, drop_mask, lane_offset):
+    """General path: stable sort by destination lane (arbitrary id->lane
+    maps), segment extraction via searchsorted."""
+    n, s = out.type.shape
+    k = n * s
+
+    flat = jax.tree.map(lambda x: x.reshape((k,) + x.shape[2:]), out)
+    src_lane = jnp.repeat(jnp.arange(n, dtype=I32), s)
+    group = src_group[src_lane]
+    valid = flat.type != MT.MSG_NONE
+    if drop_mask is not None:
+        valid = valid & ~drop_mask.reshape(k)
+    # ids outside lane_of's domain are undeliverable: drop + count (never
+    # clip-misdeliver to another lane)
+    in_range = (flat.to >= 0) & (flat.to < lane_of.shape[1])
+    to = jnp.clip(flat.to, 0, lane_of.shape[1] - 1)
+    dst = jnp.where(valid & in_range, lane_of[group, to] - lane_offset, -1)
+    return deliver_flat(flat, dst, valid, n, m_in)
 
 
 def scan_step(state: RaftState, inbox: MsgBatch) -> tuple[RaftState, MsgBatch]:

@@ -1,0 +1,198 @@
+// multiraft_xla.cc — C ABI over the batched engine via an embedded CPython.
+//
+// The compute path stays JAX/XLA; this is the runtime glue that lets a Go
+// (or any C-ABI) application drive RawNodeBatch the way it would drive the
+// reference's RawNode (rawnode.go:34-559). Dispatches to
+// raft_tpu.runtime.embed; every boundary value is plain bytes/ints.
+//
+// Build: make -f Makefile multiraft (links libpython3.12).
+
+#include "multiraft_xla.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_err_mu;
+std::string g_last_error;
+
+void set_error(const std::string& e) {
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  g_last_error = e;
+}
+
+void capture_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+PyObject* g_embed = nullptr;  // raft_tpu.runtime.embed module
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+// Call embed.<fn>(args...) returning a new reference (nullptr on error).
+PyObject* call(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_embed, fn);
+  if (f == nullptr) {
+    capture_py_error();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (r == nullptr) capture_py_error();
+  return r;
+}
+
+int call_int(const char* fn, PyObject* args) {
+  PyObject* r = call(fn, args);
+  if (r == nullptr) return -1;
+  long v = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (v == -1 && PyErr_Occurred()) {
+    capture_py_error();
+    return -1;
+  }
+  return static_cast<int>(v);
+}
+
+int64_t copy_bytes_out(PyObject* r, uint8_t* buf, int64_t cap) {
+  char* p = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &p, &n) != 0) {
+    capture_py_error();
+    return -1;
+  }
+  if (n > cap) return -static_cast<int64_t>(n);
+  std::memcpy(buf, p, static_cast<size_t>(n));
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+int mrx_init(void) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Py_InitializeEx leaves this thread holding the GIL. Import while we
+    // have it, then DETACH the thread state so any OS thread (e.g. a Go
+    // scheduler moving goroutines between threads) can PyGILState_Ensure
+    // later without deadlocking on the initializer's GIL.
+    g_embed = PyImport_ImportModule("raft_tpu.runtime.embed");
+    bool ok = g_embed != nullptr;
+    if (!ok) capture_py_error();
+    PyEval_SaveThread();
+    return ok ? 0 : -1;
+  }
+  Gil gil;
+  if (g_embed == nullptr) {
+    g_embed = PyImport_ImportModule("raft_tpu.runtime.embed");
+    if (g_embed == nullptr) {
+      capture_py_error();
+      return -1;
+    }
+  }
+  return 0;
+}
+
+int64_t mrx_engine_new(int32_t n_nodes) {
+  Gil gil;
+  PyObject* r = call("engine_new", Py_BuildValue("(i)", n_nodes));
+  if (r == nullptr) return -1;
+  int64_t h = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return h;
+}
+
+void mrx_engine_free(int64_t h) {
+  Gil gil;
+  PyObject* r = call("engine_free", Py_BuildValue("(L)", h));
+  Py_XDECREF(r);
+}
+
+int mrx_campaign(int64_t h, int32_t lane) {
+  Gil gil;
+  return call_int("campaign", Py_BuildValue("(Li)", h, lane));
+}
+
+int mrx_tick(int64_t h, int32_t lane) {
+  Gil gil;
+  return call_int("tick", Py_BuildValue("(Li)", h, lane));
+}
+
+int mrx_propose(int64_t h, int32_t lane, const uint8_t* data, int64_t len) {
+  Gil gil;
+  return call_int(
+      "propose",
+      Py_BuildValue("(Liy#)", h, lane, reinterpret_cast<const char*>(data),
+                    static_cast<Py_ssize_t>(len)));
+}
+
+int mrx_step_wire(int64_t h, int32_t lane, const uint8_t* msg, int64_t len) {
+  Gil gil;
+  return call_int(
+      "step_wire",
+      Py_BuildValue("(Liy#)", h, lane, reinterpret_cast<const char*>(msg),
+                    static_cast<Py_ssize_t>(len)));
+}
+
+int mrx_has_ready(int64_t h, int32_t lane) {
+  Gil gil;
+  return call_int("has_ready", Py_BuildValue("(Li)", h, lane));
+}
+
+int64_t mrx_ready(int64_t h, int32_t lane, uint8_t* buf, int64_t cap) {
+  Gil gil;
+  PyObject* r = call("ready_wire", Py_BuildValue("(Li)", h, lane));
+  if (r == nullptr) return -1;
+  int64_t n = copy_bytes_out(r, buf, cap);
+  Py_DECREF(r);
+  return n;
+}
+
+int mrx_advance(int64_t h, int32_t lane) {
+  Gil gil;
+  return call_int("advance", Py_BuildValue("(Li)", h, lane));
+}
+
+int64_t mrx_status_json(int64_t h, int32_t lane, char* buf, int64_t cap) {
+  Gil gil;
+  PyObject* r = call("status_json", Py_BuildValue("(Li)", h, lane));
+  if (r == nullptr) return -1;
+  int64_t n = copy_bytes_out(r, reinterpret_cast<uint8_t*>(buf), cap);
+  Py_DECREF(r);
+  return n;
+}
+
+void mrx_last_error(char* buf, int64_t cap) {
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  if (cap <= 0) return;
+  std::snprintf(buf, static_cast<size_t>(cap), "%s", g_last_error.c_str());
+}
+
+}  // extern "C"

@@ -1,0 +1,65 @@
+/* multiraft_xla.h — C ABI over the batched TPU raft engine.
+ *
+ * The TPU-native analog of the reference's public Go API (reference:
+ * rawnode.go:34-559, node.go:132-243): a Go program built with
+ * `-tags multiraft_xla` drives the engine through these exports (see
+ * go/multiraft_xla.go), with raftpb wire bytes as the only message type
+ * crossing the boundary — byte-identical to what a Go raft peer emits
+ * (native/raftpb_codec.cc).
+ *
+ * The implementation (multiraft_xla.cc) embeds CPython and dispatches to
+ * raft_tpu.runtime.embed. All calls are GIL-serialized; handles are engine
+ * ids. Thread contract matches the reference RawNode: one driving thread
+ * per engine (rawnode.go:31).
+ */
+#ifndef MULTIRAFT_XLA_H
+#define MULTIRAFT_XLA_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Return codes: 0 = ok, 1 = ErrProposalDropped (retryable, reference
+ * raft.go:30), < 0 = error (mrx_last_error has details). */
+
+/* Initialize the embedded runtime. Safe to call more than once. */
+int mrx_init(void);
+
+/* Create an engine hosting one raft group of n_nodes voters (raft ids
+ * 1..n_nodes, lane i drives voter i+1). Returns handle > 0, or < 0. */
+int64_t mrx_engine_new(int32_t n_nodes);
+void mrx_engine_free(int64_t h);
+
+/* RawNode.Campaign / Tick / Propose (reference: rawnode.go:69-106). */
+int mrx_campaign(int64_t h, int32_t lane);
+int mrx_tick(int64_t h, int32_t lane);
+int mrx_propose(int64_t h, int32_t lane, const uint8_t* data, int64_t len);
+
+/* RawNode.Step with a raftpb-wire-encoded message (reference:
+ * rawnode.go:108-125). */
+int mrx_step_wire(int64_t h, int32_t lane, const uint8_t* msg, int64_t len);
+
+/* RawNode.HasReady / Ready / Advance (reference: rawnode.go:141-200,
+ * 479-491). mrx_ready writes the packed Ready frame (layout documented in
+ * raft_tpu/runtime/embed.py) and returns the byte count; if cap is too
+ * small returns -(needed). Calling mrx_ready ACCEPTS the Ready — pair it
+ * with mrx_advance. */
+int mrx_has_ready(int64_t h, int32_t lane);
+int64_t mrx_ready(int64_t h, int32_t lane, uint8_t* buf, int64_t cap);
+int mrx_advance(int64_t h, int32_t lane);
+
+/* Status.MarshalJSON, byte-compatible with the reference (status.go:78-97).
+ * Returns bytes written, or -(needed). */
+int64_t mrx_status_json(int64_t h, int32_t lane, char* buf, int64_t cap);
+
+/* Copy the last error message (NUL-terminated, possibly truncated). */
+void mrx_last_error(char* buf, int64_t cap);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MULTIRAFT_XLA_H */

@@ -1,0 +1,293 @@
+// test_multiraft_xla.cc — C-level exercise of the multiraft_xla ABI.
+//
+// 1. Round-trips a raftpb message through the wire codec's C exports
+//    (msg_marshal/msg_unmarshal, raftpb_codec.cc) and checks byte
+//    stability.
+// 2. Drives a full 3-voter raft group end-to-end THROUGH THE C ABI only:
+//    campaign, Ready/Advance loops, wire-encoded message delivery between
+//    lanes, proposal, and commit — the same loop a Go application built
+//    against go/multiraft_xla.go runs (reference: doc.go:69-145).
+//
+// Run via tests/test_go_interop.py (needs PYTHONPATH to the venv +
+// JAX_PLATFORMS=cpu in the environment).
+
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include "multiraft_xla.h"
+
+// raftpb_codec.cc exports (see codec bindings in runtime/codec.py)
+extern "C" {
+int64_t msg_marshal(const uint64_t* scalars, const uint8_t* context,
+                    int64_t context_len, int32_t n_entries,
+                    const uint64_t* ent_scalars, const int64_t* ent_lens,
+                    const uint8_t* ent_data, const uint64_t* snap_meta,
+                    const uint8_t* snap_data, int64_t snap_data_len,
+                    const int32_t* snap_counts, const uint64_t* snap_ids,
+                    int32_t n_resp, const uint64_t* resp_scalars,
+                    uint8_t* out, int64_t cap);
+int64_t msg_unmarshal(const uint8_t* in, int64_t len, uint64_t* scalars,
+                      uint8_t* context, int64_t context_cap,
+                      int64_t* context_len, int32_t* n_entries,
+                      int32_t max_entries, uint64_t* ent_scalars,
+                      int64_t* ent_lens, uint8_t* ent_data,
+                      int64_t ent_data_cap, uint64_t* snap_meta,
+                      uint8_t* snap_data, int64_t snap_data_cap,
+                      int64_t* snap_data_len, int32_t* snap_counts,
+                      uint64_t* snap_ids, int32_t snap_ids_cap,
+                      int32_t* n_resp, int32_t max_resp,
+                      uint64_t* resp_scalars);
+}
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      char err[512];                                                  \
+      mrx_last_error(err, sizeof(err));                               \
+      std::fprintf(stderr, "FAIL %s:%d: %s (last_error: %s)\n",       \
+                   __FILE__, __LINE__, #cond, err);                   \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+// --- minimal proto scan: top-level varint field `field` of a message ---
+static bool wire_field_varint(const uint8_t* p, int64_t n, int field,
+                              uint64_t* out) {
+  int64_t i = 0;
+  while (i < n) {
+    uint64_t tag = 0;
+    int shift = 0;
+    while (i < n) {
+      uint8_t b = p[i++];
+      tag |= (uint64_t)(b & 0x7f) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    int f = (int)(tag >> 3), wt = (int)(tag & 7);
+    uint64_t v = 0;
+    switch (wt) {
+      case 0: {
+        int s = 0;
+        while (i < n) {
+          uint8_t b = p[i++];
+          v |= (uint64_t)(b & 0x7f) << s;
+          if (!(b & 0x80)) break;
+          s += 7;
+        }
+        if (f == field) {
+          *out = v;
+          return true;
+        }
+        break;
+      }
+      case 2: {
+        int s = 0;
+        while (i < n) {
+          uint8_t b = p[i++];
+          v |= (uint64_t)(b & 0x7f) << s;
+          if (!(b & 0x80)) break;
+          s += 7;
+        }
+        i += (int64_t)v;
+        break;
+      }
+      case 1:
+        i += 8;
+        break;
+      case 5:
+        i += 4;
+        break;
+      default:
+        return false;
+    }
+  }
+  return false;
+}
+
+static int codec_roundtrip_test() {
+  // MsgApp: type=3, to=2, from=1, term=5, logterm=4, index=10, commit=9,
+  // one entry (term 5, index 11, data "ab")
+  uint64_t scalars[11] = {3, 2, 1, 5, 4, 10, 9, 0, 0, 0, 0};
+  uint64_t ent_scalars[3] = {0, 5, 11};
+  int64_t ent_lens[1] = {2};
+  const uint8_t ent_data[] = {'a', 'b'};
+  uint64_t snap_meta[3] = {0, 0, 0};
+  int32_t snap_counts[4] = {0, 0, 0, 0};
+  uint64_t snap_ids[1] = {0};
+  uint64_t resp_scalars[1] = {0};
+  uint8_t wire[512];
+  int64_t n = msg_marshal(scalars, nullptr, -1, 1, ent_scalars, ent_lens,
+                          ent_data, snap_meta, nullptr, -1, snap_counts,
+                          snap_ids, 0, resp_scalars, wire, sizeof(wire));
+  if (n <= 0) {
+    std::fprintf(stderr, "marshal failed: %" PRId64 "\n", n);
+    return 1;
+  }
+
+  uint64_t s2[11];
+  uint8_t ctx[64];
+  int64_t ctx_len = -1;
+  int32_t n_ents = 0;
+  uint64_t es2[3 * 8];
+  int64_t el2[8];
+  uint8_t ed2[256];
+  uint64_t sm2[3];
+  uint8_t sd2[256];
+  int64_t sdl2 = -1;
+  int32_t sc2[4];
+  uint64_t sids2[16];
+  int32_t n_resp = 0;
+  uint64_t rs2[11 * 4];
+  int rc = (int)msg_unmarshal(wire, n, s2, ctx, sizeof(ctx), &ctx_len,
+                              &n_ents, 8, es2, el2, ed2, sizeof(ed2), sm2,
+                              sd2, sizeof(sd2), &sdl2, sc2, sids2, 16,
+                              &n_resp, 4, rs2);
+  if (rc != 0) {
+    std::fprintf(stderr, "unmarshal failed: %d\n", rc);
+    return 1;
+  }
+  for (int i = 0; i < 11; i++) {
+    if (s2[i] != scalars[i]) {
+      std::fprintf(stderr, "scalar %d mismatch: %" PRIu64 " != %" PRIu64 "\n",
+                   i, s2[i], scalars[i]);
+      return 1;
+    }
+  }
+  if (n_ents != 1 || el2[0] != 2 || std::memcmp(ed2, "ab", 2) != 0) {
+    std::fprintf(stderr, "entry mismatch\n");
+    return 1;
+  }
+  // re-marshal: byte-stable
+  uint8_t wire2[512];
+  int64_t n2 = msg_marshal(s2, nullptr, -1, 1, es2, el2, ed2, sm2, nullptr,
+                           -1, sc2, sids2, 0, rs2, wire2, sizeof(wire2));
+  if (n2 != n || std::memcmp(wire, wire2, (size_t)n) != 0) {
+    std::fprintf(stderr, "re-marshal not byte-stable\n");
+    return 1;
+  }
+  std::printf("codec round-trip: OK (%" PRId64 " wire bytes)\n", n);
+  return 0;
+}
+
+// Parse the Ready frame (layout: raft_tpu/runtime/embed.py) collecting the
+// peer messages; everything else is skipped structurally.
+struct WireMsg {
+  std::vector<uint8_t> bytes;
+  uint64_t to;
+};
+
+static bool parse_ready(const uint8_t* p, int64_t n,
+                        std::vector<WireMsg>* msgs) {
+  int64_t i = 0;
+  auto u32 = [&](uint32_t* v) {
+    if (i + 4 > n) return false;
+    std::memcpy(v, p + i, 4);
+    i += 4;
+    return true;
+  };
+  uint32_t n_msgs;
+  if (!u32(&n_msgs)) return false;
+  for (uint32_t k = 0; k < n_msgs; k++) {
+    uint32_t len;
+    if (!u32(&len) || i + len > n) return false;
+    WireMsg m;
+    m.bytes.assign(p + i, p + i + len);
+    if (!wire_field_varint(m.bytes.data(), len, 2, &m.to)) return false;
+    msgs->push_back(std::move(m));
+    i += len;
+  }
+  // entries + committed entries: skip
+  for (int g = 0; g < 2; g++) {
+    uint32_t cnt;
+    if (!u32(&cnt)) return false;
+    for (uint32_t k = 0; k < cnt; k++) {
+      if (i + 24 > n) return false;
+      uint32_t dlen;
+      std::memcpy(&dlen, p + i + 20, 4);
+      i += 24 + dlen;
+    }
+  }
+  return true;  // hard/soft state + snapshot not needed here
+}
+
+static int engine_e2e_test() {
+  CHECK(mrx_init() == 0);
+  int64_t h = mrx_engine_new(3);
+  CHECK(h > 0);
+
+  CHECK(mrx_campaign(h, 0) == 0);
+
+  uint8_t buf[1 << 20];
+  // pump to quiescence: collect each lane's Ready, advance, deliver
+  for (int iter = 0; iter < 64; iter++) {
+    bool moved = false;
+    for (int lane = 0; lane < 3; lane++) {
+      int hr = mrx_has_ready(h, lane);
+      CHECK(hr >= 0);
+      if (!hr) continue;
+      int64_t nb = mrx_ready(h, lane, buf, sizeof(buf));
+      CHECK(nb > 0);
+      CHECK(mrx_advance(h, lane) == 0);
+      std::vector<WireMsg> msgs;
+      CHECK(parse_ready(buf, nb, &msgs));
+      for (const auto& m : msgs) {
+        int dst = (int)m.to - 1;
+        if (dst < 0 || dst >= 3) continue;
+        int rc = mrx_step_wire(h, dst, m.bytes.data(),
+                               (int64_t)m.bytes.size());
+        CHECK(rc == 0 || rc == 1);
+      }
+      moved = true;
+    }
+    if (!moved) break;
+  }
+
+  char js[4096];
+  int64_t jn = mrx_status_json(h, 0, js, sizeof(js));
+  CHECK(jn > 0);
+  js[jn] = 0;
+  CHECK(std::strstr(js, "\"raftState\":\"StateLeader\"") != nullptr);
+
+  // propose through the ABI and pump until committed everywhere
+  const uint8_t payload[] = "hello-from-c";
+  CHECK(mrx_propose(h, 0, payload, sizeof(payload) - 1) == 0);
+  for (int iter = 0; iter < 64; iter++) {
+    bool moved = false;
+    for (int lane = 0; lane < 3; lane++) {
+      if (mrx_has_ready(h, lane) != 1) continue;
+      int64_t nb = mrx_ready(h, lane, buf, sizeof(buf));
+      CHECK(nb > 0);
+      CHECK(mrx_advance(h, lane) == 0);
+      std::vector<WireMsg> msgs;
+      CHECK(parse_ready(buf, nb, &msgs));
+      for (const auto& m : msgs) {
+        int dst = (int)m.to - 1;
+        if (dst < 0 || dst >= 3) continue;
+        int rc = mrx_step_wire(h, dst, m.bytes.data(),
+                               (int64_t)m.bytes.size());
+        CHECK(rc == 0 || rc == 1);
+      }
+      moved = true;
+    }
+    if (!moved) break;
+  }
+  for (int lane = 0; lane < 3; lane++) {
+    jn = mrx_status_json(h, lane, js, sizeof(js));
+    CHECK(jn > 0);
+    js[jn] = 0;
+    CHECK(std::strstr(js, "\"commit\":2") != nullptr);
+  }
+  std::printf("engine e2e via C ABI: OK (leader elected, commit=2 on all)\n");
+  mrx_engine_free(h);
+  return 0;
+}
+
+int main() {
+  if (codec_roundtrip_test() != 0) return 1;
+  if (engine_e2e_test() != 0) return 1;
+  std::printf("ALL OK\n");
+  return 0;
+}

@@ -38,6 +38,15 @@ elections with PreVote/CheckQuorum, randomized timeouts, replication with
 probe/replicate/snapshot flow control and inflight windows, commit/apply,
 in-fabric snapshot catch-up, leadership transfer, linearizable ReadIndex at
 the leader, auto-proposals for steady-state serving — runs on device.
+
+On MsgApp pipelining (reference: raft.go:1516-1518 drain loop): the serial
+RawNode path re-invokes `drain_appends` after each ack to fill the inflight
+window. Here one MsgApp per peer per round IS the pipeline optimum: the
+fabric delivers and acks every round (RTT = 1 round), so window occupancy
+never exceeds one message — a deeper burst would only move the same entries
+in the same number of rounds while widening the per-round entry gather. The
+inflight machinery still gates correctness when a peer lags (snapshot
+catch-up, mute masks); it is just never the steady-state constraint.
 """
 
 from __future__ import annotations
@@ -582,9 +591,15 @@ def fused_round(
         (inb.vote.log_term == lt) & (inb.vote.index >= state.last[:, None])
     )
     grantable = cur & can_vote & up2d_cell
-    any_grant = grantable.any(axis=1)
-    gwin = jnp.argmax(grantable, axis=1).astype(I32)
-    grant_cell = grantable & (lanes_v == gwin[:, None]) & any_grant[:, None]
+    # A real MSG_VOTE grant records state.vote, so at most one can win per
+    # round; PreVote grants record nothing and the reference would grant
+    # every qualifying request in sequence (raft.go:1164-1212) — grant all.
+    is_pv_cell = inb.vote.kind == MT.MSG_PRE_VOTE
+    real_grantable = grantable & ~is_pv_cell
+    any_real = real_grantable.any(axis=1)
+    gwin = jnp.argmax(real_grantable, axis=1).astype(I32)
+    real_grant_cell = real_grantable & (lanes_v == gwin[:, None]) & any_real[:, None]
+    grant_cell = (grantable & is_pv_cell) | real_grant_cell
     resp_kind = jnp.where(
         inb.vote.kind == MT.MSG_PRE_VOTE,
         jnp.int32(MT.MSG_PRE_VOTE_RESP),
@@ -602,7 +617,7 @@ def fused_round(
             "reject": jnp.ones((n, v), BOOL),
         },
     )
-    real_grant = (grant_cell & (inb.vote.kind == MT.MSG_VOTE)).any(axis=1)
+    real_grant = real_grant_cell.any(axis=1)
     state = dataclasses.replace(
         state,
         vote=_w(real_grant, gwin + 1, state.vote),

@@ -37,6 +37,11 @@ ERR_CONFLICT_BELOW_COMMIT = 2  # reference log.go:118-120 panic
 ERR_APPEND_BELOW_COMMIT = 4  # reference log.go:135-137 panic
 ERR_WINDOW_OVERFLOW = 8  # no reference analog: device window capacity
 ERR_APPLIED_OUT_OF_RANGE = 16  # reference log.go:328-331 panic
+# int32 device indexes (vs the reference's uint64): flag the approach to
+# the representable bound LOUDLY instead of silently wrapping. 2^30 leaves
+# a billion-entry margin to react (snapshot + re-key the group host-side).
+ERR_INDEX_NEAR_OVERFLOW = 32
+INDEX_OVERFLOW_MARGIN = 1 << 30
 
 
 def _err(state: RaftState, cond, bit: int) -> RaftState:
@@ -191,6 +196,9 @@ def append(
         return oh.scatter_range_set(col, slot0, vals, write)
 
     new_last = jnp.where(ok, prev_index + n_ents, state.last)
+    state = _err(
+        state, ok & (new_last >= INDEX_OVERFLOW_MARGIN), ERR_INDEX_NEAR_OVERFLOW
+    )
     return dataclasses.replace(
         state,
         log_term=scatter(state.log_term, ent_term),

@@ -219,11 +219,14 @@ def reset(state: RaftState, mask, term) -> RaftState:
         pending_conf_index=_w(mask, 0, state.pending_conf_index),
         uncommitted_size=_w(mask, 0, state.uncommitted_size),
         # readOnly queue is recreated on reset (reference: raft.go:782
-        # r.readOnly = newReadOnly(...))
+        # r.readOnly = newReadOnly(...)); pendingReadIndexMessages (pri_*)
+        # is a separate raft field the reference does NOT clear on reset
         ro_ctx=_w(m1, 0, state.ro_ctx),
         ro_from=_w(m1, 0, state.ro_from),
         ro_index=_w(m1, 0, state.ro_index),
         ro_acks=_w(mask[:, None, None], False, state.ro_acks),
+        ro_seq=_w(m1, 0, state.ro_seq),
+        ro_next_seq=_w(mask, 1, state.ro_next_seq),
     )
     # progress reset for every tracked peer (self keeps Match=lastIndex)
     sel = m1 & present
@@ -820,8 +823,18 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
             sie_nv = sie if sie.ndim == 2 else sie[:, None]
             send_sie = send_sie | (cells & sie_nv)
 
-    # MsgBeat (reference: raft.go:1228-1230)
-    state = bcast_heartbeat(state, mask & (t == MT.MSG_BEAT), out)
+    # MsgBeat (reference: raft.go:1228-1230). Periodic heartbeats carry the
+    # ctx of the LAST pending ReadIndex request (raft.go:698-703
+    # lastPendingRequestCtx) so a lost per-request broadcast still gets
+    # acked and the prefix-release rule frees the whole queue.
+    live_ro = state.ro_ctx != 0
+    newest = jnp.argmax(jnp.where(live_ro, state.ro_seq, -1), axis=1)
+    last_ctx = jnp.where(
+        live_ro.any(axis=1), ohm.gather(state.ro_ctx, newest.astype(I32)), 0
+    )
+    state = bcast_heartbeat(
+        state, mask & (t == MT.MSG_BEAT), out, ctx=last_ctx
+    )
 
     # MsgCheckQuorum (raft.go:1231-1243)
     cq = mask & (t == MT.MSG_CHECK_QUORUM)
@@ -885,17 +898,34 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     )
     want_send(appended[:, None] & jnp.ones_like(state.pr_match, bool))
 
-    # MsgReadIndex (reference: raft.go:1303-1332, read_only.go). Known
-    # deviations (documented for the judge): requests arriving before the
-    # leader commits in its term are dropped, not queued (raft.go:1310-1321
-    # defers them) — clients retry; and a full ro_* table also drops.
+    # MsgReadIndex (reference: raft.go:1303-1332, read_only.go). A full
+    # ro_*/pri_* table drops the request (the reference's queues are
+    # unbounded; R is the static bound here) — clients retry.
     ri = mask & (t == MT.MSG_READ_INDEX)
     committed_in_term = lg.term_at(state, state.committed) == state.term
-    ri_ok = ri & committed_in_term
     n_in = jnp.sum(state.voters_in.astype(I32), axis=1)
     n_out = jnp.sum(state.voters_out.astype(I32), axis=1)
     single = (n_in <= 1) & (n_out == 0)
-    immediate = ri_ok & (single | state.cfg.read_only_lease_based)
+    # a single-voter leader answers immediately, even before the first
+    # commit of its term (raft.go:1305-1310 IsSingleton short-circuit)
+    r_ax = state.ro_ctx.shape[1]
+    # not committed in this term yet: postpone the raw request
+    # (raft.go:1313-1317 pendingReadIndexMessages; released after the first
+    # commit of the term below at maybeCommit)
+    postpone = ri & ~single & ~committed_in_term
+    p_free = state.pri_ctx == 0
+    p_first = jnp.argmax(p_free, axis=1).astype(I32)
+    can_post = postpone & p_free.any(axis=1)
+    p_put = (
+        jnp.arange(r_ax, dtype=I32)[None, :] == p_first[:, None]
+    ) & can_post[:, None]
+    state = dataclasses.replace(
+        state,
+        pri_ctx=_w(p_put, msg.context[:, None], state.pri_ctx),
+        pri_from=_w(p_put, msg.frm[:, None], state.pri_from),
+    )
+    serve = ri & (single | committed_in_term)
+    immediate = serve & (single | state.cfg.read_only_lease_based)
     out.put_reply(
         immediate,
         type=MT.MSG_READ_INDEX_RESP,
@@ -905,8 +935,7 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         index=state.committed,
         context=msg.context,
     )
-    enq = ri_ok & ~immediate
-    r_ax = state.ro_ctx.shape[1]
+    enq = serve & ~immediate
     free = state.ro_ctx == 0  # [N, R]
     first_free = jnp.argmax(free, axis=1).astype(I32)
     can_enq = enq & free.any(axis=1)
@@ -921,6 +950,8 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         ro_from=_w(put_r, msg.frm[:, None], state.ro_from),
         ro_index=_w(put_r, state.committed[:, None], state.ro_index),
         ro_acks=_w(put_r[:, :, None], is_self_v[:, None, :], state.ro_acks),
+        ro_seq=_w(put_r, state.ro_next_seq[:, None], state.ro_seq),
+        ro_next_seq=state.ro_next_seq + can_enq.astype(I32),
     )
     state = bcast_heartbeat(state, can_enq, out, ctx=msg.context)
 
@@ -995,6 +1026,54 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     )
     all_peers = jnp.ones_like(state.pr_match, bool)
     want_send(committed_adv[:, None] & all_peers)
+
+    #   commit advanced in our term: release the postponed MsgReadIndex
+    #   queue (raft.go:1500-1503 -> releasePendingReadIndexMessages,
+    #   raft.go:2062-2079). Every postponed request is enqueued into the
+    #   readOnly table (safe) or answered at the current commit (lease);
+    #   ONE heartbeat broadcast carries the newest migrated ctx — quorum
+    #   acks to it release the whole prefix, exactly like the reference's
+    #   lastPendingRequestCtx recovery.
+    rel_p = committed_adv & (lg.term_at(state, state.committed) == state.term)
+    r_ax = state.ro_ctx.shape[1]
+    lanes_r = jnp.arange(r_ax, dtype=I32)[None, :]
+    is_self_v = lanes_v == ss[:, None]
+    mig_ctx = jnp.zeros_like(state.term)
+    mig_any = jnp.zeros_like(rel_p)
+    for k in range(r_ax):  # static unroll; pri slots fill in arrival order
+        mv = rel_p & (state.pri_ctx[:, k] != 0)
+        lease_k = mv & state.cfg.read_only_lease_based
+        out.put_reply(
+            lease_k,
+            type=MT.MSG_READ_INDEX_RESP,
+            to=state.pri_from[:, k],
+            frm=state.id,
+            term=state.term,
+            index=state.committed,
+            context=state.pri_ctx[:, k],
+        )
+        enq_k = mv & ~state.cfg.read_only_lease_based
+        free_k = state.ro_ctx == 0
+        ff_k = jnp.argmax(free_k, axis=1).astype(I32)
+        can_k = enq_k & free_k.any(axis=1)
+        put_k = (lanes_r == ff_k[:, None]) & can_k[:, None]
+        state = dataclasses.replace(
+            state,
+            ro_ctx=_w(put_k, state.pri_ctx[:, k][:, None], state.ro_ctx),
+            ro_from=_w(put_k, state.pri_from[:, k][:, None], state.ro_from),
+            ro_index=_w(put_k, state.committed[:, None], state.ro_index),
+            ro_acks=_w(put_k[:, :, None], is_self_v[:, None, :], state.ro_acks),
+            ro_seq=_w(put_k, state.ro_next_seq[:, None], state.ro_seq),
+            ro_next_seq=state.ro_next_seq + can_k.astype(I32),
+        )
+        mig_ctx = jnp.where(can_k, state.pri_ctx[:, k], mig_ctx)
+        mig_any = mig_any | can_k
+    state = dataclasses.replace(
+        state,
+        pri_ctx=_w(rel_p[:, None], 0, state.pri_ctx),
+        pri_from=_w(rel_p[:, None], 0, state.pri_from),
+    )
+    state = bcast_heartbeat(state, mig_any, out, ctx=mig_ctx)
     #   no commit advance: maybe unblock just the sender
     not_self = msg.frm != state.id
     retry_sender = advanced_lane & ~committed_adv & not_self
@@ -1031,9 +1110,11 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     )
     want_send(need_app[:, None] & sel_from)
 
-    # ReadIndex ack via heartbeat ctx (reference: raft.go:1548-1561,
-    # read_only.go:68-112). Each request's own broadcast acks it; the
-    # reference's release-the-prefix optimization is unnecessary here.
+    # ReadIndex ack via heartbeat ctx (reference: raft.go:1548-1561
+    # recvAck + advance, read_only.go:68-112). A quorum ack for ctx releases
+    # the whole FIFO *prefix* up to and including that request — quorum
+    # confirmation of leadership at a later enqueue point covers every
+    # earlier pending read.
     hctx = msg.context
     hit_r = hr[:, None] & (state.ro_ctx == hctx[:, None]) & (hctx[:, None] != 0)
     acks = state.ro_acks | (hit_r[:, :, None] & sel_from[:, None, :])
@@ -1043,27 +1124,68 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     ro_res = qr.joint_vote(
         ro_votes, state.voters_in[:, None, :], state.voters_out[:, None, :]
     )  # [N, R]
-    release = hit_r & (ro_res == VoteResult.VOTE_WON)
-    rel_any = release.any(axis=1)
-    rel_r = jnp.argmax(release, axis=1).astype(I32)  # [N]
-
-    def at_rel(arr_nr):
-        return ohm.gather(arr_nr, rel_r)
-
+    won = hit_r & (ro_res == VoteResult.VOTE_WON)
+    won_any = won.any(axis=1)
+    won_r = jnp.argmax(won, axis=1).astype(I32)  # [N]
+    won_seq = ohm.gather(state.ro_seq, won_r)
+    live_r = state.ro_ctx != 0
+    in_prefix = live_r & won_any[:, None] & (state.ro_seq <= won_seq[:, None])
+    is_won_slot = (
+        jnp.arange(state.ro_ctx.shape[1], dtype=I32)[None, :] == won_r[:, None]
+    ) & won_any[:, None]
+    self_rel = in_prefix & (state.ro_from == state.id[:, None]) & ~is_won_slot
+    remote_all = in_prefix & (state.ro_from != state.id[:, None]) & ~is_won_slot
+    # the quorum-acked request itself responds exactly as before (reply slot)
     out.put_reply(
-        rel_any,
+        won_any,
         type=MT.MSG_READ_INDEX_RESP,
-        to=at_rel(state.ro_from),
+        to=ohm.gather(state.ro_from, won_r),
         frm=state.id,
         term=state.term,
-        index=at_rel(state.ro_index),
-        context=at_rel(state.ro_ctx),
+        index=ohm.gather(state.ro_index, won_r),
+        context=ohm.gather(state.ro_ctx, won_r),
     )
+    # Older REMOTE-destined prefix slots stay queued: the outbox holds one
+    # reply cell per lane per step, so only the quorum-acked slot's
+    # response rides this step. The stranded slots drain one per ack
+    # round: once they are the newest live pending requests, tick
+    # heartbeats carry their ctx (lastPendingRequestCtx above) and each
+    # quorum ack releases the next one — same fixpoint as the reference's
+    # batch release, spread over rounds.
+    sq = state.ro_seq
+    # older self-destined prefix slots append straight to the ReadState
+    # ring (reference: responseToReadIndexReq local branch, raft.go:2085-
+    # 2091), in FIFO (seq) order
+    rank = jnp.sum(
+        self_rel[:, None, :] & (sq[:, None, :] < sq[:, :, None]), axis=-1
+    )
+    pos = state.rs_count[:, None] + rank  # [N, R]
+    ok_rs = self_rel & (pos < r_ax2)
+    put_rs = ok_rs[:, :, None] & (
+        jnp.arange(r_ax2, dtype=I32)[None, None, :] == pos[:, :, None]
+    )  # [N, src, dst]
+    any_dst = put_rs.any(axis=1)
+    state = dataclasses.replace(
+        state,
+        rs_ctx=jnp.where(
+            any_dst,
+            jnp.sum(put_rs * state.ro_ctx[:, :, None], axis=1),
+            state.rs_ctx,
+        ),
+        rs_index=jnp.where(
+            any_dst,
+            jnp.sum(put_rs * state.ro_index[:, :, None], axis=1),
+            state.rs_index,
+        ),
+        rs_count=state.rs_count + jnp.sum(ok_rs.astype(I32), axis=1),
+    )
+    release = is_won_slot | ok_rs
     state = dataclasses.replace(
         state,
         ro_ctx=_w(release, 0, state.ro_ctx),
         ro_from=_w(release, 0, state.ro_from),
         ro_index=_w(release, 0, state.ro_index),
+        ro_seq=_w(release, 0, state.ro_seq),
         ro_acks=jnp.where(release[:, :, None], False, acks),
     )
 
@@ -1226,6 +1348,25 @@ def _step_follower(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftSt
 
 # --------------------------------------------------------------------------
 # post-conf-change kernel (reference: raft.go:1916-1970 switchToConfig tail)
+
+
+def drain_appends(state: RaftState, mask, peer, max_entries: int) -> StepResult:
+    """The reference's post-ack drain loop (raft.go:1515-1518
+    `if r.id != m.From { for r.maybeSendAppend(m.From, false) {} }`): after
+    an ack moved flow-control state (freed inflight slots, probe ->
+    replicate), send as many further MsgApps TO THAT PEER as the window
+    allows. `peer`: [N] raft id of the acking peer. The outbox holds one
+    cell per (lane, peer), so each invocation emits at most one MsgApp and
+    the host re-invokes until quiescent — same fixpoint, pipelined across
+    kernel calls instead of inside one."""
+    out = Outbox(state, max_entries)
+    is_leader = mask & (state.state == StateType.LEADER)
+    sel_peer = state.prs_id == peer[:, None]
+    has_more = state.pr_next <= state.last[:, None]
+    state = maybe_send_append(
+        state, is_leader[:, None] & sel_peer & has_more, False, out
+    )
+    return StepResult(state, out.msgs)
 
 
 def post_conf_change(state: RaftState, mask, max_entries: int) -> StepResult:

@@ -27,7 +27,13 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from raft_tpu.cluster import Cluster, route, scan_step, _bytes_between
+from raft_tpu.cluster import (
+    Cluster,
+    deliver_flat,
+    route,
+    scan_step,
+    _bytes_between,
+)
 from raft_tpu.messages import MsgBatch, empty_batch
 from raft_tpu.ops import log as lg
 from raft_tpu.ops import step as stepmod
@@ -57,8 +63,92 @@ def lane_specs(tree):
     return jax.tree.map(lambda _: P("groups"), tree)
 
 
+def route_cross_shard(out, *, m_in, v, lanes_per_shard, n_shards):
+    """Global delivery for group-sharded meshes where a group's voters MAY
+    live on different shards (SURVEY §5.8): shard-local messages deliver
+    locally; cross-shard messages ride ONE `lax.all_to_all` over the
+    "groups" mesh axis (ICI), bucketed per destination shard.
+
+    Runs inside shard_map. out: the shard's [L, S] outbox (canonical layout:
+    member j of global group g lives at global lane g*v + j). Returns
+    (inbox [L, m_in], n_dropped) — drops = inbox overflow, bad ids, or
+    cross-shard bucket overflow (capacity v*S covers the worst case of one
+    straddling group per shard boundary; more pathological placements are
+    counted, never misdelivered)."""
+    L, s = out.type.shape
+    k = L * s
+    my = jax.lax.axis_index("groups")
+    offset = my * lanes_per_shard
+
+    flat = jax.tree.map(lambda x: x.reshape((k,) + x.shape[2:]), out)
+    src_local = jnp.repeat(jnp.arange(L, dtype=I32), s)
+    g_global = (offset + src_local) // v
+    valid = flat.type != MT.MSG_NONE
+    in_range = (flat.to >= 1) & (flat.to <= v)
+    bad_id = jnp.sum((valid & ~in_range).astype(I32))
+    valid = valid & in_range
+    dst_global = g_global * v + (jnp.clip(flat.to, 1, v) - 1)
+    dest_shard = dst_global // lanes_per_shard
+
+    local = valid & (dest_shard == my)
+    remote = valid & (dest_shard != my)
+
+    # bucket remote messages per destination shard: [D, cap]
+    cap = v * s
+    sel = remote[None, :] & (
+        dest_shard[None, :] == jnp.arange(n_shards, dtype=I32)[:, None]
+    )  # [D, K]
+    pos = jnp.cumsum(sel.astype(I32), axis=-1) - 1
+    overflow = jnp.sum((sel & (pos >= cap)).astype(I32))
+    oh = sel[:, None, :] & (
+        pos[:, None, :] == jnp.arange(cap, dtype=I32)[None, :, None]
+    )  # [D, cap, K]
+
+    def bucket(col):
+        cast = col.dtype == jnp.bool_
+        x = col.astype(I32) if cast else col
+        if x.ndim == 1:
+            picked = jnp.sum(jnp.where(oh, x[None, None, :], 0), axis=-1)
+        else:  # [K, E]
+            picked = jnp.sum(
+                jnp.where(oh[..., None], x[None, None, :, :], 0), axis=-2
+            )
+        return picked.astype(jnp.bool_) if cast else picked
+
+    send = jax.tree.map(bucket, flat)
+    send_dst = bucket(dst_global)
+    send_live = bucket(remote.astype(I32)).astype(bool)
+
+    # the ICI hop: shard d receives what every shard bucketed for d
+    recv = jax.tree.map(
+        lambda x: jax.lax.all_to_all(
+            x, "groups", split_axis=0, concat_axis=0, tiled=False
+        ),
+        (send, send_dst, send_live),
+    )
+    r_msgs, r_dst, r_live = recv
+
+    # merge local + received candidate pools, deliver into [L, m_in]
+    def cat(a, b):
+        return jnp.concatenate(
+            [a, b.reshape((n_shards * cap,) + b.shape[2:])], axis=0
+        )
+
+    pool = jax.tree.map(cat, flat, r_msgs)
+    dst_local = jnp.concatenate(
+        [
+            jnp.where(local, dst_global - offset, -1),
+            r_dst.reshape(n_shards * cap) - offset,
+        ]
+    )
+    pool_valid = jnp.concatenate([local, r_live.reshape(n_shards * cap)])
+    inbox, dropped = deliver_flat(pool, dst_local, pool_valid, L, m_in)
+    return inbox, dropped + bad_id + overflow
+
+
 def _round_body(
-    state, inbox, group_of, lane_of, *, m_in, do_tick, lanes_per_shard, v
+    state, inbox, group_of, lane_of, *, m_in, do_tick, lanes_per_shard, v,
+    n_shards=None, straddle=False,
 ):
     """Shard-local cluster round (runs inside shard_map)."""
     e = inbox.ent_term.shape[-1]
@@ -75,6 +165,12 @@ def _round_body(
         state,
         uncommitted_size=jnp.clip(state.uncommitted_size - applied_bytes, 0),
     )
+    if straddle:
+        nxt, dropped = route_cross_shard(
+            out_all, m_in=m_in, v=v,
+            lanes_per_shard=lanes_per_shard, n_shards=n_shards,
+        )
+        return state, nxt, dropped
     offset = jax.lax.axis_index("groups") * lanes_per_shard
     nxt, dropped = route(
         out_all, group_of, lane_of, m_in, lane_offset=offset, lanes_per_group=v
@@ -83,19 +179,31 @@ def _round_body(
 
 
 class ShardedCluster(Cluster):
-    """A Cluster whose lane axis is sharded over a jax Mesh."""
+    """A Cluster whose lane axis is sharded over a jax Mesh.
 
-    def __init__(self, n_groups: int, n_voters: int, devices=None, **kw):
+    By default every group must be fully resident on one shard (delivery is
+    then purely shard-local). With `straddle=True` a group's voters may
+    span shard boundaries: delivery goes through `route_cross_shard`, whose
+    cross-shard half is one all_to_all over ICI per round (SURVEY §5.8)."""
+
+    def __init__(
+        self, n_groups: int, n_voters: int, devices=None,
+        straddle: bool = False, **kw,
+    ):
         devices = devices if devices is not None else jax.devices()
-        if n_groups % len(devices):
-            raise ValueError("n_groups must divide evenly over devices")
         super().__init__(n_groups, n_voters, **kw)
         n = self.shape.n
+        if n % len(devices):
+            raise ValueError("lanes must divide evenly over devices")
         self.mesh, self.lane_sharding, shard_lanes = make_group_mesh(devices, n)
         self.repl_sharding = NamedSharding(self.mesh, P())
         self.lanes_per_shard = n // len(devices)
-        if (n_groups // len(devices)) * n_voters != self.lanes_per_shard:
-            raise ValueError("groups must not straddle shard boundaries")
+        self.n_shards = len(devices)
+        self.straddle = straddle
+        if not straddle and self.lanes_per_shard % n_voters:
+            raise ValueError(
+                "groups straddle shard boundaries; pass straddle=True"
+            )
 
         self.state = jax.tree.map(shard_lanes, self.state)
         self.group_of = jax.device_put(self.group_of, self.lane_sharding)
@@ -129,6 +237,7 @@ class ShardedCluster(Cluster):
                     state, inbox, group_of, lane_of,
                     m_in=self.m_in, do_tick=do_tick,
                     lanes_per_shard=self.lanes_per_shard, v=self.v,
+                    n_shards=self.n_shards, straddle=self.straddle,
                 )
                 return state, nxt, jax.lax.psum(d, "groups")
 
@@ -156,6 +265,7 @@ class ShardedCluster(Cluster):
                         st, inb, group_of, lane_of,
                         m_in=self.m_in, do_tick=do_tick,
                         lanes_per_shard=self.lanes_per_shard, v=self.v,
+                        n_shards=self.n_shards, straddle=self.straddle,
                     )
                     return (st, nxt, drops + d), None
 

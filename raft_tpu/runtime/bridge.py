@@ -19,7 +19,7 @@ groups never touch the bridge).
 
 from __future__ import annotations
 
-from raft_tpu.api.rawnode import Message, RawNodeBatch
+from raft_tpu.api.rawnode import ErrProposalDropped, Message, RawNodeBatch
 
 
 class HostBridge:
@@ -58,8 +58,13 @@ class HostBridge:
         codec = None
         if self.wire and msgs:
             # lazy: wire mode needs the native library; hosts without it use
-            # in-memory delivery
-            from raft_tpu.runtime import codec
+            # in-memory delivery — checked ONCE up front so a missing library
+            # can never abort a delivery batch partway through
+            from raft_tpu.runtime import codec as _codec
+            from raft_tpu.runtime.native import _load
+
+            if _load() is not None:
+                codec = _codec
 
         log = get_logger()
         for m in msgs:
@@ -74,7 +79,11 @@ class HostBridge:
             h, lane = tgt
             if codec is not None:
                 m = codec.unmarshal_message(codec.marshal_message(m))
-            self._hosts[h].step(lane, m)
+            try:
+                self._hosts[h].step(lane, m)
+            except ErrProposalDropped:
+                self.dropped += 1
+                continue
             self.delivered += 1
 
     def pump(self, max_iters: int = 100, on_commit=None) -> int:

@@ -47,10 +47,15 @@ def _scalars(m: Message) -> np.ndarray:
 def marshal_message(m: Message) -> bytes:
     lib = _lib()
     scalars = _scalars(m)
-    ctx = int(m.context)
     # Message.context on the wire is bytes; the engine keys requests with an
-    # int ticket — encode it as 8-byte big-endian when nonzero, absent when 0
-    ctx_b = ctx.to_bytes(8, "big") if ctx else None
+    # int ticket encoded as 8-byte big-endian (absent when 0). Foreign
+    # contexts (a Go peer's ReadIndex id of any other length) are carried as
+    # raw bytes end-to-end so marshal(unmarshal(x)) is byte-stable.
+    if isinstance(m.context, bytes):
+        ctx_b = m.context
+    else:
+        ctx = int(m.context)
+        ctx_b = ctx.to_bytes(8, "big") if ctx else None
     ents = m.entries or []
     ent_scalars = _u64(
         [x for e in ents for x in (int(e.type), e.term, e.index)]
@@ -95,7 +100,7 @@ def marshal_message(m: Message) -> bytes:
         out = np.zeros(cap, np.uint8)
         n = lib.msg_marshal(
             scalars.ctypes.data_as(ctypes.c_void_p),
-            ctx_b, ctypes.c_int64(len(ctx_b) if ctx_b else -1),
+            ctx_b, ctypes.c_int64(len(ctx_b) if ctx_b is not None else -1),
             ctypes.c_int32(len(ents)),
             ent_scalars.ctypes.data_as(ctypes.c_void_p),
             ent_lens.ctypes.data_as(ctypes.c_void_p),
@@ -166,14 +171,22 @@ def unmarshal_message(data: bytes, max_entries: int | None = None,
 
     m = mk(scalars)
     m.vote = int(scalars[9])
-    if context_len.value > 0:
-        m.context = int.from_bytes(
-            context[: context_len.value].tobytes(), "big"
-        )
+    if context_len.value == 8:
+        # 8 bytes is the engine's own ticket convention — but only values
+        # inside the device's i32 ticket range are engine tickets; an
+        # 8-byte FOREIGN id >= 2^31 stays raw bytes (interned at the
+        # engine boundary) instead of overflowing the context column
+        v = int.from_bytes(context[:8].tobytes(), "big")
+        m.context = v if v < 2**31 else context[:8].tobytes()
+    elif context_len.value >= 0:
+        # foreign context: keep raw bytes (re-marshal emits them verbatim)
+        m.context = context[: context_len.value].tobytes()
     off = 0
     for i in range(n_entries.value):
         dl = int(ent_lens[i])
-        d = ent_data[off : off + dl].tobytes() if dl >= 0 else b""
+        # dl < 0 = the field was absent (Go nil Data) — preserved as None so
+        # re-marshal stays byte-exact (marshal maps None back to absent)
+        d = ent_data[off : off + dl].tobytes() if dl >= 0 else None
         if dl > 0:
             off += dl
         m.entries.append(

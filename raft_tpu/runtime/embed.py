@@ -1,0 +1,151 @@
+"""Flat, wire-typed API over RawNodeBatch for the C embedding layer
+(native/multiraft_xla.cc) — the TPU-native analog of the reference's public
+Go API surface (rawnode.go:34-559) exported over a C ABI so a Go wrapper
+(go/multiraft_xla.go, build tag `multiraft_xla`) can drive the batched
+engine as a drop-in `RawNode`.
+
+Everything crossing the boundary is bytes:
+- messages ride the byte-exact raftpb wire codec (runtime/codec.py) — the
+  same encoding a Go peer produces/consumes;
+- a Ready is packed into a little-endian frame (format below) that the C/Go
+  side parses without touching Python objects.
+
+Ready frame layout (all little-endian):
+  u32 n_msgs      then per message:  u32 len, len bytes (raftpb wire)
+  u32 n_entries   then per entry:    u64 term, u64 index, u32 type,
+                                     u32 dlen, dlen bytes      (to persist)
+  u32 n_committed then per entry:    same frame                (to apply)
+  u8 has_hard_state  [u64 term, u64 vote, u64 commit]
+  u8 must_sync
+  u8 has_soft_state  [u64 lead, u32 raft_state]
+  u8 has_snapshot    [u64 index, u64 term, u32 dlen, dlen bytes,
+                      u32 n_voters then u64 ids...]
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from raft_tpu.api.rawnode import (
+    Entry,
+    ErrProposalDropped,
+    RawNodeBatch,
+    Ready,
+)
+from raft_tpu.config import Shape
+
+_engines: dict[int, RawNodeBatch] = {}
+_next_handle = 1
+
+ERR_PROPOSAL_DROPPED = 1
+
+
+def engine_new(n_nodes: int) -> int:
+    """One raft group of n_nodes voters (ids 1..n), one lane per voter —
+    the single-group shape the Go RawNode wrapper drives."""
+    global _next_handle
+    shape = Shape(n_lanes=n_nodes, max_peers=max(4, n_nodes))
+    peers = np.zeros((n_nodes, shape.v), np.int32)
+    peers[:, :n_nodes] = np.arange(1, n_nodes + 1, dtype=np.int32)
+    b = RawNodeBatch(shape, list(range(1, n_nodes + 1)), peers)
+    h = _next_handle
+    _next_handle += 1
+    _engines[h] = b
+    return h
+
+
+def engine_free(h: int) -> None:
+    _engines.pop(h, None)
+
+
+def step_wire(h: int, lane: int, data: bytes) -> int:
+    from raft_tpu.runtime import codec
+
+    b = _engines[h]
+    msg = codec.unmarshal_message(bytes(data))
+    try:
+        b.step(lane, msg)
+    except ErrProposalDropped:
+        return ERR_PROPOSAL_DROPPED
+    return 0
+
+
+def campaign(h: int, lane: int) -> int:
+    _engines[h].campaign(lane)
+    return 0
+
+
+def tick(h: int, lane: int) -> int:
+    _engines[h].tick(lane)
+    return 0
+
+
+def propose(h: int, lane: int, data: bytes) -> int:
+    try:
+        _engines[h].propose(lane, bytes(data))
+    except ErrProposalDropped:
+        return ERR_PROPOSAL_DROPPED
+    return 0
+
+
+def has_ready(h: int, lane: int) -> int:
+    return 1 if _engines[h].has_ready(lane) else 0
+
+
+def _pack_entry(e: Entry) -> bytes:
+    d = e.data or b""
+    return struct.pack("<QQII", e.term, e.index, e.type, len(d)) + d
+
+
+def _pack_ready(rd: Ready) -> bytes:
+    from raft_tpu.runtime import codec
+
+    out = [struct.pack("<I", len(rd.messages))]
+    for m in rd.messages:
+        w = codec.marshal_message(m)
+        out.append(struct.pack("<I", len(w)))
+        out.append(w)
+    for group in (rd.entries, rd.committed_entries):
+        out.append(struct.pack("<I", len(group)))
+        out.extend(_pack_entry(e) for e in group)
+    if rd.hard_state is not None:
+        out.append(struct.pack("<BQQQ", 1, rd.hard_state.term,
+                               rd.hard_state.vote, rd.hard_state.commit))
+    else:
+        out.append(struct.pack("<B", 0))
+    out.append(struct.pack("<B", 1 if rd.must_sync else 0))
+    if rd.soft_state is not None:
+        out.append(struct.pack("<BQI", 1, rd.soft_state.lead,
+                               rd.soft_state.raft_state))
+    else:
+        out.append(struct.pack("<B", 0))
+    s = rd.snapshot
+    if s is not None and s.index:
+        d = s.data or b""
+        out.append(struct.pack("<BQQI", 1, s.index, s.term, len(d)))
+        out.append(d)
+        out.append(struct.pack("<I", len(s.voters)))
+        out.extend(struct.pack("<Q", v) for v in s.voters)
+    else:
+        out.append(struct.pack("<B", 0))
+    return b"".join(out)
+
+
+def ready_wire(h: int, lane: int) -> bytes:
+    return _pack_ready(_engines[h].ready(lane))
+
+
+def advance(h: int, lane: int) -> int:
+    _engines[h].advance(lane)
+    return 0
+
+
+def status_json(h: int, lane: int) -> bytes:
+    return _engines[h].status_json(lane).encode()
+
+
+def basic_status_json(h: int, lane: int) -> bytes:
+    return json.dumps(_engines[h].basic_status(lane)).encode()

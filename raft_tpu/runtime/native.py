@@ -108,8 +108,9 @@ class NativePayloadStore:
     # EntryStore interface -------------------------------------------------
 
     def put(self, lane: int, e):
+        data = e.data or b""  # nil payloads normalize at the store boundary
         self._lib.ps_put(
-            self._h, lane, e.index, e.term, e.type, e.data, len(e.data)
+            self._h, lane, e.index, e.term, e.type, data, len(data)
         )
 
     def get(self, lane: int, index: int, term: int):

@@ -146,6 +146,16 @@ class RaftState:
     ro_from: Any  # [N, R] i32 requester raft id
     ro_index: Any  # [N, R] i32 commit index captured at enqueue
     ro_acks: Any  # [N, R, V] bool
+    # FIFO order of the readOnly queue (read_only.go:42 readIndexQueue): a
+    # quorum ack for ctx releases every live slot with seq <= its seq (the
+    # reference's advance() prefix rule, read_only.go:81-112)
+    ro_seq: Any  # [N, R] i32 enqueue sequence (valid where ro_ctx != 0)
+    ro_next_seq: Any  # [N] i32 monotonic counter (starts at 1)
+    # MsgReadIndex arriving before the leader commits in its term, postponed
+    # until the first commit (raft.go:1313-1317 pendingReadIndexMessages;
+    # bounded at R here — overflow drops and the client retries)
+    pri_ctx: Any  # [N, R] i32 (0 = free slot)
+    pri_from: Any  # [N, R] i32
     # Released ReadStates awaiting host pickup (reference: raft.go:371
     # readStates slice, drained by Ready).
     rs_ctx: Any  # [N, R] i32
@@ -312,6 +322,10 @@ def init_state(
         ro_from=jnp.zeros((n, r), I32),
         ro_index=jnp.zeros((n, r), I32),
         ro_acks=jnp.zeros((n, r, v), BOOL),
+        ro_seq=jnp.zeros((n, r), I32),
+        ro_next_seq=jnp.ones((n,), I32),
+        pri_ctx=jnp.zeros((n, r), I32),
+        pri_from=jnp.zeros((n, r), I32),
         rs_ctx=jnp.zeros((n, r), I32),
         rs_index=jnp.zeros((n, r), I32),
         rs_count=zeros_n,

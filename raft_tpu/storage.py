@@ -1,0 +1,171 @@
+"""Host-side persisted-log model + restart/recovery.
+
+The reference's `Storage` interface and `MemoryStorage` (reference:
+storage.go:46-310) are the durability contract: the application persists
+every Ready's entries/HardState/snapshot, and a restarting node rebuilds
+itself from `Storage.InitialState` + the stored entries (reference:
+node.go:281-289 RestartNode, raft.go:432-477 newRaft, doc.go:46-67).
+
+Here the device holds the algorithmic log (term/type/size columns); this
+module supplies the host half of that story:
+
+- `MemoryStorage` — semantics-exact port of the reference's in-memory
+  Storage (dummy-entry offset layout, Append truncation cases, Compact,
+  ApplySnapshot/CreateSnapshot, InitialState).
+- `persist_ready(storage, rd)` — the Ready-side capture helper: apply one
+  Ready's durable effects (snapshot, entries, HardState) to a storage, in
+  the contract's order (reference: doc.go:75-91).
+- `RawNodeBatch.restart_lane` (api/rawnode.py) consumes a MemoryStorage to
+  rebuild a lane; this module holds the pure state-derivation helper.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from raft_tpu.api.rawnode import Entry, HardState, Ready, Snapshot
+
+
+class StorageError(Exception):
+    pass
+
+
+ErrCompacted = StorageError("requested index is unavailable due to compaction")
+ErrUnavailable = StorageError("requested entry at index is unavailable")
+ErrSnapOutOfDate = StorageError("requested index is older than the existing snapshot")
+
+
+class MemoryStorage:
+    """reference: storage.go:98-310. `ents[0]` is the dummy entry holding
+    the compaction point (snapshot index/term); real entries follow."""
+
+    def __init__(self):
+        self.hard_state = HardState()
+        self.snapshot_obj = Snapshot()
+        self.ents: list[Entry] = [Entry()]  # dummy @ index 0 term 0
+
+    # -- Storage interface (reference: storage.go:46-90) -------------------
+
+    def initial_state(self) -> tuple[HardState, Snapshot]:
+        """(HardState, ConfState-carrier): the ConfState lives on the
+        snapshot metadata exactly like the reference (storage.go:121-124)."""
+        return self.hard_state, self.snapshot_obj
+
+    def first_index(self) -> int:
+        return self.ents[0].index + 1
+
+    def last_index(self) -> int:
+        return self.ents[0].index + len(self.ents) - 1
+
+    def term(self, i: int) -> int:
+        offset = self.ents[0].index
+        if i < offset:
+            raise ErrCompacted
+        if i - offset >= len(self.ents):
+            raise ErrUnavailable
+        return self.ents[i - offset].term
+
+    def entries(self, lo: int, hi: int) -> list[Entry]:
+        offset = self.ents[0].index
+        if lo <= offset:
+            raise ErrCompacted
+        if hi > self.last_index() + 1:
+            raise StorageError(
+                f"entries' hi({hi}) is out of bound lastindex({self.last_index()})"
+            )
+        if len(self.ents) == 1:
+            raise ErrUnavailable
+        return list(self.ents[lo - offset : hi - offset])
+
+    def snapshot(self) -> Snapshot:
+        return self.snapshot_obj
+
+    # -- mutation (reference: storage.go:127-310) --------------------------
+
+    def set_hard_state(self, st: HardState):
+        self.hard_state = st
+
+    def apply_snapshot(self, snap: Snapshot):
+        if self.snapshot_obj.index >= snap.index:
+            raise ErrSnapOutOfDate
+        self.snapshot_obj = snap
+        self.ents = [Entry(term=snap.term, index=snap.index)]
+
+    def create_snapshot(self, i: int, conf_state=None, data: bytes = b"") -> Snapshot:
+        """reference: storage.go:227-249. conf_state: a Snapshot-like or
+        ConfState-like carrying voters/learners/... to stamp on the meta."""
+        if i <= self.snapshot_obj.index:
+            raise ErrSnapOutOfDate
+        offset = self.ents[0].index
+        if i > self.last_index():
+            raise StorageError(
+                f"snapshot {i} is out of bound lastindex({self.last_index()})"
+            )
+        s = self.snapshot_obj
+        kw = dict(
+            index=i,
+            term=self.ents[i - offset].term,
+            data=data,
+            voters=s.voters,
+            learners=s.learners,
+            voters_outgoing=s.voters_outgoing,
+            learners_next=s.learners_next,
+            auto_leave=s.auto_leave,
+        )
+        if conf_state is not None:
+            kw.update(
+                voters=tuple(conf_state.voters),
+                learners=tuple(conf_state.learners),
+                voters_outgoing=tuple(getattr(conf_state, "voters_outgoing", ())),
+                learners_next=tuple(getattr(conf_state, "learners_next", ())),
+                auto_leave=bool(getattr(conf_state, "auto_leave", False)),
+            )
+        self.snapshot_obj = Snapshot(**kw)
+        return self.snapshot_obj
+
+    def compact(self, compact_index: int):
+        offset = self.ents[0].index
+        if compact_index <= offset:
+            raise ErrCompacted
+        if compact_index > self.last_index():
+            raise StorageError(
+                f"compact {compact_index} is out of bound "
+                f"lastindex({self.last_index()})"
+            )
+        i = compact_index - offset
+        head = Entry(term=self.ents[i].term, index=self.ents[i].index)
+        self.ents = [head] + self.ents[i + 1 :]
+
+    def append(self, entries: list[Entry]):
+        """reference: storage.go:277-310 — the 3-case truncation."""
+        if not entries:
+            return
+        first = self.first_index()
+        last = entries[0].index + len(entries) - 1
+        if last < first:
+            return  # entirely compacted away
+        if first > entries[0].index:
+            entries = entries[first - entries[0].index :]
+        offset = entries[0].index - self.ents[0].index
+        if len(self.ents) > offset:
+            self.ents = self.ents[:offset] + list(entries)
+        elif len(self.ents) == offset:
+            self.ents = self.ents + list(entries)
+        else:
+            raise StorageError(
+                f"missing log entry [last: {self.last_index()}, "
+                f"append at: {entries[0].index}]"
+            )
+
+
+def persist_ready(storage: MemoryStorage, rd: Ready):
+    """Apply one Ready's durable effects to `storage` — what the reference
+    application loop does between Ready and Advance (reference: doc.go:75-91;
+    snapshot first, then entries, then HardState — the MustSync contract)."""
+    if rd.snapshot is not None and rd.snapshot.index:
+        if storage.snapshot_obj.index < rd.snapshot.index:
+            storage.apply_snapshot(rd.snapshot)
+    if rd.entries:
+        storage.append([dataclasses.replace(e) for e in rd.entries])
+    if rd.hard_state is not None and not rd.hard_state.is_empty():
+        storage.set_hard_state(dataclasses.replace(rd.hard_state))

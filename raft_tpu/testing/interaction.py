@@ -19,6 +19,7 @@ import numpy as np
 from raft_tpu import confchange as ccm
 from raft_tpu.api.rawnode import (
     Entry,
+    ErrProposalDropped,
     HardState,
     Message,
     RawNodeBatch,
@@ -77,6 +78,11 @@ class EnvNode:
     storage: dict = dataclasses.field(default_factory=dict)  # index -> Entry
     storage_first: int = 1
     storage_last: int = 0
+    # persisted HardState (reference: MemoryStorage.SetHardState) — what a
+    # crash-restart recovers term/vote/commit from
+    hard_state: HardState = dataclasses.field(default_factory=HardState)
+    # index the app's state machine (history) has applied through
+    applied: int = 0
 
 
 class InteractionEnv:
@@ -273,33 +279,13 @@ class InteractionEnv:
     def handle_campaign(self, d: TestData):
         self.batch.campaign(self.nodes[self._first_idx(d)].lane)
 
-    def _proposal_dropped(self, lane: int) -> bool:
-        """Mirror of ErrProposalDropped returns (reference: raft.go:1244-1302
-        stepLeader, 1636-1642 stepCandidate, 1671-1680 stepFollower)."""
-        v = self.batch.view
-        st = int(v.state[lane])
-        if st == int(StateType.LEADER):
-            nid = int(v.id[lane])
-            in_prs = any(
-                int(v.prs_id[lane, j]) == nid for j in range(self.batch.shape.v)
-            )
-            return not in_prs or int(v.lead_transferee[lane]) != 0
-        if st in (int(StateType.CANDIDATE), int(StateType.PRE_CANDIDATE)):
-            return True
-        # follower
-        if int(v.lead[lane]) == 0:
-            return True
-        return bool(
-            np.asarray(self.batch.state.cfg.disable_proposal_forwarding[lane])
-        )
-
     def handle_propose(self, d: TestData):
         idx = self._first_idx(d)
         data = d.cmd_args[1].key.encode()
         lane = self.nodes[idx].lane
-        dropped = self._proposal_dropped(lane)
-        self.batch.propose(lane, data)
-        if dropped:
+        try:
+            self.batch.propose(lane, data)
+        except ErrProposalDropped:
             return "raft proposal dropped"
 
     def handle_propose_conf_change(self, d: TestData):
@@ -328,12 +314,15 @@ class InteractionEnv:
         )
         lane = self.nodes[idx].lane
         nid = self.batch.id_of(lane)
-        dropped = self._proposal_dropped(lane)
-        self.batch._run_step(
-            lane,
-            Message(type=int(MT.MSG_PROP), to=nid, frm=nid,
-                    entries=[Entry(type=int(t), data=data)]),
-        )
+        try:
+            self.batch._step_prop(
+                lane,
+                Message(type=int(MT.MSG_PROP), to=nid, frm=nid,
+                        entries=[Entry(type=int(t), data=data)]),
+            )
+            dropped = False
+        except ErrProposalDropped:
+            dropped = True
         if dropped:
             return "raft proposal dropped"
 
@@ -510,8 +499,70 @@ class InteractionEnv:
                     if not known:
                         self.output.write("raft: cannot step as peer not found\n")
                         continue
-                self.batch.step(lane, m)
+                try:
+                    self.batch.step(lane, m)
+                except ErrProposalDropped:
+                    # reference: deliver prints the Step error
+                    # (_deliver_msgs.go:98-100)
+                    self.output.write("raft proposal dropped\n")
         return n
+
+    def handle_restart(self, d: TestData):
+        """EXTENSION (not in the reference DSL): crash-restart node(s) from
+        their persisted storage — HardState + stored entries + latest
+        compaction snapshot — exercising the RestartNode path
+        (reference: node.go:281-289, doc.go:46-67). Usage: restart <idx...>
+        """
+        from raft_tpu.storage import MemoryStorage
+
+        for idx in self._idxs(d):
+            node = self.nodes[idx]
+            nid = idx + 1
+            ms = MemoryStorage()
+            base = node.storage_first - 1
+            # the snapshot covering the compacted prefix: the newest history
+            # snapshot at or below the storage base (the one a real app would
+            # have fsynced when it compacted)
+            snap = None
+            for s in node.history:
+                if s.index <= base and (snap is None or s.index > snap.index):
+                    snap = s
+            if snap is not None and snap.index:
+                ms.apply_snapshot(snap)
+            elif snap is not None:
+                ms.snapshot_obj = snap  # index-0 bootstrap ConfState carrier
+            ms.append([node.storage[i] for i in sorted(node.storage)])
+            ms.set_hard_state(dataclasses.replace(node.hard_state))
+            self.batch.restart_lane(
+                node.lane, ms, applied=min(node.applied, ms.hard_state.commit)
+            )
+            # drop any in-flight thread work from the previous life
+            node.append_work.clear()
+            node.apply_work.clear()
+            v = self.batch.view
+            self.output.logf(
+                INFO, f"{nid} became follower at term {int(v.term[node.lane])}"
+            )
+            peers = sorted(
+                set(self.batch.peer_ids(node.lane, voters=True))
+                | set(self.batch.peer_ids(node.lane, learners=True))
+            )
+            peers_s = ",".join(str(p) for p in peers)
+            w = self.batch.shape.w
+            li = int(v.last[node.lane])
+            lt = (
+                int(v.log_term[node.lane, li & (w - 1)])
+                if li > int(v.snap_index[node.lane])
+                else int(v.snap_term[node.lane])
+            )
+            self.output.logf(
+                INFO,
+                f"newRaft {nid} [peers: [{peers_s}], "
+                f"term: {int(v.term[node.lane])}, "
+                f"commit: {int(v.committed[node.lane])}, "
+                f"applied: {int(v.applied[node.lane])}, "
+                f"lastindex: {li}, lastterm: {lt}]",
+            )
 
     # -- ready / storage threads -------------------------------------------
 
@@ -545,6 +596,8 @@ class InteractionEnv:
                     self.messages.append(m)
             return None
         self._persist_append(node, rd.entries, rd.snapshot)
+        if rd.hard_state is not None:
+            node.hard_state = dataclasses.replace(rd.hard_state)
         self._process_apply(node, rd.committed_entries)
         for m in rd.messages:
             self.messages.append(m)
@@ -624,6 +677,7 @@ class InteractionEnv:
                     auto_leave=cs.auto_leave,
                 )
             node.history.append(snap)
+            node.applied = ent.index
             self.batch.set_app_snapshot(node.lane, snap)
 
     @staticmethod
@@ -719,6 +773,12 @@ class InteractionEnv:
         shown = dataclasses.replace(m, responses=[])
         self.output.write("Processing:\n" + D.describe_message(shown) + "\n")
         self._persist_append(node, m.entries, m.snapshot)
+        if m.term or m.vote or m.commit:
+            # the append message carries the HardState to fsync
+            # (reference: rawnode.go:225-262 newStorageAppendMsg)
+            node.hard_state = HardState(
+                term=m.term, vote=m.vote, commit=m.commit
+            )
         self.output.write("Responses:\n")
         for r in resps:
             self.output.write(D.describe_message(r) + "\n")

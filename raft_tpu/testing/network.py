@@ -17,7 +17,7 @@ import random
 import time
 from typing import Callable
 
-from raft_tpu.api.rawnode import Message, RawNodeBatch
+from raft_tpu.api.rawnode import ErrProposalDropped, Message, RawNodeBatch
 
 
 class SyncNetwork:
@@ -68,7 +68,10 @@ class SyncNetwork:
                 dst = self.id2lane.get(m.to)
                 if dst is None:
                     continue
-                self.batch.step(dst, m)
+                try:
+                    self.batch.step(dst, m)
+                except ErrProposalDropped:
+                    pass  # a forwarded proposal the target cannot take
                 progressed = True
             for lane in range(self.batch.shape.n):
                 if self.batch.has_ready(lane):

@@ -1,0 +1,99 @@
+"""Proposal back-pressure: ErrProposalDropped on every refusal path — no
+silent loss (reference: raft.go:30 ErrProposalDropped, node.go:469;
+raft.go:1244-1302 stepLeader, 1671-1680 stepFollower, 2033-2047
+uncommitted-size gate; the device log window is this engine's additional
+static bound)."""
+
+import pytest
+
+from raft_tpu.api.rawnode import ErrProposalDropped
+from raft_tpu.types import MessageType as MT
+
+from tests.test_rawnode import drive, make_group
+
+
+def test_window_exhaustion_no_silent_loss():
+    """Filling the device log window refuses further proposals LOUDLY; after
+    commit + compaction the window frees and proposals flow again."""
+    w = 8
+    b = make_group(3, shape_kw={"log_window": w})
+    b.campaign(0)
+    drive(b)
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+
+    # replication disabled: entries pile into the leader's window
+    accepted = 0
+    dropped = 0
+    for i in range(2 * w):
+        try:
+            b.propose(0, b"p%d" % i)
+            accepted += 1
+        except ErrProposalDropped:
+            dropped += 1
+        b._msgs[0] = []
+    assert dropped > 0, "window exhaustion must surface, not drop silently"
+    # every accepted proposal is really in the log (no silent loss)
+    assert int(b.view.last[0]) == 1 + accepted  # 1 = election empty entry
+    assert int(b.view.last[0]) - int(b.view.snap_index[0]) <= w
+
+    # drain: the dropped MsgApps are re-sent after heartbeat exchanges,
+    # everything commits, then compaction frees the window
+    for _ in range(20):
+        b.tick(0)
+        drive(b)
+        if b.basic_status(0)["commit"] == 1 + accepted:
+            break
+    committed = b.basic_status(0)["commit"]
+    assert committed == 1 + accepted
+    b.compact(0, committed)
+    b.propose(0, b"after")
+    drive(b)
+    assert b.basic_status(0)["commit"] == committed + 1
+
+
+def test_follower_without_leader_drops():
+    """reference: raft.go:1671-1675 — no leader known, proposal dropped."""
+    b = make_group(3)
+    with pytest.raises(ErrProposalDropped):
+        b.propose(1, b"x")
+
+
+def test_candidate_drops():
+    """reference: raft.go:1636-1642 stepCandidate drops proposals."""
+    b = make_group(3)
+    b.campaign(0)  # candidate until responses are delivered
+    assert b.basic_status(0)["raft_state"] == "CANDIDATE"
+    with pytest.raises(ErrProposalDropped):
+        b.propose(0, b"x")
+
+
+def test_follower_forwarding_accepted():
+    """A follower with a known leader forwards instead of dropping."""
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    b.propose(2, b"via proxy")  # must not raise
+    drive(b)
+    assert b.basic_status(0)["commit"] == 2
+
+
+def test_disable_proposal_forwarding_drops():
+    """reference: raft.go:1676-1679."""
+    b = make_group(3, disable_proposal_forwarding=True)
+    b.campaign(0)
+    drive(b)
+    with pytest.raises(ErrProposalDropped):
+        b.propose(2, b"x")
+
+
+def test_transferring_leader_drops():
+    """reference: raft.go:1256-1258 — proposals dropped while a leadership
+    transfer is in flight."""
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    # start a transfer but do not deliver the TimeoutNow
+    b.transfer_leadership(0, 2)
+    assert int(b.view.lead_transferee[0]) == 2
+    with pytest.raises(ErrProposalDropped):
+        b.propose(0, b"x")

@@ -159,3 +159,73 @@ def test_unknown_fields_skipped_everywhere():
     wire += varint(101 << 3 | 1) + b"\x00" * 8
     got = unmarshal_message(wire)
     assert got.snapshot.index == 5 and got.snapshot.voters == (1, 2)
+
+
+def test_nil_vs_empty_entry_data_byte_stable():
+    """A Go-origin entry with nil Data (no field 4 on the wire, e.g. the
+    leader's empty entry) must re-marshal byte-identically — nil survives
+    unmarshal as data=None (marshal's -1 convention)."""
+    from raft_tpu.runtime.codec import marshal_message, unmarshal_message
+
+    m = Message(
+        type=int(MT.MSG_APP), to=2, frm=1, term=5, log_term=4, index=10,
+        entries=[
+            Entry(term=5, index=11, data=None),   # nil Data
+            Entry(term=5, index=12, data=b""),    # present-but-empty Data
+            Entry(term=5, index=13, data=b"x"),
+        ],
+    )
+    wire = marshal_message(m)
+    got = unmarshal_message(wire)
+    assert got.entries[0].data is None
+    assert got.entries[1].data == b""
+    assert got.entries[2].data == b"x"
+    assert marshal_message(got) == wire
+
+
+def test_foreign_context_byte_stable():
+    """Contexts that are not the engine's 8-byte int ticket (e.g. etcd
+    ReadIndex ids) round-trip as raw bytes, byte-stably."""
+    from raft_tpu.runtime.codec import marshal_message, unmarshal_message
+
+    for ctx in (b"a", b"etcd-readindex-id-123", b"\x00" * 3, b""):
+        m = Message(type=int(MT.MSG_READ_INDEX), to=1, frm=2, context=ctx)
+        wire = marshal_message(m)
+        got = unmarshal_message(wire)
+        assert got.context == ctx
+        assert marshal_message(got) == wire
+    # the engine's own int tickets still come back as ints
+    m = Message(type=int(MT.MSG_READ_INDEX), to=1, frm=2, context=77)
+    wire = marshal_message(m)
+    got = unmarshal_message(wire)
+    assert got.context == 77
+    assert marshal_message(got) == wire
+
+
+def test_foreign_context_through_engine_readindex():
+    """A bytes context stepped into the engine surfaces back out (ReadState)
+    as the original bytes — interned to a device ticket only in between."""
+    from tests.test_rawnode import drive, make_group
+
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+    ctx = b"foreign-ctx-not-8b"
+    b.read_index(0, ctx)
+    seen = []
+    for _ in range(20):
+        moved = False
+        for lane in range(3):
+            if not b.has_ready(lane):
+                continue
+            rd = b.ready(lane)
+            b.advance(lane)
+            seen += rd.read_states
+            for m in rd.messages:
+                if 0 <= m.to - 1 < 3:
+                    b.step(m.to - 1, m)
+            moved = True
+        if seen or not moved:
+            break
+    assert any(rs.request_ctx == ctx for rs in seen)

@@ -1,0 +1,142 @@
+"""Flow-control conformance (reference: raft_flow_control_test.go) plus the
+post-ack drain loop (reference: raft.go:1516-1518).
+
+Explicit reference test-name mapping:
+- TestMsgAppFlowControlFull          -> test_msgapp_flow_control_full
+- TestMsgAppFlowControlMoveForward   -> test_msgapp_flow_control_move_forward
+- TestMsgAppFlowControlRecvHeartbeat -> test_msgapp_flow_control_recv_heartbeat
+"""
+
+import numpy as np
+
+from raft_tpu.api.rawnode import Message
+from raft_tpu.types import MessageType as MT, ProgressState
+
+from tests.test_rawnode import drive, make_group
+
+INFLIGHT = 4
+
+
+def leader_pair():
+    """2-voter group, node 1 leader, peer 2 in StateReplicate (the natural
+    post-election state), outbox cleared."""
+    b = make_group(2, shape_kw={"max_inflight": INFLIGHT})
+    b.campaign(0)
+    drive(b)
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+    j = next(
+        k for k in range(b.shape.v) if int(b.view.prs_id[0, k]) == 2
+    )
+    assert int(b.view.pr_state[0, j]) == int(ProgressState.REPLICATE)
+    b._msgs[0] = []
+    return b, j
+
+
+def take_apps(b, lane=0):
+    """readMessages() analog: drain and return the peer-addressed MsgApps."""
+    ms = [m for m in b._msgs[lane] if m.type == int(MT.MSG_APP)]
+    b._msgs[lane] = []
+    return ms
+
+
+def paused(b, j):
+    """Progress.IsPaused for peer slot j of lane 0 (replicate state:
+    MsgAppFlowPaused, set when the inflight window fills on send)."""
+    v = b.view
+    ps = int(v.pr_state[0, j])
+    if ps == int(ProgressState.SNAPSHOT):
+        return True
+    return bool(v.pr_msg_app_flow_paused[0, j])
+
+
+def test_msgapp_flow_control_full():
+    """reference: raft_flow_control_test.go:27 TestMsgAppFlowControlFull."""
+    b, j = leader_pair()
+    for i in range(INFLIGHT):
+        b.propose(0, b"somedata")
+        ms = take_apps(b)
+        assert len(ms) == 1, (i, ms)
+    assert paused(b, j)
+    assert int(b.view.infl_count[0, j]) == INFLIGHT
+    for i in range(10):
+        b.propose(0, b"somedata")
+        assert take_apps(b) == [], i
+
+
+def test_msgapp_flow_control_move_forward():
+    """reference: raft_flow_control_test.go:63 TestMsgAppFlowControlMoveForward."""
+    b, j = leader_pair()
+    term = b.basic_status(0)["term"]
+    for _ in range(INFLIGHT):
+        b.propose(0, b"somedata")
+        take_apps(b)
+    # index 1 is the election's empty entry; proposals start at 2
+    for tt in range(2, INFLIGHT):
+        # move the window forward
+        b.step(0, Message(type=int(MT.MSG_APP_RESP), to=1, frm=2,
+                          term=term, index=tt))
+        take_apps(b)
+        # one freed slot admits exactly one more
+        b.propose(0, b"somedata")
+        ms = take_apps(b)
+        assert len(ms) == 1 and ms[0].type == int(MT.MSG_APP), (tt, ms)
+        assert paused(b, j), tt
+        # out-of-date acks have no effect on the window
+        for i in range(tt):
+            b.step(0, Message(type=int(MT.MSG_APP_RESP), to=1, frm=2,
+                              term=term, index=i))
+            take_apps(b)
+            assert paused(b, j), (tt, i)
+
+
+def test_msgapp_flow_control_recv_heartbeat():
+    """reference: raft_flow_control_test.go:110 TestMsgAppFlowControlRecvHeartbeat."""
+    b, j = leader_pair()
+    term = b.basic_status(0)["term"]
+    for _ in range(INFLIGHT):
+        b.propose(0, b"somedata")
+        take_apps(b)
+    for tt in range(1, 5):
+        for i in range(tt):
+            assert paused(b, j), (tt, i)
+            # unpauses, sends one empty MsgApp, pauses again
+            b.step(0, Message(type=int(MT.MSG_HEARTBEAT_RESP), to=1, frm=2,
+                              term=term))
+            ms = take_apps(b)
+            assert len(ms) == 1 and ms[0].entries == [], (tt, i, ms)
+        for i in range(10):
+            assert paused(b, j), (tt, i)
+            b.propose(0, b"somedata")
+            assert take_apps(b) == [], (tt, i)
+        # clear one more heartbeat-resp send
+        b.step(0, Message(type=int(MT.MSG_HEARTBEAT_RESP), to=1, frm=2,
+                          term=term))
+        take_apps(b)
+
+
+def test_drain_sends_backlog_after_unblock():
+    """reference: raft.go:1516-1518 — when an ack frees the window while a
+    backlog of unsent entries exists (MaxSizePerMsg caps each MsgApp), the
+    leader keeps sending until flow control pauses again, within one Step."""
+    # max_msg_entries=1 forces one entry per MsgApp
+    b = make_group(2, shape_kw={"max_inflight": INFLIGHT, "max_msg_entries": 1})
+    b.campaign(0)
+    drive(b)
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+    term = b.basic_status(0)["term"]
+    b._msgs[0] = []
+    # fill the window, then build a backlog the paused peer can't receive
+    for i in range(INFLIGHT + 3):
+        b.propose(0, b"d%d" % i)
+    sent = take_apps(b)
+    assert len(sent) == INFLIGHT, sent  # window-limited
+    last_sent = sent[-1].entries[-1].index
+    # ack everything sent so far: frees the whole window; the drain loop
+    # must now emit the 3-entry backlog as 3 further MsgApps in THIS step
+    b.step(0, Message(type=int(MT.MSG_APP_RESP), to=1, frm=2,
+                      term=term, index=last_sent))
+    ms = take_apps(b)
+    apps = [m for m in ms if m.entries]
+    assert len(apps) == 3, ms
+    idxs = [m.entries[0].index for m in apps]
+    assert idxs == sorted(idxs) and len(set(idxs)) == 3

@@ -197,3 +197,45 @@ def test_heterogeneous_per_group_configs_share_one_program():
     assert all(len(x) == 1 for x in leaders_per_group(c).values())
     # the PreVote group reached term >= 1 through a real election too
     assert np.asarray(c.state.term)[6:9].max() >= 1
+
+
+def test_prevote_grant_not_blocked_by_concurrent_vote():
+    """PreVote grants record nothing, so a grantable PreVote must not be
+    rejected merely because a real MsgVote from another candidate won the
+    single-winner argmax slot in the same round (the reference grants both
+    in sequence, raft.go:1164-1212)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.fused import FusedCluster, fused_round, no_ops
+    from raft_tpu.types import MessageType as MT
+
+    # one 3-voter group, everyone at term 0 with empty logs; lane 0 receives
+    # a real MsgVote(term 2) from voter 2 (src slot 1) and a MsgPreVote
+    # (term 3) from voter 3 (src slot 2) in the same round
+    c = FusedCluster(1, 3, seed=3)
+    vote = c.fab.vote
+    kind = np.asarray(vote.kind).copy()
+    term = np.asarray(vote.term).copy()
+    kind[0, 1] = int(MT.MSG_VOTE)
+    term[0, 1] = 2
+    kind[0, 2] = int(MT.MSG_PRE_VOTE)
+    term[0, 2] = 3
+    vote = dataclasses.replace(
+        vote, kind=jnp.asarray(kind), term=jnp.asarray(term)
+    )
+    inb = dataclasses.replace(c.fab, vote=vote)
+    state, out = fused_round(
+        c.state, inb, no_ops(3), do_tick=False, auto_propose=False
+    )
+    k = np.asarray(out.vresp.kind)
+    rej = np.asarray(out.vresp.reject)
+    assert k[0, 1] == int(MT.MSG_VOTE_RESP) and not rej[0, 1], (
+        "the real MsgVote should be granted"
+    )
+    assert k[0, 2] == int(MT.MSG_PRE_VOTE_RESP) and not rej[0, 2], (
+        "PreVote grant was suppressed by the MsgVote winner"
+    )
+    # and the real vote was recorded for candidate 2 only
+    assert int(np.asarray(state.vote)[0]) == 2

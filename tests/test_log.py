@@ -243,3 +243,22 @@ def test_gather_entries():
     t, ty, by, valid = lg.gather_entries(st, arr2(2), arr2(2), E)
     assert np.asarray(t)[0].tolist() == [2, 3, 0, 0]
     assert np.asarray(valid)[0].tolist() == [True, True, False, False]
+
+
+def test_index_near_overflow_flagged():
+    """int32 indexes (vs the reference's uint64): crossing 2^30 sets
+    ERR_INDEX_NEAR_OVERFLOW instead of silently wrapping at 2^31."""
+    near = lg.INDEX_OVERFLOW_MARGIN - 1
+    state = mk([1], committed=near, snap_index=near - 1, stabled=near)
+    state = lg.append(
+        state,
+        jnp.asarray([near, 0], jnp.int32),
+        jnp.ones((2, E), jnp.int32),
+        jnp.zeros((2, E), jnp.int32),
+        jnp.zeros((2, E), jnp.int32),
+        jnp.asarray([1, 0], jnp.int32),
+    )
+    assert lane0(state.last) == near + 1
+    assert lane0(state.error_bits) & lg.ERR_INDEX_NEAR_OVERFLOW
+    # the control lane stays clean
+    assert int(np.asarray(state.error_bits)[1]) == 0

@@ -19,13 +19,15 @@ from raft_tpu.types import MessageType as MT, StateType as ST
 I32 = np.int32
 
 
-def make_batch(n=3, election_tick=10, heartbeat_tick=1, **overrides) -> RawNodeBatch:
+def make_batch(
+    n=3, election_tick=10, heartbeat_tick=1, shape_kw=None, **overrides
+) -> RawNodeBatch:
     ids = list(range(1, n + 1))
     peers = np.zeros((n, 8), I32)
     for lane in range(n):
         peers[lane, :n] = ids
     return RawNodeBatch(
-        Shape(n_lanes=n), ids=ids, peers=peers,
+        Shape(n_lanes=n, **(shape_kw or {})), ids=ids, peers=peers,
         election_tick=election_tick, heartbeat_tick=heartbeat_tick, **overrides,
     )
 

@@ -159,3 +159,57 @@ def test_joint_active():
     active1 = jnp.asarray([1, 0, 0, 0], bool)
     assert bool(quorum.joint_active(active2, mask, empty))
     assert not bool(quorum.joint_active(active1, mask, empty))
+
+
+def test_committed_matches_dumb_oracle_50k():
+    """Reference-scale property check (quorum/quick_test.go:28 runs 50k
+    quickcheck cases) — batched through the kernel in one call."""
+    rng = np.random.default_rng(42)
+    k, v = 50_000, 8
+    n = rng.integers(0, v + 1, size=k)
+    mask = np.arange(v)[None, :] < n[:, None]
+    # shuffle which slots are voters per row
+    perm = rng.permuted(np.tile(np.arange(v), (k, 1)), axis=1)
+    mask = np.take_along_axis(mask, perm, axis=1)
+    match = rng.integers(0, 1 << 18, size=(k, v)).astype(np.int32)
+    got = np.asarray(
+        quorum.majority_committed(jnp.asarray(match), jnp.asarray(mask))
+    )
+    for i in range(k):
+        want = dumb_committed(match[i], mask[i])
+        assert got[i] == want, (i, match[i], mask[i], got[i], want)
+
+
+def test_vote_matches_dumb_oracle_50k():
+    rng = np.random.default_rng(43)
+    k, v = 50_000, 8
+    n = rng.integers(0, v + 1, size=k)
+    mask = np.arange(v)[None, :] < n[:, None]
+    perm = rng.permuted(np.tile(np.arange(v), (k, 1)), axis=1)
+    mask = np.take_along_axis(mask, perm, axis=1)
+    votes = rng.integers(0, 3, size=(k, v)).astype(np.int32)
+    got = np.asarray(
+        quorum.majority_vote(jnp.asarray(votes), jnp.asarray(mask))
+    )
+    for i in range(k):
+        want = dumb_vote(votes[i], mask[i])
+        assert got[i] == want, (i, votes[i], mask[i], got[i], want)
+
+
+def test_joint_committed_matches_min_50k():
+    rng = np.random.default_rng(44)
+    k, v = 50_000, 8
+    mask_in = rng.integers(0, 2, size=(k, v)).astype(bool)
+    mask_out = rng.integers(0, 2, size=(k, v)).astype(bool)
+    match = rng.integers(0, 1 << 18, size=(k, v)).astype(np.int32)
+    got = np.asarray(
+        quorum.joint_committed(
+            jnp.asarray(match), jnp.asarray(mask_in), jnp.asarray(mask_out)
+        )
+    )
+    for i in range(k):
+        want = min(
+            dumb_committed(match[i], mask_in[i]),
+            dumb_committed(match[i], mask_out[i]),
+        )
+        assert got[i] == want, i

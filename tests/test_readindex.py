@@ -71,20 +71,120 @@ def test_single_node_immediate():
     assert rs.request_ctx == 5 and rs.index == commit
 
 
-def test_read_before_commit_in_term_dropped():
-    """Deviation from the reference (which queues): requests before the
-    leader commits in its term are dropped; the client retries."""
+def pump_filtered(b, drop=None, max_iters=40):
+    """pump_collect_reads with a message filter: drop(m) -> True to drop."""
+    reads = {}
+    n = b.shape.n
+    for _ in range(max_iters):
+        moved = False
+        for lane in range(n):
+            if not b.has_ready(lane):
+                continue
+            rd = b.ready(lane)
+            for rs in rd.read_states:
+                reads.setdefault(lane, []).append(rs)
+            msgs = rd.messages
+            b.advance(lane)
+            for m in msgs:
+                if drop is not None and drop(m):
+                    continue
+                dst = m.to - 1
+                if 0 <= dst < n:
+                    b.step(dst, m)
+            moved = True
+        if not moved:
+            break
+    return reads
+
+
+def test_read_before_commit_in_term_queued():
+    """reference: raft_test.go TestReadOnlyForNewLeader — a MsgReadIndex
+    arriving before the leader commits in its term is POSTPONED
+    (raft.go:1313-1317) and released after the first commit of the term
+    (raft.go:2062-2079), not dropped."""
+    from raft_tpu.types import MessageType as MT
+
     b = make_group(3)
     b.campaign(0)
-    # leader not yet established/committed: read on candidate lane is inert
-    b.read_index(0, ctx=3)
-    reads = pump_collect_reads(b)
-    drive(b)
+    # drop all MsgApp: the leader wins the election but cannot commit the
+    # empty entry of its term
+    reads = {}
+    def drop_app(m):
+        return m.type == int(MT.MSG_APP)
+    reads = pump_filtered(b, drop=drop_app)
     assert b.basic_status(0)["raft_state"] == "LEADER"
-    # after commit-in-term, reads flow again
-    b.read_index(0, ctx=4)
+    assert b.basic_status(0)["commit"] == 0
+
+    b.read_index(0, ctx=7)
+    reads = pump_filtered(b, drop=drop_app)
+    assert 0 not in reads, "read must be postponed, not answered"
+
+    # recover the network; heartbeats un-pause the probing followers
+    # (the reference test ticks heartbeatTimeout then proposes), then
+    # commit an entry in the leader's term
+    b.propose(0, b"e")
+    reads = {}
+    for _ in range(4):
+        b.tick(0)
+        for lane, rss in pump_filtered(b).items():
+            reads.setdefault(lane, []).extend(rss)
+        if b.basic_status(0)["commit"] >= 2:
+            break
+    commit = b.basic_status(0)["commit"]
+    assert commit >= 2
+    # the postponed request was released and answered
+    assert [r.request_ctx for r in reads.get(0, [])] == [7]
+    # and its index is the commit at release time
+    assert reads[0][0].index == commit
+
+    # subsequent reads are served normally
+    b.read_index(0, ctx=8)
+    reads = pump_filtered(b)
+    assert [r.request_ctx for r in reads.get(0, [])] == [8]
+
+
+def test_prefix_release_on_later_ack():
+    """reference: read_only.go:81-112 advance() — a quorum ack for a later
+    ctx releases the acked request AND every earlier pending one, even if
+    the earlier request's own heartbeats were all lost."""
+    from raft_tpu.types import MessageType as MT
+
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    commit = b.basic_status(0)["commit"]
+
+    # first read: its heartbeat broadcast is entirely lost
+    def drop_hb_ctx1(m):
+        return m.type == int(MT.MSG_HEARTBEAT) and m.context == 101
+    b.read_index(0, ctx=101)
+    reads = pump_filtered(b, drop=drop_hb_ctx1)
+    assert 0 not in reads, "ctx 101 must still be pending"
+
+    # second read: delivered normally; its quorum ack releases the prefix
+    b.read_index(0, ctx=102)
+    reads = pump_filtered(b, drop=drop_hb_ctx1)
+    got = {r.request_ctx for r in reads.get(0, [])}
+    assert got == {101, 102}, got
+    for r in reads[0]:
+        assert r.index == commit
+
+
+def test_singleton_read_before_commit_immediate():
+    """reference: raft.go:1305-1310 — a single-voter leader answers
+    ReadIndex immediately, even before the first commit of its term."""
+    b = make_group(1)
+    b.campaign(0)
+    # one Ready/Advance delivers the durable self-vote -> leader; the empty
+    # entry's own self-ack is still pending, so nothing is committed in
+    # this term yet
+    b.ready(0)
+    b.advance(0)
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+    assert b.basic_status(0)["commit"] == 0
+    b.read_index(0, ctx=5)
     reads = pump_collect_reads(b)
-    assert [r.request_ctx for r in reads.get(0, [])] == [4]
+    assert [(r.request_ctx, r.index) for r in reads.get(0, [])] == [(5, 0)]
 
 
 def test_lease_based_read():
