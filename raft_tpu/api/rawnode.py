@@ -350,8 +350,15 @@ class RawNodeBatch:
         # foreign (bytes) contexts <-> negative device tickets; the device
         # only ever needs equality on the i32 ticket (ro_ctx ring / heartbeat
         # echo), the original bytes are restored on every host-visible surface
-        self._ctx_intern: dict[bytes, int] = {}
-        self._ctx_rev: dict[int, bytes] = {}
+        # per-lane: ctx is a per-group request key (the reference's readOnly
+        # queue is per raft instance, read_only.go:39-43) — identical bytes
+        # on two lanes are distinct requests and must not share a ticket's
+        # lifetime
+        self._ctx_intern: list[dict[bytes, int]] = [{} for _ in range(shape.n)]
+        self._ctx_rev: list[dict[int, bytes]] = [{} for _ in range(shape.n)]
+        # monotonic: a released ticket is never reissued, so a live pending
+        # request can't have its _ctx_rev entry clobbered by a later intern
+        self._next_ctx_ticket = -2
         e = shape.max_msg_entries
         (
             self._step_fn,
@@ -362,33 +369,36 @@ class RawNodeBatch:
 
     # -- kernel plumbing ---------------------------------------------------
 
-    def _ctx_ticket(self, ctx) -> int:
+    def _ctx_ticket(self, lane: int, ctx) -> int:
         """Map a message context to the device's i32 ticket: ints pass
         through; foreign byte strings intern to a negative ticket (app int
         tickets are conventionally >= 0; engine-internal contexts are small
-        positives)."""
+        positives). Repeated arrivals of the same bytes on the same lane
+        (heartbeat echoes of a pending request's ctx) reuse the ticket —
+        device-side ack matching is ticket equality."""
         if not isinstance(ctx, bytes):
             return int(ctx)
         if not ctx:
             return 0
-        t = self._ctx_intern.get(ctx)
+        t = self._ctx_intern[lane].get(ctx)
         if t is None:
-            t = -(len(self._ctx_intern) + 2)
-            self._ctx_intern[ctx] = t
-            self._ctx_rev[t] = ctx
+            t = self._next_ctx_ticket
+            self._next_ctx_ticket -= 1
+            self._ctx_intern[lane][ctx] = t
+            self._ctx_rev[lane][t] = ctx
         return t
 
-    def _ctx_out(self, ticket: int):
+    def _ctx_out(self, lane: int, ticket: int):
         """Restore the original bytes for interned tickets."""
-        return self._ctx_rev.get(ticket, ticket)
+        return self._ctx_rev[lane].get(ticket, ticket)
 
-    def _ctx_release(self, ticket: int):
+    def _ctx_release(self, lane: int, ticket: int):
         """Drop an interned mapping once its last engine artifact (the
         ReadState or the MsgReadIndexResp back to the requester) has been
         surfaced — the intern table must not grow with request count."""
-        b = self._ctx_rev.pop(ticket, None)
+        b = self._ctx_rev[lane].pop(ticket, None)
         if b is not None:
-            self._ctx_intern.pop(b, None)
+            self._ctx_intern[lane].pop(b, None)
 
     def _inbox_one(self, lane: int, msg: Message) -> MsgBatch:
         n, e = self.shape.n, self.shape.max_msg_entries
@@ -428,11 +438,11 @@ class RawNodeBatch:
                 commit=int(cols["commit"][lane, slot]),
                 reject=bool(cols["reject"][lane, slot]),
                 reject_hint=int(cols["reject_hint"][lane, slot]),
-                context=self._ctx_out(ctx_ticket),
+                context=self._ctx_out(lane, ctx_ticket),
             )
             if m.type == int(MT.MSG_READ_INDEX_RESP):
                 # the response is this ticket's final engine artifact
-                self._ctx_release(ctx_ticket)
+                self._ctx_release(lane, ctx_ticket)
             ne = int(cols["n_ents"][lane, slot])
             if ne and m.type == int(MT.MSG_PROP):
                 # proposal forwarded to the leader: entries ride verbatim with
@@ -487,7 +497,9 @@ class RawNodeBatch:
     def _run_step(self, lane: int, msg: Message):
         """One kernel invocation with a single hot lane; payload bookkeeping."""
         if isinstance(msg.context, bytes):
-            msg = dataclasses.replace(msg, context=self._ctx_ticket(msg.context))
+            msg = dataclasses.replace(
+                msg, context=self._ctx_ticket(lane, msg.context)
+            )
         pre = self.trace.snapshot(lane) if self.trace is not None else None
         old_last = int(self.view.last[lane])
         old_term = int(self.view.term[lane])
@@ -814,7 +826,7 @@ class RawNodeBatch:
         rd.read_states = [
             ReadState(
                 index=int(v.rs_index[lane, r]),
-                request_ctx=self._ctx_out(int(v.rs_ctx[lane, r])),
+                request_ctx=self._ctx_out(lane, int(v.rs_ctx[lane, r])),
             )
             for r in range(nrs)
         ] + list(self._read_states[lane])
@@ -847,7 +859,7 @@ class RawNodeBatch:
                     self._applying[lane] = rd.committed_entries[-1].index
             if nrs:
                 for r_ in range(nrs):
-                    self._ctx_release(int(v.rs_ctx[lane, r_]))
+                    self._ctx_release(lane, int(v.rs_ctx[lane, r_]))
                 self.state = dataclasses.replace(
                     self.state, rs_count=self.state.rs_count.at[lane].set(0)
                 )

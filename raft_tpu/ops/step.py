@@ -1160,6 +1160,7 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         self_rel[:, None, :] & (sq[:, None, :] < sq[:, :, None]), axis=-1
     )
     pos = state.rs_count[:, None] + rank  # [N, R]
+    r_ax2 = state.rs_ctx.shape[1]
     ok_rs = self_rel & (pos < r_ax2)
     put_rs = ok_rs[:, :, None] & (
         jnp.arange(r_ax2, dtype=I32)[None, None, :] == pos[:, :, None]
