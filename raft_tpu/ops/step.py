@@ -8,7 +8,7 @@ storage acks -> vote casting -> role-dispatched handlers. Per-lane control
 flow becomes lane masks; each phase is a no-op on lanes it doesn't select.
 This is the "single vmapped kernel" SURVEY §3.2 names as the north star.
 
-Outbox layout (per lane, `V + 2` message slots):
+Outbox layout (per lane, `V + 2 + R` message slots):
   slots 0..V-1  fan-out: the message (if any) addressed to peer slot j
                  (MsgApp/MsgSnap/MsgHeartbeat/MsgVote/MsgTimeoutNow)
   slot  V       self-addressed after-append message (the self-ack
@@ -18,6 +18,9 @@ Outbox layout (per lane, `V + 2` message slots):
                  contract, see api/rawnode.py)
   slot  V+1     direct reply to the message's sender (acks, rejections,
                  forwards)
+  slots V+2..   R ReadIndex drain slots: the whole-prefix batch release of
+                 pending remote reads on a quorum ack (read_only.go:81-112)
+                 emits one MsgReadIndexResp per released slot in one step
 
 Known, deliberate deviations from the reference (documented for the judge):
   - One MsgApp per peer per step: the reference's pipelining loop
@@ -119,13 +122,17 @@ class Outbox:
     dominant copy cost on TPU.)
     """
 
-    def __init__(self, state: RaftState, max_entries: int):
+    def __init__(self, state: RaftState, max_entries: int, n_drain: int = 0):
         n, v = state.prs_id.shape
         self.n, self.v, self.e = n, v, max_entries
+        self.n_drain = n_drain
         self._proto = empty_batch((n,), max_entries)
         self._peers = empty_batch((n, v), max_entries)
         self._self = {f.name: getattr(self._proto, f.name) for f in dataclasses.fields(self._proto)}
         self._reply = dict(self._self)
+        # drain slots: extra same-step emissions beyond the one-reply-per-
+        # lane contract (ReadIndex prefix batch release, read_only.go:81-112)
+        self._drain = empty_batch((n, n_drain), max_entries) if n_drain else None
 
     def _bc_mask(self, mask, like):
         ms = mask
@@ -146,14 +153,15 @@ class Outbox:
     def put_reply(self, mask, **fields):
         self._put_row(self._reply, mask, fields)
 
+    def put_drain(self, mask_nr, **fields_nr):
+        """Write [N, n_drain] messages into the drain slots (same calling
+        convention as put_peers)."""
+        self._drain = self._put_nv(self._drain, mask_nr, fields_nr)
+
     def put_self(self, mask, **fields):
         self._put_row(self._self, mask, fields)
 
-    def put_peers(self, mask_nv, **fields_nv):
-        """Write per-peer messages into fan-out slots. fields values are
-        [N, V] (or broadcastable [N] -> same message to every peer)."""
-        m = self._peers
-
+    def _put_nv(self, m, mask_nv, fields_nv):
         def _bc(x, like):
             x = jnp.asarray(x)
             while x.ndim < like.ndim:
@@ -172,17 +180,26 @@ class Outbox:
                 )
             else:
                 updates[f.name] = old
-        self._peers = MsgBatch(**updates)
+        return MsgBatch(**updates)
+
+    def put_peers(self, mask_nv, **fields_nv):
+        """Write per-peer messages into fan-out slots. fields values are
+        [N, V] (or broadcastable [N] -> same message to every peer)."""
+        self._peers = self._put_nv(self._peers, mask_nv, fields_nv)
 
     @property
     def msgs(self) -> MsgBatch:
-        """Assemble the [N, V+2] slot batch (fan-out slots, self, reply)."""
+        """Assemble the [N, V+2(+n_drain)] slot batch (fan-out slots, self,
+        reply, drain)."""
         cols = {}
         for f in dataclasses.fields(self._peers):
             p = getattr(self._peers, f.name)
             s = self._self[f.name][:, None]
             r = self._reply[f.name][:, None]
-            cols[f.name] = jnp.concatenate([p, s, r], axis=1)
+            parts = [p, s, r]
+            if self._drain is not None:
+                parts.append(getattr(self._drain, f.name))
+            cols[f.name] = jnp.concatenate(parts, axis=1)
         return MsgBatch(**cols)
 
 
@@ -609,8 +626,16 @@ class StepResult(NamedTuple):
 
 
 def step(state: RaftState, msg: MsgBatch, max_entries: int | None = None) -> StepResult:
-    """Step every lane on (at most) one message. msg batch shape [N]."""
-    out = Outbox(state, max_entries or msg.ent_term.shape[-1])
+    """Step every lane on (at most) one message. msg batch shape [N].
+
+    Output slots: [N, V+2+(R-1)] — V fan-out, self, reply, plus R-1 drain
+    slots used only by the ReadIndex prefix batch release
+    (read_only.go:81-112; the quorum-acked request itself rides the reply
+    slot, so at most R-1 older remote reads release alongside it)."""
+    out = Outbox(
+        state, max_entries or msg.ent_term.shape[-1],
+        n_drain=state.ro_ctx.shape[1] - 1,
+    )
     present = msg.is_present
     mtype = msg.type
 
@@ -1134,7 +1159,7 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         jnp.arange(state.ro_ctx.shape[1], dtype=I32)[None, :] == won_r[:, None]
     ) & won_any[:, None]
     self_rel = in_prefix & (state.ro_from == state.id[:, None]) & ~is_won_slot
-    remote_all = in_prefix & (state.ro_from != state.id[:, None]) & ~is_won_slot
+    remote_rel = in_prefix & (state.ro_from != state.id[:, None]) & ~is_won_slot
     # the quorum-acked request itself responds exactly as before (reply slot)
     out.put_reply(
         won_any,
@@ -1145,14 +1170,35 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         index=ohm.gather(state.ro_index, won_r),
         context=ohm.gather(state.ro_ctx, won_r),
     )
-    # Older REMOTE-destined prefix slots stay queued: the outbox holds one
-    # reply cell per lane per step, so only the quorum-acked slot's
-    # response rides this step. The stranded slots drain one per ack
-    # round: once they are the newest live pending requests, tick
-    # heartbeats carry their ctx (lastPendingRequestCtx above) and each
-    # quorum ack releases the next one — same fixpoint as the reference's
-    # batch release, spread over rounds.
+    # Older REMOTE-destined prefix slots batch-release through the drain
+    # slots — every pending remote read in the acked prefix responds in
+    # THIS step, matching the reference's whole-prefix advance
+    # (read_only.go:81-112 + raft.go:1553-1561 responseToReadIndexReq).
     sq = state.ro_seq
+    r_ax2 = state.rs_ctx.shape[1]
+    if out.n_drain > 0:
+        rr_rank = jnp.sum(
+            remote_rel[:, None, :] & (sq[:, None, :] < sq[:, :, None]), axis=-1
+        )  # FIFO order among released remote slots
+        # [N, src R, drain R-1] one-hot: source slot lands at rank's slot
+        put_dr = remote_rel[:, :, None] & (
+            rr_rank[:, :, None]
+            == jnp.arange(out.n_drain, dtype=I32)[None, None, :]
+        )
+        dr_any = put_dr.any(axis=1)  # [N, drain]
+
+        def _dr(col):
+            return jnp.sum(put_dr * col[:, :, None], axis=1)
+
+        out.put_drain(
+            dr_any,
+            type=MT.MSG_READ_INDEX_RESP,
+            to=_dr(state.ro_from),
+            frm=state.id[:, None],
+            term=state.term[:, None],
+            index=_dr(state.ro_index),
+            context=_dr(state.ro_ctx),
+        )
     # older self-destined prefix slots append straight to the ReadState
     # ring (reference: responseToReadIndexReq local branch, raft.go:2085-
     # 2091), in FIFO (seq) order
@@ -1160,7 +1206,6 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         self_rel[:, None, :] & (sq[:, None, :] < sq[:, :, None]), axis=-1
     )
     pos = state.rs_count[:, None] + rank  # [N, R]
-    r_ax2 = state.rs_ctx.shape[1]
     ok_rs = self_rel & (pos < r_ax2)
     put_rs = ok_rs[:, :, None] & (
         jnp.arange(r_ax2, dtype=I32)[None, None, :] == pos[:, :, None]
@@ -1180,7 +1225,7 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         ),
         rs_count=state.rs_count + jnp.sum(ok_rs.astype(I32), axis=1),
     )
-    release = is_won_slot | ok_rs
+    release = is_won_slot | ok_rs | remote_rel
     state = dataclasses.replace(
         state,
         ro_ctx=_w(release, 0, state.ro_ctx),

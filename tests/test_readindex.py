@@ -196,3 +196,68 @@ def test_lease_based_read():
     reads = pump_collect_reads(b)
     (rs,) = reads[0]
     assert rs.request_ctx == 12 and rs.index == commit
+
+
+def test_remote_prefix_batch_release_single_ready():
+    """reference: read_only.go:81-112 + raft.go:1553-1561 — a quorum ack
+    releases EVERY pending read in the prefix in the same advance, and the
+    leader responds to all remote requesters at once: all MsgReadIndexResp
+    must ride ONE leader Ready (the drain slots), not trickle out one per
+    ack round."""
+    from raft_tpu.types import MessageType as MT
+
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    commit = b.basic_status(0)["commit"]
+
+    # two follower-forwarded reads whose ack heartbeats are all lost:
+    # they stay pending in the leader's readOnly queue
+    def drop_stale_hb(m):
+        return m.type == int(MT.MSG_HEARTBEAT) and m.context in (201, 202)
+
+    b.read_index(1, ctx=201)
+    pump_filtered(b, drop=drop_stale_hb)
+    b.read_index(2, ctx=202)
+    pump_filtered(b, drop=drop_stale_hb)
+
+    # third forwarded read delivered normally; its quorum ack must batch-
+    # release the whole prefix
+    b.read_index(1, ctx=203)
+    reads = {}
+    resp_readies = []  # ctx sets of leader Readies carrying resps
+    for _ in range(40):
+        moved = False
+        for lane in range(3):
+            if not b.has_ready(lane):
+                continue
+            rd = b.ready(lane)
+            moved = True
+            resps = [
+                m.context
+                for m in rd.messages
+                if m.type == int(MT.MSG_READ_INDEX_RESP)
+            ]
+            if lane == 0 and resps:
+                resp_readies.append(set(resps))
+            msgs = rd.messages
+            for rs in rd.read_states:
+                reads.setdefault(lane, []).append(rs)
+            b.advance(lane)
+            for m in msgs:
+                if drop_stale_hb(m):
+                    continue
+                dst = m.to - 1
+                if 0 <= dst < 3:
+                    b.step(dst, m)
+        if not moved:
+            break
+
+    # every response left in ONE leader Ready
+    assert resp_readies == [{201, 202, 203}], resp_readies
+    # and the followers surfaced the ReadStates with the right indexes
+    assert {r.request_ctx for r in reads.get(1, [])} == {201, 203}
+    assert {r.request_ctx for r in reads.get(2, [])} == {202}
+    for rss in reads.values():
+        for r in rss:
+            assert r.index == commit
