@@ -1171,6 +1171,17 @@ class RawNodeBatch:
         )
         self.view.refresh(self.state)
 
+    def set_snapshot_unavailable(self, lane: int, on: bool = True):
+        """Storage.Snapshot() deferral (reference: storage.go:36-38
+        ErrSnapshotTemporarilyUnavailable): while on, the leader's MsgSnap
+        fallback is skipped without error and retried after clearing —
+        raft.go:625-649's non-panicking skip path."""
+        st = self.state
+        self.state = dataclasses.replace(
+            st, snap_unavailable=st.snap_unavailable.at[lane].set(on)
+        )
+        self.view.refresh(self.state)
+
     def compact(self, lane: int, to_index: int, data: bytes = b""):
         """App-driven compaction: CreateSnapshot(to_index, data) + Compact
         (reference: storage.go:227-272). to_index must be <= applied."""

@@ -406,7 +406,13 @@ def maybe_send_append(
     # avail_snap_*, which may be ahead of the compaction point), matching
     # r.raftLog.snapshot() semantics (reference: raft.go:636-649).
     need_snap = prev < state.snap_index[:, None]
-    snap_sel = sel & need_snap & state.pr_recent_active
+    # Storage.Snapshot() deferral (ErrSnapshotTemporarilyUnavailable,
+    # storage.go:36-38): skip the send without erroring or entering
+    # StateSnapshot; the peer is retried once the storage recovers
+    # (raft.go:625-649 returns false on this error).
+    snap_sel = (
+        sel & need_snap & state.pr_recent_active & ~state.snap_unavailable[:, None]
+    )
     app_sel = sel & ~need_snap
 
     send_si = jnp.where(

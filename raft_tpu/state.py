@@ -110,6 +110,10 @@ class RaftState:
     # point; it is what leaders send in MsgSnap (raft.go:636-649).
     avail_snap_index: Any  # [N] i32 (0 = none)
     avail_snap_term: Any  # [N] i32
+    # Storage.Snapshot() deferral (reference: storage.go:36-38
+    # ErrSnapshotTemporarilyUnavailable): while set, the leader skips the
+    # MsgSnap fallback without erroring and retries later (raft.go:625-649)
+    snap_unavailable: Any  # [N] bool
 
     # --- membership (reference: tracker/tracker.go:27-78) ---
     # Slot-major: peer slot j of lane n describes group-member prs_id[n, j].
@@ -305,6 +309,7 @@ def init_state(
         pending_snap_term=zeros_n,
         avail_snap_index=zeros_n,
         avail_snap_term=zeros_n,
+        snap_unavailable=jnp.zeros((n,), BOOL),
         prs_id=jnp.asarray(peer_ids),
         voters_in=jnp.asarray(voters_in),
         voters_out=jnp.zeros((n, v), BOOL),

@@ -93,3 +93,193 @@ def test_snapshot_surfaces_in_ready_before_committed_entries():
         if not moved:
             break
     assert seen_snap and seen_snap[0].index == commit
+
+
+# --------------------------------------------------------------------------
+# raft_snap_test.go ports (reference: raft_snap_test.go:25-141). The
+# reference tests drive node 1 white-box with a dummy peer 2 (messages to 2
+# are never delivered); mirrored here by poking the [lane, slot] progress
+# cells and stepping single messages.
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from raft_tpu.api.rawnode import Message
+from raft_tpu.types import MessageType as MT, ProgressState
+
+SNAP_IDX = 11  # the reference's magic testingSnap index/term
+SNAP_TERM = 11
+
+
+def _poke(b, **fields):
+    """Apply .at[...].set updates given as {field: [(index_tuple, value)]}."""
+    st = b.state
+    upd = {}
+    for name, sets in fields.items():
+        arr = getattr(st, name)
+        for idx, val in sets:
+            arr = arr.at[idx].set(val)
+        upd[name] = arr
+    b.state = dataclasses.replace(st, **upd)
+    b.view.refresh(b.state)
+
+
+def restored_leader_pair():
+    """Node 1 restored from testingSnap{index:11, term:11, voters:[1,2]},
+    then elected leader without ever delivering to peer 2 (the reference's
+    newTestRaft + restore + becomeCandidate/becomeLeader)."""
+    b = make_group(2, shape_kw=dict(log_window=32))
+    _poke(
+        b,
+        snap_index=[((0,), SNAP_IDX)],
+        snap_term=[((0,), SNAP_TERM)],
+        last=[((0,), SNAP_IDX)],
+        stabled=[((0,), SNAP_IDX)],
+        committed=[((0,), SNAP_IDX)],
+        applying=[((0,), SNAP_IDX)],
+        applied=[((0,), SNAP_IDX)],
+    )
+    b.campaign(0)
+    rd = b.ready(0)
+    b.advance(0)  # self-vote durable
+    term = b.basic_status(0)["term"]
+    b.step(0, Message(type=int(MT.MSG_VOTE_RESP), frm=2, to=1, term=term))
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+    # drain the become-leader Ready (empty entry at SNAP_IDX+1)
+    b.ready(0)
+    b.advance(0)
+    while b.has_ready(0):
+        b.ready(0)
+        b.advance(0)
+    assert int(b.view.last[0]) == SNAP_IDX + 1
+    return b
+
+
+def test_sending_snapshot_sets_pending(  # TestSendingSnapshotSetPendingSnapshot
+):
+    b = restored_leader_pair()
+    first = SNAP_IDX + 1  # firstIndex after restore
+    _poke(b, pr_next=[((0, 1), first)])
+    b.step(
+        0,
+        Message(
+            type=int(MT.MSG_APP_RESP), frm=2, to=1,
+            term=b.basic_status(0)["term"], index=first - 1, reject=True,
+        ),
+    )
+    assert int(b.view.pr_pending_snapshot[0, 1]) == SNAP_IDX
+    assert int(b.view.pr_state[0, 1]) == int(ProgressState.SNAPSHOT)
+    # and the MsgSnap rode out
+    rd = b.ready(0)
+    b.advance(0)
+    snaps = [m for m in rd.messages if m.type == int(MT.MSG_SNAP)]
+    assert len(snaps) == 1 and snaps[0].to == 2
+
+
+def test_pending_snapshot_pauses_replication(  # TestPendingSnapshotPauseReplication
+):
+    b = restored_leader_pair()
+    _poke(
+        b,
+        pr_state=[((0, 1), int(ProgressState.SNAPSHOT))],
+        pr_pending_snapshot=[((0, 1), SNAP_IDX)],
+    )
+    b.propose(0, b"somedata")
+    rd = b.ready(0)
+    b.advance(0)
+    assert [m for m in rd.messages if m.to == 2] == [], rd.messages
+
+
+def test_snapshot_failure():  # TestSnapshotFailure
+    b = restored_leader_pair()
+    _poke(
+        b,
+        pr_next=[((0, 1), 1)],
+        pr_state=[((0, 1), int(ProgressState.SNAPSHOT))],
+        pr_pending_snapshot=[((0, 1), SNAP_IDX)],
+    )
+    b.step(0, Message(type=int(MT.MSG_SNAP_STATUS), frm=2, to=1, reject=True))
+    assert int(b.view.pr_pending_snapshot[0, 1]) == 0
+    assert int(b.view.pr_next[0, 1]) == 1
+    assert bool(b.view.pr_msg_app_flow_paused[0, 1])
+    assert int(b.view.pr_state[0, 1]) == int(ProgressState.PROBE)
+
+
+def test_snapshot_succeed():  # TestSnapshotSucceed
+    b = restored_leader_pair()
+    _poke(
+        b,
+        pr_next=[((0, 1), 1)],
+        pr_state=[((0, 1), int(ProgressState.SNAPSHOT))],
+        pr_pending_snapshot=[((0, 1), SNAP_IDX)],
+    )
+    b.step(0, Message(type=int(MT.MSG_SNAP_STATUS), frm=2, to=1, reject=False))
+    assert int(b.view.pr_pending_snapshot[0, 1]) == 0
+    assert int(b.view.pr_next[0, 1]) == SNAP_IDX + 1
+    assert bool(b.view.pr_msg_app_flow_paused[0, 1])
+    assert int(b.view.pr_state[0, 1]) == int(ProgressState.PROBE)
+
+
+def test_snapshot_abort():  # TestSnapshotAbort
+    b = restored_leader_pair()
+    _poke(
+        b,
+        pr_next=[((0, 1), 1)],
+        pr_state=[((0, 1), int(ProgressState.SNAPSHOT))],
+        pr_pending_snapshot=[((0, 1), SNAP_IDX)],
+    )
+    # an ack at/above the pending snapshot aborts it; the peer enters
+    # Replicate and the empty leader entry (index 12) goes out with the
+    # optimistic Next bump
+    b.step(
+        0,
+        Message(
+            type=int(MT.MSG_APP_RESP), frm=2, to=1,
+            term=b.basic_status(0)["term"], index=SNAP_IDX,
+        ),
+    )
+    assert int(b.view.pr_pending_snapshot[0, 1]) == 0
+    assert int(b.view.pr_state[0, 1]) == int(ProgressState.REPLICATE)
+    assert int(b.view.pr_next[0, 1]) == SNAP_IDX + 2  # 13
+    assert int(b.view.infl_count[0, 1]) == 1
+
+
+def test_snapshot_temporarily_unavailable():
+    """reference: storage.go:36-38 + raft.go:625-649 — Storage may defer
+    snapshot generation; the leader skips the MsgSnap without erroring or
+    entering StateSnapshot, and retries once the storage recovers."""
+    b = restored_leader_pair()
+    first = SNAP_IDX + 1
+    b.set_snapshot_unavailable(0, True)
+    _poke(b, pr_next=[((0, 1), first)])
+    b.step(
+        0,
+        Message(
+            type=int(MT.MSG_APP_RESP), frm=2, to=1,
+            term=b.basic_status(0)["term"], index=first - 1, reject=True,
+        ),
+    )
+    # deferred: no snapshot state, no MsgSnap, no error
+    assert int(b.view.pr_state[0, 1]) != int(ProgressState.SNAPSHOT)
+    assert int(b.view.pr_pending_snapshot[0, 1]) == 0
+    rd = b.ready(0)
+    b.advance(0)
+    assert [m for m in rd.messages if m.type == int(MT.MSG_SNAP)] == []
+    assert not np.asarray(b.state.error_bits).any()
+
+    # storage recovers: the next send attempt (heartbeat-resp backlog probe)
+    # falls back to the snapshot as usual
+    b.set_snapshot_unavailable(0, False)
+    b.step(
+        0,
+        Message(
+            type=int(MT.MSG_HEARTBEAT_RESP), frm=2, to=1,
+            term=b.basic_status(0)["term"],
+        ),
+    )
+    assert int(b.view.pr_state[0, 1]) == int(ProgressState.SNAPSHOT)
+    rd = b.ready(0)
+    b.advance(0)
+    snaps = [m for m in rd.messages if m.type == int(MT.MSG_SNAP)]
+    assert len(snaps) == 1 and snaps[0].to == 2
