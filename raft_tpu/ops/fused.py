@@ -356,12 +356,17 @@ class LocalOps(NamedTuple):
     transfer_to: Any  # [N] i32 raft id (0 = none) - MsgTransferLeader
     read_ctx: Any  # [N] i32 ctx ticket (0 = none) - MsgReadIndex at leader
     forget: Any  # [N] bool - MsgForgetLeader
+    # conf-change proposal: 0 = none, 1 = EnterJoint/Simple, 2 = LeaveJoint.
+    # The change content stays host-side (rare path, SURVEY §7); the device
+    # appends the typed entry, applies the proposal gating, and tracks
+    # pendingConfIndex (raft.go:1259-1301). See ops/fused_confchange.py.
+    prop_cc: Any  # [N] i32
 
 
 def no_ops(n: int) -> LocalOps:
     z = jnp.zeros((n,), I32)
     zb = jnp.zeros((n,), BOOL)
-    return LocalOps(zb, z, z, z, z, zb)
+    return LocalOps(zb, z, z, z, z, zb, z)
 
 
 # --------------------------------------------------------------------------
@@ -873,6 +878,40 @@ def fused_round(
     )
     want_send(appended[:, None] & all_peers)
 
+    # conf-change proposal (raft.go:1259-1301): one ENTRY_CONF_CHANGE_V2
+    # entry whose content the host holds. Gating per the reference: refuse
+    # while a change is pending (pendingConfIndex > applied), refuse a
+    # non-leave change while in joint, refuse leave while not joint — a
+    # refused change still appends an empty NORMAL entry in its place
+    # (raft.go:1284-1296). pendingConfIndex moves to the appended index.
+    from raft_tpu.types import EntryType
+
+    want_cc = (
+        (ops.prop_cc > 0)
+        & is_leader
+        & (state.lead_transferee == 0)
+        & (ss >= 0)
+    )
+    joint = state.voters_out.any(axis=1)
+    pending_cc = state.pending_conf_index > state.applied
+    refused = pending_cc | jnp.where(ops.prop_cc == 2, ~joint, joint)
+    cc_ok = want_cc & ~refused
+    cc_type = jnp.where(
+        cc_ok[:, None] & (jnp.arange(e, dtype=I32)[None, :] == 0),
+        jnp.int32(EntryType.ENTRY_CONF_CHANGE_V2),
+        0,
+    )
+    state, cc_appended = stepmod.append_entry(
+        state, want_cc, zeros_e, cc_type, zeros_e, jnp.ones((n,), I32), out
+    )
+    state = dataclasses.replace(
+        state,
+        pending_conf_index=_w(
+            cc_appended & cc_ok, state.last, state.pending_conf_index
+        ),
+    )
+    want_send(cc_appended[:, None] & all_peers)
+
     # transfer-leadership request (raft.go:1587-1618), injected at the leader
     tt = ops.transfer_to
     t_ok = (
@@ -1066,7 +1105,15 @@ class FusedCluster:
     The throughput engine behind bench.py; the serial Cluster remains the
     conformance-exact path."""
 
-    def __init__(self, n_groups: int, n_voters: int, seed: int = 1, shape=None, **cfg):
+    def __init__(
+        self,
+        n_groups: int,
+        n_voters: int,
+        seed: int = 1,
+        shape=None,
+        learner_ids: tuple = (),
+        **cfg,
+    ):
         import numpy as np
 
         from raft_tpu.config import Shape
@@ -1080,8 +1127,17 @@ class FusedCluster:
         ids = np.tile(np.arange(1, n_voters + 1, dtype=np.int32), n_groups)
         peers = np.zeros((n, n_voters), np.int32)
         peers[:, :] = np.arange(1, n_voters + 1, dtype=np.int32)[None, :]
+        # ids that start as learners in every group (membership changes can
+        # later promote them — ops/fused_confchange.py)
+        is_learner = np.zeros((n, n_voters), bool)
+        for lid in learner_ids:
+            if not (1 <= lid <= n_voters):
+                raise ValueError(f"learner id {lid} outside canonical 1..{n_voters}")
+            is_learner[:, lid - 1] = True
         lane_cfg = make_lane_config(self.shape, **cfg)
-        self.state = init_state(self.shape, ids, peers, seed=seed, cfg=lane_cfg)
+        self.state = init_state(
+            self.shape, ids, peers, is_learner, seed=seed, cfg=lane_cfg
+        )
         self.fab = empty_fabric(n, n_voters, self.shape.max_msg_entries)
         self.mute = jnp.zeros((n,), BOOL)
 
@@ -1094,6 +1150,7 @@ class FusedCluster:
         do_tick: bool = True,
         auto_propose: bool = False,
         auto_compact_lag: int | None = None,
+        ops_first_round_only: bool = True,
     ):
         if ops is None:
             ops = no_ops(self.state.id.shape[0])
@@ -1107,6 +1164,7 @@ class FusedCluster:
             do_tick=do_tick,
             auto_propose=auto_propose,
             auto_compact_lag=auto_compact_lag,
+            ops_first_round_only=ops_first_round_only,
         )
 
     def ops(self, **kw) -> LocalOps:
@@ -1127,6 +1185,12 @@ class FusedCluster:
 
     def campaign(self, lane: int):
         self.run(1, ops=self.ops(hup={lane: True}), do_tick=False)
+
+    def conf_changer(self):
+        """Membership-change driver for this batch (fused_confchange.py)."""
+        from raft_tpu.ops.fused_confchange import FusedConfChanger
+
+        return FusedConfChanger(self)
 
     def set_mute(self, lanes, on: bool = True):
         import numpy as np

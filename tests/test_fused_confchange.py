@@ -1,0 +1,215 @@
+"""Membership changes on the RUNNING fused engine (ops/fused_confchange.py).
+
+The headline scenario is the reference's confchange_v2_replace_leader.txt
+golden flow — enter joint consensus, transfer leadership to a newly promoted
+voter's side, leave joint — executed simultaneously in every group of a
+1024-group batch mid-replication, with commits required to keep advancing
+through every phase (reference: confchange/confchange.go:51-145,
+raft.go:1888-1970).
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu import confchange as ccm
+from raft_tpu.config import Shape
+from raft_tpu.ops.fused import FusedCluster
+from raft_tpu.types import StateType
+
+
+def make_batch(g, v=4, learner_ids=(4,), **cfg):
+    shape = Shape(
+        n_lanes=g * v,
+        max_peers=v,
+        log_window=32,
+        max_msg_entries=2,
+        max_inflight=2,
+    )
+    return FusedCluster(g, v, seed=7, shape=shape, learner_ids=learner_ids, **cfg)
+
+
+def elect_id1(c):
+    """Deterministically elect id 1 in every group."""
+    hups = {l: True for l in range(0, c.g * c.v, c.v)}
+    c.run(1, ops=c.ops(hup=hups), do_tick=False)
+    c.run(3, auto_propose=True)
+    leaders = c.leader_lanes()
+    assert len(leaders) == c.g, f"{len(leaders)}/{c.g} groups elected"
+    assert all(l % c.v == 0 for l in leaders)
+
+
+def committed_total(c):
+    return int(np.asarray(c.state.committed, np.int64).sum())
+
+
+def config_of(c, lane):
+    vin = np.asarray(c.state.voters_in[lane])
+    vout = np.asarray(c.state.voters_out[lane])
+    lrn = np.asarray(c.state.learners[lane])
+    ids = np.asarray(c.state.prs_id[lane])
+    return (
+        {int(i) for i in ids[vin] if i},
+        {int(i) for i in ids[vout] if i},
+        {int(i) for i in ids[lrn] if i},
+    )
+
+
+def test_replace_leader_joint_1k_groups():
+    """Replace the leader via joint consensus in all 1024 groups of a batch
+    that keeps replicating throughout (the bench-config-4 workload shape)."""
+    G = 1024
+    c = make_batch(G)
+    elect_id1(c)
+    ch = c.conf_changer()
+
+    com = [committed_total(c)]
+
+    # phase 1: EnterJoint(explicit): promote learner 4, remove voter 1
+    cc = ccm.ConfChangeV2(
+        transition=int(ccm.ConfChangeTransition.JOINT_EXPLICIT),
+        changes=[
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_NODE), 4),
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.REMOVE_NODE), 1),
+        ],
+    )
+    accepted = ch.propose(cc)
+    assert len(accepted) == G, f"only {len(accepted)} groups accepted the cc"
+    ch.settle(auto_leave=False, auto_propose=True)
+    com.append(committed_total(c))
+
+    vin, vout, lrn = config_of(c, 0)
+    assert vin == {2, 3, 4} and vout == {1, 2, 3} and lrn == set()
+    # every lane of every group installed the same joint config
+    assert bool(np.asarray(c.state.voters_out).any(axis=1).all())
+
+    # phase 2: transfer leadership 1 -> 2 while in joint
+    leaders = c.leader_lanes()
+    c.run(1, ops=c.ops(transfer_to={int(l): 2 for l in leaders}), do_tick=False)
+    for _ in range(8):
+        c.run(2, auto_propose=True)
+        leaders = c.leader_lanes()
+        if len(leaders) == G and all(l % c.v == 1 for l in leaders):
+            break
+    leaders = c.leader_lanes()
+    assert len(leaders) == G
+    assert all(l % c.v == 1 for l in leaders), "leadership not on id 2"
+    com.append(committed_total(c))
+
+    # phase 3: the new leaders leave joint
+    c.run(2, auto_propose=True)  # let the new term's empty entry apply
+    accepted = ch.propose(ccm.ConfChangeV2())
+    assert len(accepted) == G, f"only {len(accepted)} groups accepted leave"
+    ch.settle(auto_propose=True)
+    com.append(committed_total(c))
+
+    vin, vout, lrn = config_of(c, 1)
+    assert vin == {2, 3, 4} and vout == set() and lrn == set()
+    # the removed member is untracked everywhere in the group
+    assert not bool(np.asarray(c.state.voters_in[:, 0]).any())
+
+    # commits advanced in every phase: replication never stalled
+    assert com[1] > com[0] and com[2] > com[1] and com[3] > com[2], com
+
+    # the batch keeps serving under the new config
+    before = committed_total(c)
+    c.run(4, auto_propose=True)
+    assert committed_total(c) > before
+    c.check_no_errors()
+
+
+def test_learner_promotion_simple():
+    """A one-change promotion (learner -> voter) takes the simple path, no
+    joint interlude (confchange.go:128-145)."""
+    c = make_batch(8)
+    elect_id1(c)
+    ch = c.conf_changer()
+    cc = ccm.ConfChange(type=int(ccm.ConfChangeType.ADD_NODE), node_id=4)
+    accepted = ch.propose(cc)
+    assert len(accepted) == 8
+    ch.settle(auto_propose=True)
+    vin, vout, lrn = config_of(c, 0)
+    assert vin == {1, 2, 3, 4} and vout == set() and lrn == set()
+    # the promoted voter now counts toward quorum: kill two old voters and
+    # the group still commits (3 of 4 alive)
+    c.set_mute([2], on=True)  # id 3 of group 0
+    before = int(np.asarray(c.state.committed[0]))
+    c.run(6, auto_propose=True)
+    assert int(np.asarray(c.state.committed[0])) > before
+    c.check_no_errors()
+
+
+def test_auto_leave_joint():
+    """An AUTO multi-change enters joint with AutoLeave; the driver proposes
+    the empty LeaveJoint as the reference's leader does on apply
+    (raft.go:1197-1221)."""
+    c = make_batch(8)
+    elect_id1(c)
+    ch = c.conf_changer()
+    cc = ccm.ConfChangeV2(
+        changes=[
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_NODE), 4),
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_LEARNER_NODE), 3),
+        ],
+    )
+    accepted = ch.propose(cc)
+    assert len(accepted) == 8
+    ch.settle(auto_propose=True)  # installs joint, auto-proposes leave, installs final
+    vin, vout, lrn = config_of(c, 0)
+    assert vin == {1, 2, 4} and vout == set() and lrn == {3}
+    c.check_no_errors()
+
+
+def test_pending_conf_change_gate():
+    """A second change proposed while one is in flight is refused and
+    appends an empty normal entry instead (raft.go:1268-1296)."""
+    c = make_batch(4)
+    elect_id1(c)
+    ch = c.conf_changer()
+    cc = ccm.ConfChange(type=int(ccm.ConfChangeType.ADD_NODE), node_id=4)
+    first = ch.propose(cc)
+    assert len(first) == 4
+    # immediately propose again: pendingConfIndex > applied everywhere
+    ch2 = c.conf_changer()
+    second = ch2.propose(cc)
+    assert second == {}, second
+    ch.settle(auto_propose=True)
+    vin, _, _ = config_of(c, 0)
+    assert vin == {1, 2, 3, 4}
+    c.check_no_errors()
+
+
+def test_remove_leader_step_down():
+    """StepDownOnRemoval: a leader removed by the applied change demotes
+    itself (raft.go:1930-1936) and a remaining voter takes over."""
+    c = make_batch(8, step_down_on_removal=True)
+    elect_id1(c)
+    ch = c.conf_changer()
+    cc = ccm.ConfChangeV2(
+        transition=int(ccm.ConfChangeTransition.JOINT_EXPLICIT),
+        changes=[
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_NODE), 4),
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.REMOVE_NODE), 1),
+        ],
+    )
+    assert len(ch.propose(cc)) == 8
+    ch.settle(auto_leave=False, auto_propose=True)
+    # still joint: id 1 remains leader (outgoing voter)
+    assert len(c.leader_lanes()) == 8
+
+    assert len(ch.propose(ccm.ConfChangeV2())) == 8
+    ch.settle(auto_propose=True)
+    # leave applied: removed leaders stepped down
+    states = np.asarray(c.state.state)[0 :: c.v]
+    assert (states != int(StateType.LEADER)).all()
+    # surviving voters elect a replacement and the groups serve again
+    before = committed_total(c)
+    for _ in range(30):
+        c.run(4, auto_propose=True)
+        leaders = c.leader_lanes()
+        if len(leaders) == 8 and all(l % c.v != 0 for l in leaders):
+            break
+    leaders = c.leader_lanes()
+    assert len(leaders) == 8 and all(l % c.v != 0 for l in leaders)
+    c.run(4, auto_propose=True)
+    assert committed_total(c) > before
+    c.check_no_errors()
