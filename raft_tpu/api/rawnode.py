@@ -1171,6 +1171,76 @@ class RawNodeBatch:
         )
         self.view.refresh(self.state)
 
+    def rebase_group(self, lanes, delta: int | None = None) -> int:
+        """Index re-keying after snapshot+compact — the recovery path for
+        the i32 device index space (reference indexes are uint64,
+        raftpb/raft.proto:21-26; ops/log.py flags ERR_INDEX_NEAR_OVERFLOW
+        at 2^30). Shifts every index down by `delta` (default: the largest
+        window-aligned value below the group's min snap_index) on the given
+        lanes — pass ALL members of a group homed here so in-flight message
+        indexes stay consistent. Host mirrors (payload store keys, HardState
+        history, async cursors) shift too. Requires the lanes' host queues
+        to be drained (call between a full Ready/advance cycle). Returns the
+        delta applied; Ready output after this is the reference's, shifted
+        down by exactly the accumulated rebase offset."""
+        lanes = list(lanes)
+        w = self.shape.w
+        v = self.view
+        if delta is None:
+            delta = (min(int(v.snap_index[l]) for l in lanes) // w) * w
+        if delta <= 0:
+            return 0
+        if delta & (w - 1):
+            raise ValueError("rebase delta must be a multiple of the window")
+        for lane in lanes:
+            if (
+                self._msgs[lane]
+                or self._after_append[lane]
+                or self._steps_on_advance[lane]
+                or self._read_states[lane]
+            ):
+                raise RuntimeError(
+                    f"lane {lane} has queued messages; rebase requires a "
+                    "drained Ready/advance cycle"
+                )
+        from raft_tpu.ops import log as lg
+
+        # collect live window payloads before the shift (store-agnostic:
+        # works for both the Python dict store and the C++ arena)
+        kept: dict[int, list] = {}
+        for lane in lanes:
+            rows = []
+            lt = v.log_term[lane]
+            lty = v.log_type[lane]
+            for i in range(int(v.snap_index[lane]) + 1, int(v.last[lane]) + 1):
+                term = int(lt[i & (w - 1)])
+                etype, data = self.store.get(lane, i, term)
+                rows.append((i, term, int(lty[i & (w - 1)]), data))
+            kept[lane] = rows
+
+        mask = jnp.zeros((self.shape.n,), bool)
+        dl = jnp.zeros((self.shape.n,), I32)
+        for lane in lanes:
+            mask = mask.at[lane].set(True)
+            dl = dl.at[lane].set(delta)
+        self.state = jax.jit(lg.rebase_indexes)(self.state, mask, dl)
+        self.view.refresh(self.state)
+        for lane in lanes:
+            # payload store re-key: clear, re-put shifted
+            self.store.compact_below(lane, (1 << 31) - 1)
+            for i, term, etype, data in kept[lane]:
+                self.store.put(lane, Entry(term, i - delta, etype, data))
+            snap = self.store.snapshot(lane)
+            if snap is not None:
+                snap.index -= delta
+            hs = self._prev_hs[lane]
+            self._prev_hs[lane] = HardState(
+                hs.term, hs.vote, max(hs.commit - delta, 0)
+            )
+            self._inprog[lane] = max(self._inprog[lane] - delta, 0)
+            self._applying[lane] = max(self._applying[lane] - delta, 0)
+        return delta
+
     def set_snapshot_unavailable(self, lane: int, on: bool = True):
         """Storage.Snapshot() deferral (reference: storage.go:36-38
         ErrSnapshotTemporarilyUnavailable): while on, the leader's MsgSnap

@@ -284,6 +284,68 @@ def find_conflict_by_term(state: RaftState, index, term):
     return best, t
 
 
+def rebase_indexes(state: RaftState, mask, delta) -> RaftState:
+    """Host-driven index re-keying — the recovery path for the i32 device
+    index space (the reference's indexes are uint64, raftpb/raft.proto:21-26;
+    here ERR_INDEX_NEAR_OVERFLOW fires at 2^30 and the host rebases).
+
+    Subtracts `delta` [N] from every index-valued field of masked lanes.
+    delta MUST be a multiple of the window size so circular slot positions
+    (idx & (W-1)) are invariant — no log data moves. Sentinel-zero fields
+    (pending/avail snapshot, pending conf index, live read slots) shift only
+    where set; pr_match/pr_next clamp at their floors. Clears the overflow
+    flag. The caller owns shifting its host-side mirrors by the same delta
+    (payload store keys, HardState history — see RawNodeBatch.rebase_group).
+    """
+    w = state.log_term.shape[-1]
+    d = jnp.where(mask, delta, 0)
+    dv = d[:, None]
+
+    def sub(x, floor=0):
+        return jnp.maximum(x - d, floor)
+
+    def sub_nv(x, floor=0):
+        return jnp.maximum(x - dv, floor)
+
+    def sub_if(x, live, dd):
+        return jnp.where(live, jnp.maximum(x - dd, 0), x)
+
+    state = dataclasses.replace(
+        state,
+        last=sub(state.last),
+        stabled=sub(state.stabled),
+        committed=sub(state.committed),
+        applying=sub(state.applying),
+        applied=sub(state.applied),
+        snap_index=sub(state.snap_index),
+        pending_snap_index=sub_if(
+            state.pending_snap_index, state.pending_snap_index > 0, d
+        ),
+        avail_snap_index=sub_if(
+            state.avail_snap_index, state.avail_snap_index > 0, d
+        ),
+        pending_conf_index=sub_if(
+            state.pending_conf_index, state.pending_conf_index > 0, d
+        ),
+        pr_match=sub_nv(state.pr_match),
+        pr_next=sub_nv(state.pr_next, 1),
+        pr_pending_snapshot=sub_if(
+            state.pr_pending_snapshot, state.pr_pending_snapshot > 0, dv
+        ),
+        infl_index=sub_if(state.infl_index, state.infl_index > 0, dv[..., None]),
+        ro_index=sub_if(state.ro_index, state.ro_ctx != 0, dv),
+        rs_index=sub_if(state.rs_index, state.rs_ctx != 0, dv),
+        error_bits=jnp.where(
+            mask,
+            state.error_bits & ~jnp.int32(ERR_INDEX_NEAR_OVERFLOW),
+            state.error_bits,
+        ),
+    )
+    # delta must have been a multiple of W; flag misuse loudly
+    state = _err(state, mask & ((delta & (w - 1)) != 0), ERR_COMMIT_OUT_OF_RANGE)
+    return state
+
+
 def compact(state: RaftState, to_index, to_term) -> RaftState:
     """Host-driven compaction: move the snapshot point forward, freeing window
     slots (reference storage.go:251-272 Compact + CreateSnapshot). Caller must

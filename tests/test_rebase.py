@@ -1,0 +1,112 @@
+"""Index-overflow recovery: host-side re-keying of the i32 device index
+space (reference indexes are uint64, raftpb/raft.proto:21-26; the device
+flags ERR_INDEX_NEAR_OVERFLOW at 2^30 — ops/log.py — and
+`RawNodeBatch.rebase_group` shifts the group back down after
+snapshot+compact)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops import log as lg
+from tests.test_rawnode import drive, make_group
+
+I32 = jnp.int32
+
+
+def age_group(b, base: int):
+    """Simulate a long-lived group: shift every index up by `base` (a
+    multiple of W), as if `base` entries had been committed and compacted
+    away over the group's lifetime."""
+    n = b.shape.n
+    mask = jnp.ones((n,), bool)
+    neg = jnp.full((n,), -base, I32)
+    b.state = jax.jit(lg.rebase_indexes)(b.state, mask, neg)
+    # the negative delta trips no floors on a fresh group (all cursors 0/1)
+    b.state = dataclasses.replace(b.state, error_bits=jnp.zeros((n,), I32))
+    b.view.refresh(b.state)
+
+
+def test_group_crosses_overflow_margin_and_rebases():
+    w = 16
+    base = (1 << 30) - 4 * w  # a few windows below the margin
+    b = make_group(3, shape_kw=dict(log_window=w))
+    age_group(b, base)
+    b.campaign(0)
+    drive(b)
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+    assert int(b.view.committed[0]) == base + 1  # empty entry of the term
+
+    # commit entries across the 2^30 margin (compacting as an app would so
+    # the window never fills): the device flags loudly instead of silently
+    # wrapping
+    for i in range(5 * w):
+        b.propose(0, b"d%d" % i)
+        drive(b)
+        for lane in range(3):
+            applied = int(b.view.applied[lane])
+            if applied - int(b.view.snap_index[lane]) > w // 2:
+                b.compact(lane, applied)
+        if np.asarray(b.state.error_bits[0]) & lg.ERR_INDEX_NEAR_OVERFLOW:
+            break
+    assert int(b.view.last[0]) >= lg.INDEX_OVERFLOW_MARGIN
+    assert all(
+        int(np.asarray(b.state.error_bits[l])) & lg.ERR_INDEX_NEAR_OVERFLOW
+        for l in range(3)
+    )
+    commit_abs = b.basic_status(0)["commit"]
+
+    # app compaction up to applied, then host re-keying of all members
+    for lane in range(3):
+        b.compact(lane, int(b.view.applied[lane]), data=b"ck")
+    delta = b.rebase_group([0, 1, 2])
+    assert delta > 0 and delta % w == 0
+    # flag cleared, cursors shifted exactly
+    assert not np.asarray(b.state.error_bits).any()
+    assert b.basic_status(0)["commit"] == commit_abs - delta
+
+    # the group keeps serving: propose -> commit -> apply with payloads
+    committed = []
+    b.propose(0, b"after-rebase")
+    n_iter = 0
+    while n_iter < 50:
+        n_iter += 1
+        moved = False
+        for lane in range(3):
+            if not b.has_ready(lane):
+                continue
+            rd = b.ready(lane)
+            if lane == 0:
+                committed.extend(rd.committed_entries)
+            msgs = rd.messages
+            b.advance(lane)
+            for m in msgs:
+                b.step(m.to - 1, m)
+            moved = True
+        if not moved:
+            break
+    assert [e.data for e in committed] == [b"after-rebase"]
+    # Ready indexes are the reference's shifted down by exactly delta
+    assert committed[0].index == commit_abs - delta + 1
+    assert b.basic_status(1)["commit"] == commit_abs - delta + 1
+    assert not np.asarray(b.state.error_bits).any()
+
+
+def test_rebase_requires_drained_queues():
+    b = make_group(3, shape_kw=dict(log_window=16))
+    b.campaign(0)
+    drive(b)
+    b.propose(0, b"x")  # leaves messages queued until ready()
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        b.rebase_group([0, 1, 2], delta=16)
+
+
+def test_rebase_noop_when_nothing_compacted():
+    b = make_group(3, shape_kw=dict(log_window=16))
+    b.campaign(0)
+    drive(b)
+    assert b.rebase_group([0, 1, 2]) == 0  # snap_index < W -> no-op
