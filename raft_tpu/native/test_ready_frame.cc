@@ -1,0 +1,210 @@
+// Byte-for-byte mirror of the Go wrapper's Ready-frame parser
+// (go/multiraft_xla.go parseReady), used to execute the parse against real
+// frames emitted by runtime/embed.py's _pack_ready — the cross-language
+// contract test for the Ready wire format (reference parity target: what
+// rawnode.go:141-200 Ready must carry). Messages inside the frame decode
+// through the same raftpb codec the Go side's pb.Message.Unmarshal
+// implements (raftpb_codec.cc msg_unmarshal, golden-tested byte-exact in
+// tests/test_codec.py).
+//
+// Usage: test_ready_frame <frame-file>
+//   stdout: canonical dump (one line per element, compared verbatim by
+//           tests/test_go_frame_parse.py)
+//   exit 2 + "ERROR truncated" on a malformed frame (same condition the Go
+//   parser errors on).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" int64_t msg_unmarshal(
+    const uint8_t* in, int64_t len, uint64_t* scalars, uint8_t* context,
+    int64_t context_cap, int64_t* context_len, int32_t* n_entries,
+    int32_t max_entries, uint64_t* ent_scalars, int64_t* ent_data_lens,
+    uint8_t* ent_data, int64_t ent_data_cap, uint64_t* snap_meta,
+    uint8_t* snap_data, int64_t snap_data_cap, int64_t* snap_data_len,
+    int32_t* snap_counts, uint64_t* snap_ids, int32_t max_snap_ids,
+    int32_t* n_responses, int32_t max_responses, uint64_t* resp_scalars);
+
+namespace {
+
+std::vector<uint8_t> g;
+size_t pos = 0;
+
+[[noreturn]] void truncated() {
+  printf("ERROR truncated\n");
+  exit(2);
+}
+
+uint32_t u32() {
+  if (pos + 4 > g.size()) truncated();
+  uint32_t v;
+  std::memcpy(&v, g.data() + pos, 4);
+  pos += 4;
+  return v;  // little-endian host assumed (same as Go binary.LittleEndian)
+}
+
+uint64_t u64() {
+  if (pos + 8 > g.size()) truncated();
+  uint64_t v;
+  std::memcpy(&v, g.data() + pos, 8);
+  pos += 8;
+  return v;
+}
+
+uint8_t u8() {
+  if (pos + 1 > g.size()) truncated();
+  return g[pos++];
+}
+
+std::string hex(const uint8_t* p, int64_t n) {
+  if (n <= 0) return "-";
+  std::string s;
+  char b[3];
+  for (int64_t i = 0; i < n; i++) {
+    snprintf(b, sizeof b, "%02x", p[i]);
+    s += b;
+  }
+  return s;
+}
+
+void dump_message(const uint8_t* p, int64_t len) {
+  uint64_t sc[11];
+  uint8_t ctx[4096];
+  int64_t ctx_len;
+  int32_t n_ents;
+  uint64_t ent_sc[3 * 64];
+  int64_t ent_lens[64];
+  uint8_t ent_data[1 << 16];
+  uint64_t snap_meta[3] = {0, 0, 0};
+  uint8_t snap_data[1 << 16];
+  int64_t snap_len;
+  int32_t snap_counts[4];
+  uint64_t snap_ids[64];
+  int32_t n_resp;
+  uint64_t resp_sc[11 * 16];
+  int64_t rc = msg_unmarshal(p, len, sc, ctx, sizeof ctx, &ctx_len, &n_ents,
+                             64, ent_sc, ent_lens, ent_data, sizeof ent_data,
+                             snap_meta, snap_data, sizeof snap_data, &snap_len,
+                             snap_counts, snap_ids, 64, &n_resp, 16, resp_sc);
+  if (rc != 0) {
+    printf("ERROR unmarshal %lld\n", (long long)rc);
+    exit(3);
+  }
+  printf("msg type=%llu to=%llu from=%llu term=%llu logterm=%llu index=%llu "
+         "commit=%llu reject=%llu hint=%llu vote=%llu ctx=%s nents=%d "
+         "nresp=%d\n",
+         (unsigned long long)sc[0], (unsigned long long)sc[1],
+         (unsigned long long)sc[2], (unsigned long long)sc[3],
+         (unsigned long long)sc[4], (unsigned long long)sc[5],
+         (unsigned long long)sc[6], (unsigned long long)sc[7],
+         (unsigned long long)sc[8], (unsigned long long)sc[9],
+         hex(ctx, ctx_len).c_str(), n_ents, n_resp);
+  const uint8_t* dp = ent_data;
+  for (int32_t i = 0; i < n_ents; i++) {
+    int64_t dl = ent_lens[i];
+    printf(" ment %llu %llu %llu %s\n", (unsigned long long)ent_sc[i * 3],
+           (unsigned long long)ent_sc[i * 3 + 1],
+           (unsigned long long)ent_sc[i * 3 + 2], hex(dp, dl).c_str());
+    if (dl > 0) dp += dl;
+  }
+  if (sc[10]) {
+    printf(" msnap %llu %llu %s voters", (unsigned long long)snap_meta[0],
+           (unsigned long long)snap_meta[1],
+           hex(snap_data, snap_len).c_str());
+    for (int32_t i = 0; i < snap_counts[0]; i++)
+      printf(" %llu", (unsigned long long)snap_ids[i]);
+    printf("\n");
+  }
+  for (int32_t r = 0; r < n_resp; r++) {
+    const uint64_t* rs = resp_sc + r * 11;
+    printf(" mresp type=%llu to=%llu from=%llu term=%llu index=%llu "
+           "commit=%llu reject=%llu vote=%llu\n",
+           (unsigned long long)rs[0], (unsigned long long)rs[1],
+           (unsigned long long)rs[2], (unsigned long long)rs[3],
+           (unsigned long long)rs[5], (unsigned long long)rs[6],
+           (unsigned long long)rs[7], (unsigned long long)rs[9]);
+  }
+}
+
+void dump_entries(const char* label) {
+  uint32_t n = u32();
+  printf("%s %u\n", label, n);
+  for (uint32_t k = 0; k < n; k++) {
+    uint64_t term = u64();
+    uint64_t index = u64();
+    uint32_t type = u32();
+    uint32_t dlen = u32();
+    if (pos + dlen > g.size()) truncated();
+    printf("ent %llu %llu %u %s\n", (unsigned long long)term,
+           (unsigned long long)index, type, hex(g.data() + pos, dlen).c_str());
+    pos += dlen;
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s <frame-file>\n", argv[0]);
+    return 1;
+  }
+  FILE* f = fopen(argv[1], "rb");
+  if (!f) {
+    perror("open");
+    return 1;
+  }
+  uint8_t buf[1 << 16];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof buf, f)) > 0) g.insert(g.end(), buf, buf + n);
+  fclose(f);
+
+  // --- the exact parseReady sequence (go/multiraft_xla.go:196-370) ---
+  uint32_t n_msgs = u32();
+  printf("nmsgs %u\n", n_msgs);
+  for (uint32_t k = 0; k < n_msgs; k++) {
+    uint32_t l = u32();
+    if (pos + l > g.size()) truncated();
+    dump_message(g.data() + pos, l);
+    pos += l;
+  }
+  dump_entries("entries");
+  dump_entries("committed");
+  if (u8() == 1) {
+    uint64_t t = u64(), v = u64(), c = u64();
+    printf("hardstate %llu %llu %llu\n", (unsigned long long)t,
+           (unsigned long long)v, (unsigned long long)c);
+  } else {
+    printf("hardstate -\n");
+  }
+  printf("mustsync %u\n", u8());
+  if (u8() == 1) {
+    uint64_t lead = u64();
+    uint32_t st = u32();
+    printf("softstate %llu %u\n", (unsigned long long)lead, st);
+  } else {
+    printf("softstate -\n");
+  }
+  if (u8() == 1) {
+    uint64_t index = u64(), term = u64();
+    uint32_t dlen = u32();
+    if (pos + dlen > g.size()) truncated();
+    std::string d = hex(g.data() + pos, dlen);
+    pos += dlen;
+    uint32_t nv = u32();
+    printf("snapshot %llu %llu %s voters", (unsigned long long)index,
+           (unsigned long long)term, d.c_str());
+    for (uint32_t k = 0; k < nv; k++) printf(" %llu", (unsigned long long)u64());
+    printf("\n");
+  } else {
+    printf("snapshot -\n");
+  }
+  if (pos != g.size()) {
+    printf("ERROR trailing %zu bytes\n", g.size() - pos);
+    return 4;
+  }
+  printf("OK\n");
+  return 0;
+}

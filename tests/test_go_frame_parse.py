@@ -1,0 +1,190 @@
+"""Execute the Go wrapper's Ready-frame parser against real embed.py output.
+
+go/multiraft_xla.go:parseReady is a hand-rolled binary parser with no Go
+toolchain in-image to run it; native/test_ready_frame.cc mirrors its parse
+byte-for-byte (same field order, widths, truncation checks) and decodes the
+embedded raftpb messages through the same C codec Go's pb.Message.Unmarshal
+represents. This test fails if embed.py's _pack_ready layout and that parse
+ever disagree (reference parity target: what rawnode.go:141-200 Ready must
+carry)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(__file__), "..", "raft_tpu", "native")
+
+
+@pytest.fixture(scope="module")
+def parser_bin():
+    if shutil.which("g++") is None:
+        pytest.skip("native toolchain unavailable")
+    r = subprocess.run(
+        ["make", "-s", "test_ready_frame"],
+        cwd=NATIVE, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    return os.path.join(NATIVE, "test_ready_frame")
+
+
+def run_parser(parser_bin, frame: bytes, tmp_path, name):
+    p = tmp_path / name
+    p.write_bytes(frame)
+    return subprocess.run(
+        [parser_bin, str(p)], capture_output=True, text=True, timeout=60
+    )
+
+
+def _hex(data) -> str:
+    return data.hex() if data else "-"
+
+
+def _ctx_hex(ctx) -> str:
+    if isinstance(ctx, bytes):
+        return _hex(ctx)
+    ctx = int(ctx)
+    return ctx.to_bytes(8, "big").hex() if ctx else "-"
+
+
+def expected_dump(rd) -> str:
+    """The canonical dump test_ready_frame.cc prints, derived independently
+    from the host Ready object (cross-validating frame layout AND codec)."""
+    lines = [f"nmsgs {len(rd.messages)}"]
+    for m in rd.messages:
+        lines.append(
+            f"msg type={m.type} to={m.to} from={m.frm} term={m.term} "
+            f"logterm={m.log_term} index={m.index} commit={m.commit} "
+            f"reject={1 if m.reject else 0} hint={m.reject_hint} "
+            f"vote={m.vote} ctx={_ctx_hex(m.context)} "
+            f"nents={len(m.entries)} nresp={len(m.responses)}"
+        )
+        for e in m.entries:
+            lines.append(f" ment {e.type} {e.term} {e.index} {_hex(e.data)}")
+        if m.snapshot is not None:
+            v = " ".join(str(x) for x in m.snapshot.voters)
+            lines.append(
+                f" msnap {m.snapshot.index} {m.snapshot.term} "
+                f"{_hex(m.snapshot.data)} voters{' ' + v if v else ''}"
+            )
+        for r in m.responses:
+            lines.append(
+                f" mresp type={r.type} to={r.to} from={r.frm} term={r.term} "
+                f"index={r.index} commit={r.commit} "
+                f"reject={1 if r.reject else 0} vote={r.vote}"
+            )
+    for label, group in (
+        ("entries", rd.entries),
+        ("committed", rd.committed_entries),
+    ):
+        lines.append(f"{label} {len(group)}")
+        for e in group:
+            lines.append(f"ent {e.term} {e.index} {e.type} {_hex(e.data)}")
+    hs = rd.hard_state
+    lines.append(
+        f"hardstate {hs.term} {hs.vote} {hs.commit}" if hs else "hardstate -"
+    )
+    lines.append(f"mustsync {1 if rd.must_sync else 0}")
+    ss = rd.soft_state
+    lines.append(
+        f"softstate {ss.lead} {ss.raft_state}" if ss else "softstate -"
+    )
+    s = rd.snapshot
+    if s is not None and s.index:
+        v = " ".join(str(x) for x in s.voters)
+        lines.append(
+            f"snapshot {s.index} {s.term} {_hex(s.data)} "
+            f"voters{' ' + v if v else ''}".rstrip()
+        )
+    else:
+        lines.append("snapshot -")
+    lines.append("OK")
+    return "\n".join(lines) + "\n"
+
+
+def collect_corpus():
+    """Drive a 3-voter group through election, replication, linearizable
+    reads and a snapshot catch-up, framing every Ready."""
+    from raft_tpu.runtime import embed
+
+    h = embed.engine_new(3)
+    b = embed._engines[h]
+    frames = []  # (name, frame bytes, expected dump)
+
+    def take(lane, name):
+        rd = b.ready(lane)
+        frames.append((name, embed._pack_ready(rd), expected_dump(rd)))
+        return rd
+
+    def pump(collect_as=None, skip_to=()):
+        for _ in range(40):
+            moved = False
+            for lane in range(3):
+                if not b.has_ready(lane):
+                    continue
+                rd = take(lane, f"{collect_as or 'pump'}-l{lane}")
+                msgs = rd.messages
+                b.advance(lane)
+                for m in msgs:
+                    if m.to - 1 in skip_to:
+                        continue
+                    b.step(m.to - 1, m)
+                moved = True
+            if not moved:
+                return
+
+    b.campaign(0)
+    pump(collect_as="election")
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+    b.propose(0, b"payload-\x00\xff")
+    pump(collect_as="propose")
+    # linearizable read with a foreign bytes ctx (heartbeat ctx echo)
+    b.read_index(0, ctx=b"go-req-1")
+    pump(collect_as="readindex")
+    # partition lane 2, commit, compact -> snapshot Ready on the follower
+    for i in range(4):
+        b.propose(0, b"p%d" % i)
+        pump(collect_as="repl", skip_to={2})
+    b.compact(0, int(b.view.applied[0]), data=b"snap-bytes")
+    for _ in range(8):
+        b.tick(0)
+    pump(collect_as="snapshot")
+    assert b.basic_status(2)["commit"] == b.basic_status(0)["commit"]
+
+    # the empty Ready frame (unit-level edge case)
+    from raft_tpu.api.rawnode import Ready
+
+    frames.append(("empty", embed._pack_ready(Ready()), expected_dump(Ready())))
+    embed.engine_free(h)
+    return frames
+
+
+def test_parser_matches_embed_frames(parser_bin, tmp_path):
+    frames = collect_corpus()
+    # the corpus must exercise every frame section
+    all_expected = "".join(e for _, _, e in frames)
+    assert "ment" in all_expected  # message entries
+    assert "snapshot -" in all_expected
+    assert [e for _, _, e in frames if "\nsnapshot " in e and "voters" in e], (
+        "no follower snapshot Ready in corpus"
+    )
+    assert "ctx=" + b"go-req-1".hex() in all_expected  # foreign read ctx
+    assert " msnap " in all_expected  # MsgSnap carried in messages
+    for name, frame, expected in frames:
+        r = run_parser(parser_bin, frame, tmp_path, name)
+        assert r.returncode == 0, (name, r.stdout, r.stderr)
+        assert r.stdout == expected, (
+            f"{name}: parser dump diverges\n--- C ---\n{r.stdout}"
+            f"--- expected ---\n{expected}"
+        )
+
+
+def test_parser_rejects_truncation(parser_bin, tmp_path):
+    frames = collect_corpus()
+    # truncating any frame at any section boundary must error, not misparse
+    name, frame, _ = max(frames, key=lambda f: len(f[1]))
+    for cut in (len(frame) - 1, len(frame) // 2, 3, 0):
+        r = run_parser(parser_bin, frame[:cut], tmp_path, f"trunc{cut}")
+        assert r.returncode == 2, (cut, r.stdout)
+        assert "ERROR truncated" in r.stdout
