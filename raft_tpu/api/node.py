@@ -23,6 +23,21 @@ from raft_tpu.api.rawnode import Message, RawNodeBatch, Ready
 from raft_tpu.types import MessageType as MT
 
 
+class ErrStopped(Exception):
+    """The node host was stopped while an operation waited (reference:
+    node.go:36 ErrStopped, returned by every channel op racing `n.done`)."""
+
+
+class ErrCanceled(Exception):
+    """The caller's cancellation (or deadline) fired while the op waited —
+    the context-cancellation arm of the reference's blocking calls
+    (node.go:502-545 stepWaitOption select on ctx.Done()). Cancellation
+    observed BEFORE the loop picks the op up guarantees it is skipped;
+    cancellation racing the loop's execution may still see the op applied —
+    exactly the reference's semantics, where a proposal already handed to
+    the raft goroutine proceeds even as the caller returns ctx.Err()."""
+
+
 @dataclasses.dataclass
 class _Op:
     kind: str
@@ -31,6 +46,10 @@ class _Op:
     done: threading.Event | None = None
     result: object = None
     error: Exception | None = None
+    # cancellation (the ctx.Done() analog): checked by the loop immediately
+    # before execution; a canceled op is skipped, never half-applied
+    cancel: threading.Event | None = None
+    started: bool = False
 
 
 class NodeHost:
@@ -78,6 +97,14 @@ class NodeHost:
 
     def _handle(self, op: _Op):
         b = self.batch
+        if op.cancel is not None and op.cancel.is_set():
+            # reference: the select never picks the channel send once
+            # ctx.Done() fired — the message is not stepped at all
+            op.error = ErrCanceled()
+            if op.done is not None:
+                op.done.set()
+            return
+        op.started = True
         try:
             if op.kind == "tick":
                 b.tick(op.lane)
@@ -115,15 +142,44 @@ class NodeHost:
             if op.done is not None:
                 op.done.set()
 
-    def _submit(self, kind, lane, payload=None, wait=False):
-        op = _Op(kind, lane, payload, threading.Event() if wait else None)
+    def _submit(
+        self, kind, lane, payload=None, wait=False, timeout=None, cancel=None
+    ):
+        """wait=True blocks like the reference's stepWait (node.go:502-545):
+        `timeout` (seconds) is the ctx-deadline analog, `cancel` (a
+        threading.Event) the ctx-cancellation analog. Ops whose
+        cancellation fires before the loop reaches them are never applied."""
+        # a deadline needs its own cancel event so the op is skipped (not
+        # executed late) once the caller has given up on it
+        if wait and timeout is not None and cancel is None:
+            cancel = threading.Event()
+        op = _Op(
+            kind, lane, payload,
+            threading.Event() if wait else None,
+            cancel=cancel if wait else None,
+        )
         self._ops.put(op)
         if wait:
-            # no timeout: first XLA compiles can take minutes; the loop
-            # thread always sets done (or the host is stopped)
-            while not op.done.wait(timeout=1.0):
+            # default: no deadline — first XLA compiles can take minutes;
+            # the loop thread always sets done (or the host is stopped)
+            import time as _time
+
+            deadline = None if timeout is None else _time.monotonic() + timeout
+            while not op.done.wait(timeout=0.05):
                 if self._stop.is_set():
-                    raise RuntimeError("node host stopped")
+                    raise ErrStopped()
+                if cancel is not None and cancel.is_set():
+                    if op.started:
+                        # the loop is already executing it (the reference's
+                        # ctx race: the proposal proceeds); keep waiting
+                        continue
+                    # not started: the loop is guaranteed to skip it
+                    raise ErrCanceled()
+                if deadline is not None and _time.monotonic() > deadline:
+                    cancel.set()  # the loop must not execute it late
+                    if op.started:
+                        continue  # already executing: let it finish
+                    raise TimeoutError(f"{kind} timed out after {timeout}s")
             if op.error is not None:
                 raise op.error
             return op.result
@@ -143,16 +199,48 @@ class Node:
     def campaign(self):
         self.host._submit("campaign", self.lane)
 
-    def propose(self, data: bytes, wait: bool = False):
-        self.host._submit("propose", self.lane, data, wait=wait)
+    def propose(
+        self,
+        data: bytes,
+        wait: bool = True,
+        timeout: float | None = None,
+        cancel: threading.Event | None = None,
+    ):
+        """Blocking like the reference (node.go:469 Propose -> stepWait):
+        returns once the proposal was stepped (raising ErrProposalDropped if
+        refused), or raises TimeoutError / ErrCanceled / ErrStopped on the
+        ctx-equivalent arms (node.go:502-545)."""
+        self.host._submit(
+            "propose", self.lane, data, wait=wait, timeout=timeout, cancel=cancel
+        )
 
-    def propose_conf_change(self, data: bytes, v2: bool = False, wait: bool = False):
-        self.host._submit("propose_cc", self.lane, (data, v2), wait=wait)
+    def propose_conf_change(
+        self,
+        data: bytes,
+        v2: bool = False,
+        wait: bool = True,
+        timeout: float | None = None,
+        cancel: threading.Event | None = None,
+    ):
+        self.host._submit(
+            "propose_cc", self.lane, (data, v2),
+            wait=wait, timeout=timeout, cancel=cancel,
+        )
 
-    def step(self, msg: Message):
+    def step(
+        self,
+        msg: Message,
+        wait: bool = False,
+        timeout: float | None = None,
+        cancel: threading.Event | None = None,
+    ):
+        """Non-blocking for network messages (reference node.Step); pass
+        wait=True for the stepWait contract on local proposals."""
         if msg.type in (int(MT.MSG_HUP), int(MT.MSG_BEAT)):
             raise ValueError("cannot step raft local message")
-        self.host._submit("step", self.lane, msg)
+        self.host._submit(
+            "step", self.lane, msg, wait=wait, timeout=timeout, cancel=cancel
+        )
 
     def ready(self, timeout: float | None = None) -> Ready:
         """Blocking receive, like `<-n.Ready()` (reference: node.go:547)."""

@@ -65,8 +65,23 @@ def run_cluster(n_nodes, drop_prob, n_proposals, deadline_s=300.0):
         time.sleep(0.05)
     assert leader is not None, "no leader elected under lossy network"
 
+    from raft_tpu.api.rawnode import ErrProposalDropped
+
     for k in range(n_proposals):
-        nodes[leader].propose(b"prop-%d" % k)
+        # ErrProposalDropped is retryable by contract (raft.go:28-32) —
+        # leadership may move mid-run under the lossy network
+        while True:
+            try:
+                nodes[leader].propose(b"prop-%d" % k)
+                break
+            except ErrProposalDropped:
+                time.sleep(0.05)
+                sts = [nodes[i].status() for i in range(n_nodes)]
+                ls = [
+                    i for i, s in enumerate(sts) if s["raft_state"] == "LEADER"
+                ]
+                if ls:
+                    leader = ls[-1]
         time.sleep(0.01)
 
     target = n_proposals  # at least the proposals (plus empty entries)
@@ -118,3 +133,81 @@ def test_sync_network_partition_reelection():
         b.tick(2)
         net.send([])
     assert b.basic_status(0)["raft_state"] == "FOLLOWER"
+
+
+# -- blocking-call edges (reference: node.go:36 ErrStopped, 502-545 the
+# ctx.Done()/deadline select arms of stepWait) ------------------------------
+
+
+def test_blocking_propose_surfaces_dropped():
+    """Propose blocks until stepped; a follower with no known leader drops
+    the proposal and the blocking caller sees ErrProposalDropped (reference:
+    node.go:469 + raft.go:1267 DisableProposalForwarding-free path)."""
+    from raft_tpu.api.rawnode import ErrProposalDropped
+
+    b = make_group(3)
+    host = NodeHost(b)
+    try:
+        with pytest.raises(ErrProposalDropped):
+            host.node(0).propose(b"no-leader-yet")
+    finally:
+        host.stop()
+
+
+def test_propose_canceled_before_processing_never_applies():
+    """A cancellation that fires before the loop reaches the op skips it
+    entirely — the reference's select never sends on propc once ctx.Done()
+    fired (node.go:502-545)."""
+    from raft_tpu.api.node import ErrCanceled
+
+    b = make_group(1)
+    host = NodeHost(b)
+    try:
+        nd = host.node(0)
+        nd.campaign()
+        # settle: drain Readys until the term's empty entry is appended and
+        # no more work is pending (status() is a loop barrier)
+        for _ in range(10):
+            try:
+                nd.ready(timeout=0.5)
+                nd.advance()
+            except Exception:
+                pass
+            nd.status()
+            if int(b.view.last[0]) >= 1 and not nd.has_ready():
+                break
+        canceled = threading.Event()
+        canceled.set()
+        last0 = int(b.view.last[0])
+        with pytest.raises(ErrCanceled):
+            nd.propose(b"never", cancel=canceled)
+        # drain any in-flight loop work, then confirm nothing was appended
+        nd.status()
+        assert int(b.view.last[0]) == last0
+    finally:
+        host.stop()
+
+
+def test_blocking_call_after_stop_raises():
+    from raft_tpu.api.node import ErrStopped
+
+    b = make_group(1)
+    host = NodeHost(b)
+    host.stop()
+    with pytest.raises(ErrStopped):
+        host.node(0).propose(b"x")
+
+
+def test_propose_timeout():
+    """The deadline arm: a zero timeout expires before the (busy) loop can
+    process the op."""
+    b = make_group(1)
+    host = NodeHost(b)
+    try:
+        # saturate the loop with ticks so the propose sits queued
+        for _ in range(50):
+            host.node(0).tick()
+        with pytest.raises(TimeoutError):
+            host.node(0).propose(b"x", timeout=0.0)
+    finally:
+        host.stop()
