@@ -111,15 +111,22 @@ def run_serial(n_groups, n_voters, n_iters, block):
 def main():
     platform = jax.devices()[0].platform
     engine = os.environ.get("BENCH_ENGINE", "fused")
+    # 65k groups measured as the single-chip throughput peak (round-3
+    # scaling ladder, BASELINE.md): 1.77M groups*ticks/s vs 1.49M at 16k
     n_groups = int(
-        os.environ.get("BENCH_GROUPS", 16384 if platform == "tpu" else 512)
+        os.environ.get("BENCH_GROUPS", 65536 if platform == "tpu" else 512)
     )
     n_iters = int(os.environ.get("BENCH_ITERS", 10))
     block = int(os.environ.get("BENCH_BLOCK", 32))
     n_voters = int(os.environ.get("BENCH_VOTERS", 3))
 
     runner = run_fused if engine == "fused" else run_serial
-    dt, compile_s, n_leaders, commits = runner(n_groups, n_voters, n_iters, block)
+    from raft_tpu.utils.profiling import env_trace_dir, trace
+
+    with trace(env_trace_dir()):
+        dt, compile_s, n_leaders, commits = runner(
+            n_groups, n_voters, n_iters, block
+        )
 
     groups_ticks_per_sec = n_groups * n_iters * block / dt
     target = 1_000_000.0

@@ -36,32 +36,59 @@ def _emit(name, value, unit, extra):
 
 
 def config1_single_group_proposals(n_proposals=1000):
-    """Propose->commit->apply latency on ONE 3-voter group: the analog of
-    BenchmarkProposal3Nodes (a proposal commits in one fused round; the
-    measurement is rounds/sec on a single resident group)."""
+    """Committed proposals/sec on ONE 3-voter group — the analog of
+    BenchmarkProposal3Nodes (rafttest/node_bench_test.go:25).
+
+    Two client models, both reported:
+      - serial client: one outstanding proposal (1/round) — the pure
+        propose->commit latency bound;
+      - pipelined client: E outstanding proposals per round (the reference
+        under load carries several entries per Ready/MsgApp, and its bench
+        loop keeps proposals continuously queued) — the throughput figure.
+    The whole run is device-resident via the multi-round scan
+    (cluster-of-1 on the fused engine, blocks of 100 rounds/dispatch)."""
+    import os
+
+    from raft_tpu.config import Shape
     from raft_tpu.ops.fused import FusedCluster
 
-    c = FusedCluster(1, 3, seed=2)
+    e = int(os.environ.get("BENCH1_ENTRIES", 8))
+    shape = Shape(
+        n_lanes=3, max_peers=3, log_window=64, max_msg_entries=e,
+        max_inflight=2,
+    )
+    c = FusedCluster(1, 3, seed=2, shape=shape)
     c.run(40)
-    assert len(c.leader_lanes()) == 1
+    leaders = c.leader_lanes()
+    assert len(leaders) == 1
+    lead = int(leaders[0])
     blocks, block = 10, 100
-    c.run(block, auto_propose=True, auto_compact_lag=8)  # warm the exact program
-    com0 = int(np.asarray(c.state.committed)[0])
-    t0 = time.perf_counter()
-    for _ in range(blocks):
-        c.run(block, auto_propose=True, auto_compact_lag=8)
-    jax.block_until_ready(c.state.term)
-    dt = time.perf_counter() - t0
-    commits = int(np.asarray(c.state.committed)[0]) - com0
+    res = {}
+    for label, prop_n in (("serial", 1), ("pipelined", e)):
+        ops = c.ops(prop_n={lead: prop_n})
+        c.run(
+            block, ops=ops, ops_first_round_only=False, auto_compact_lag=32
+        )  # warm the exact program
+        com0 = int(np.asarray(c.state.committed)[0])
+        t0 = time.perf_counter()
+        for _ in range(blocks):
+            c.run(
+                block, ops=ops, ops_first_round_only=False, auto_compact_lag=32
+            )
+        jax.block_until_ready(c.state.term)
+        dt = time.perf_counter() - t0
+        commits = int(np.asarray(c.state.committed)[0]) - com0
+        res[label] = (commits / dt, 1e6 * dt / (blocks * block), commits)
     c.check_no_errors()
     _emit(
         "1_single_group_1k_proposals",
-        commits / dt,
+        res["pipelined"][0],
         "proposals_committed/s",
         {
-            "proposals": commits,
-            "round_us": round(1e6 * dt / (blocks * block), 1),
-            "note": "one resident group; latency-bound, not throughput",
+            "serial_client_proposals_per_s": round(res["serial"][0], 1),
+            "outstanding": e,
+            "round_us": round(res["pipelined"][1], 1),
+            "note": "one resident group, device-resident multi-round scan",
         },
     )
 

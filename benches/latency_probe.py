@@ -1,0 +1,101 @@
+"""Commit-index latency probe — the second half of the BASELINE.json
+metric ("groups x ticks/sec; commit-index latency @1M groups").
+
+Measures, at a given resident group count:
+  - in-fabric commit latency: rounds from proposal injection until every
+    group's commit index covers it (the fused engine's propose->commit
+    pipeline: append in round t, quorum-ack + commit in t+1), converted to
+    wall time at the measured round rate;
+  - client-visible latency: wall time of the same thing driven as one
+    dispatch per round (what a host-side proposer would observe through
+    the dispatch path, including tunnel latency on this rig).
+
+Prints one JSON line per shape.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def measure(n_groups, n_voters, w=8, e=1):
+    from raft_tpu.config import Shape
+    from raft_tpu.ops.fused import FusedCluster
+
+    shape = Shape(
+        n_lanes=n_groups * n_voters,
+        max_peers=n_voters,
+        log_window=w,
+        max_msg_entries=e,
+        max_inflight=1,
+        max_read_index=2,
+    )
+    c = FusedCluster(n_groups, n_voters, seed=13, shape=shape)
+    lag = w // 2
+    block = 16
+    c.run(block, auto_propose=True, auto_compact_lag=lag)
+    jax.block_until_ready(c.state.term)
+    warm = 0
+    while len(c.leader_lanes()) < n_groups and warm < 40 * block:
+        c.run(block, auto_propose=True, auto_compact_lag=lag)
+        warm += block
+    # warm every program variant the timed region uses (each distinct
+    # (n_rounds, do_tick, auto_propose) tuple is its own XLA program)
+    c.run(block, auto_compact_lag=lag)
+    c.run(1, do_tick=False, auto_compact_lag=lag)
+    jax.block_until_ready(c.state.term)
+
+    # steady-state round rate (for the in-fabric conversion)
+    t0 = time.perf_counter()
+    c.run(block, auto_compact_lag=lag)
+    jax.block_until_ready(c.state.term)
+    round_s = (time.perf_counter() - t0) / block
+
+    # inject ONE proposal at every leader; count rounds to full commit
+    com0 = np.asarray(c.state.committed).copy()
+    leaders = c.leader_lanes()
+    prop = {int(l): 1 for l in leaders}
+    t0 = time.perf_counter()
+    c.run(1, ops=c.ops(prop_n=prop), do_tick=False, auto_compact_lag=lag)
+    rounds = 1
+    while True:
+        com = np.asarray(c.state.committed)
+        if (com[leaders] > com0[leaders]).all():
+            break
+        if rounds > 16:
+            raise RuntimeError("proposal did not commit")
+        c.run(1, do_tick=False, auto_compact_lag=lag)
+        rounds += 1
+    client_s = time.perf_counter() - t0
+    c.check_no_errors()
+    print(
+        json.dumps(
+            {
+                "groups": n_groups,
+                "voters": n_voters,
+                "commit_rounds": rounds,
+                "round_ms": round(1000 * round_s, 3),
+                "in_fabric_commit_ms": round(1000 * round_s * rounds, 3),
+                "client_visible_commit_ms": round(1000 * client_s, 3),
+            }
+        ),
+        flush=True,
+    )
+    del c
+
+
+if __name__ == "__main__":
+    voters = int(os.environ.get("LAT_VOTERS", 3))
+    for g in [
+        int(x)
+        for x in os.environ.get("LAT_GROUPS", "16384,262144").split(",")
+    ]:
+        measure(g, voters)

@@ -19,12 +19,15 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
     from raft_tpu.config import Shape
     from raft_tpu.ops.fused import FusedCluster
 
+    f = int(os.environ.get("PROBE_INFLIGHT", min(8, e)))
+    r = int(os.environ.get("PROBE_READS", 4))
     shape = Shape(
         n_lanes=n_groups * n_voters,
         max_peers=n_voters,
         log_window=w,
         max_msg_entries=e,
-        max_inflight=min(8, e),
+        max_inflight=f,
+        max_read_index=r,
     )
     c = FusedCluster(n_groups, n_voters, seed=42, shape=shape)
     lag = min(8, w // 2)
@@ -44,16 +47,28 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
         best = min(best, time.perf_counter() - t0)
     lanes = n_groups * n_voters
     round_ms = 1000 * best / block
+    mem = {}
+    try:
+        ms = jax.local_devices()[0].memory_stats() or {}
+        mem = {
+            "hbm_in_use_gb": round(ms.get("bytes_in_use", 0) / 2**30, 2),
+            "hbm_peak_gb": round(ms.get("peak_bytes_in_use", 0) / 2**30, 2),
+        }
+    except Exception:
+        pass
     print(
         json.dumps(
             {
                 "groups": n_groups,
                 "voters": n_voters,
                 "lanes": lanes,
+                "w": w,
+                "e": e,
                 "round_ms": round(round_ms, 3),
                 "groups_ticks_per_s": round(n_groups * block / best, 1),
-                "ns_per_lane_round": round(1e6 * best / block / lanes, 2),
+                "us_per_lane_round": round(1e6 * best / block / lanes, 2),
                 "compile_s": round(compile_s, 1),
+                **mem,
             }
         ),
         flush=True,
@@ -63,8 +78,11 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
 
 if __name__ == "__main__":
     voters = int(os.environ.get("PROBE_VOTERS", 3))
+    w = int(os.environ.get("PROBE_WINDOW", 16))
+    e = int(os.environ.get("PROBE_ENTRIES", 2))
+    block = int(os.environ.get("PROBE_BLOCK", 32))
     shapes = os.environ.get(
         "PROBE_GROUPS", "4096,16384,65536,131072,262144"
     )
     for g in [int(x) for x in shapes.split(",")]:
-        measure(g, voters)
+        measure(g, voters, block=block, w=w, e=e)

@@ -1,0 +1,84 @@
+"""Tracing/profiling hooks (SURVEY §5: the reference ships none — "add its
+own (jax.profiler traces), no parity required").
+
+Two layers:
+  - `trace(dir)` — capture an XLA/TPU profile of a code region into a
+    TensorBoard-loadable directory (jax.profiler.trace), with named
+    sub-regions via `annotate`.
+  - `StepStats` — cheap host-side counters for the serving path (the analog
+    of the reference's MemoryStorage.callStats, storage.go:92-94, which
+    feeds BenchmarkRawNode's storage-access metrics, rawnode_test.go:1244).
+
+Env integration: benchmarks honor RAFT_TPU_TRACE=<dir> (see bench.py) so
+the driver can turn any run into a profile without code changes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None = None):
+    """Profile the enclosed region. No-op when log_dir is None/empty, so
+    call sites can pass os.environ.get("RAFT_TPU_TRACE") unconditionally."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named sub-region inside a trace (shows as a TraceAnnotation row)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepStats:
+    """Host-side op counters + wall timings for the RawNode serving path.
+
+    Attach with `RawNodeBatch.trace_stats = StepStats()`? No — counting
+    happens at the call sites the app owns; this is a plain bag:
+
+        stats = StepStats()
+        with stats.timed("step"):
+            batch.step(lane, msg)
+        print(stats.as_dict())
+    """
+
+    def __init__(self):
+        self.counts: dict[str, int] = {}
+        self.seconds: dict[str, float] = {}
+
+    def bump(self, key: str, n: int = 1):
+        self.counts[key] = self.counts.get(key, 0) + n
+
+    @contextlib.contextmanager
+    def timed(self, key: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[key] = self.seconds.get(key, 0.0) + (
+                time.perf_counter() - t0
+            )
+            self.bump(key)
+
+    def as_dict(self) -> dict:
+        return {
+            k: {
+                "count": self.counts.get(k, 0),
+                "seconds": round(self.seconds.get(k, 0.0), 6),
+            }
+            for k in sorted(set(self.counts) | set(self.seconds))
+        }
+
+
+def env_trace_dir() -> str | None:
+    return os.environ.get("RAFT_TPU_TRACE") or None

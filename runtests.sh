@@ -5,6 +5,21 @@
 # (single) remote TPU on every interpreter start; unsetting
 # PALLAS_AXON_POOL_IPS disables the hook so CPU-only test runs don't
 # serialize on the chip claim.
-exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+#
+# tests/test_sharded.py runs in its OWN pytest process: XLA:CPU segfaults
+# compiling its largest 8-device shard_map programs when hundreds of other
+# programs were compiled earlier in the same process (reproduced at the
+# same spot in two full-suite runs; the file passes standalone). Process
+# isolation sidesteps the backend bug without losing coverage.
+
+run() {
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
-    python -m pytest "${@:-tests/}" -x -q
+    python -m pytest "$@" -x -q
+}
+
+if [ $# -gt 0 ]; then
+  run "$@"
+else
+  run tests/ --ignore=tests/test_sharded.py && run tests/test_sharded.py
+fi

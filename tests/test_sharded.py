@@ -9,6 +9,18 @@ from raft_tpu.cluster import Cluster
 from raft_tpu.parallel.sharded import ShardedCluster
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _no_persistent_cache():
+    """XLA's CPU executable serializer aborts the process on this module's
+    largest shard_map programs (fatal abort inside
+    compilation_cache.put_executable_and_time); skip persisting them — the
+    correctness runs don't need cross-run caching."""
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", old)
+
+
 @pytest.fixture(scope="module")
 def devices():
     d = jax.devices()
