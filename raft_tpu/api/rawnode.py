@@ -239,6 +239,31 @@ _MSG_SCALARS = (
 )
 
 
+@lru_cache(maxsize=1)
+def _scan_fn():
+    """Module-level jit of the step_many multi-column scan: shared across
+    RawNodeBatch instances (per-instance jit wrappers recompile the same
+    program — see _compiled_kernels)."""
+    from raft_tpu.cluster import scan_step
+
+    return jax.jit(scan_step)
+
+
+@lru_cache(maxsize=8)
+def _zero_inbox_template(n: int, m: int, e: int):
+    """Host-side zeroed MsgBatch columns (dtypes from the device spec,
+    fetched once): the scratch buffers _flush_batch copies from."""
+    base = empty_batch((n, m), e)
+    return {
+        f.name: np.asarray(getattr(base, f.name))
+        for f in dataclasses.fields(base)
+    }
+
+
+def _zero_inbox_cols(n: int, m: int, e: int) -> dict:
+    return {k: v.copy() for k, v in _zero_inbox_template(n, m, e).items()}
+
+
 def _msg_to_row(msg: Message, e: int) -> dict:
     row = {b: getattr(msg, h) for h, b in _MSG_SCALARS}
     if msg.type == int(MT.MSG_PROP) and any(
@@ -658,6 +683,90 @@ class RawNodeBatch:
                 self._write_tracker(lane, cfg, trk)
                 self.set_app_snapshot(lane, snap)
                 self.store.compact_below(lane, snap.index + 1)
+
+    # -- batched stepping (the serving-path fast lane) ---------------------
+
+    _BATCH_M = 4  # inbox columns per device dispatch
+
+    def _batchable(self, lane: int, msg: Message) -> bool:
+        """Messages steppable in a shared multi-column dispatch: the fan-in
+        hot path (acks, votes, heartbeats, ReadIndex traffic — the
+        reference's raft.go:1333-1526 hot loop). Excluded and stepped
+        per-message: anything carrying entries/snapshots (payload-store and
+        ErrProposalDropped bookkeeping are per-message), async-storage
+        lanes (in-progress cursor rewind), and traced lanes (the
+        conformance log oracle observes single steps)."""
+        return (
+            self.trace is None
+            and not self._async[lane]
+            and not msg.entries
+            and msg.snapshot is None
+            and msg.type
+            not in (int(MT.MSG_PROP), int(MT.MSG_SNAP), int(MT.MSG_HUP), int(MT.MSG_BEAT))
+        )
+
+    def step_many(self, steps, on_drop=None):
+        """Step (lane, message) pairs in submission order with at most one
+        device dispatch per _BATCH_M batchable messages, instead of one per
+        message (the host-device round-trip amortization VERDICT r2 #4 asks
+        of the serving path). Non-batchable messages flush the current
+        batch (order preserved) and take the per-message path;
+        ErrProposalDropped from those goes to on_drop(lane, msg) when given,
+        else propagates."""
+        pending: list[tuple[int, Message]] = []
+        per_lane: dict[int, int] = {}
+
+        def flush():
+            if pending:
+                self._flush_batch(pending)
+                pending.clear()
+                per_lane.clear()
+
+        for lane, msg in steps:
+            if self._batchable(lane, msg):
+                if per_lane.get(lane, 0) >= self._BATCH_M:
+                    flush()
+                if isinstance(msg.context, bytes):
+                    msg = dataclasses.replace(
+                        msg, context=self._ctx_ticket(lane, msg.context)
+                    )
+                pending.append((lane, msg))
+                per_lane[lane] = per_lane.get(lane, 0) + 1
+            else:
+                flush()
+                try:
+                    self.step(lane, msg)
+                except ErrProposalDropped:
+                    if on_drop is None:
+                        raise
+                    on_drop(lane, msg)
+        flush()
+
+    def _flush_batch(self, pending):
+        n, e, m_cols = self.shape.n, self.shape.max_msg_entries, self._BATCH_M
+        cols = _zero_inbox_cols(n, m_cols, e)
+        fill = [0] * n
+        acks: list[tuple[int, int]] = []
+        for lane, msg in pending:
+            row = _msg_to_row(msg, e)
+            s = fill[lane]
+            fill[lane] += 1
+            for name, val in row.items():
+                cols[name][lane, s] = np.asarray(val)
+            if (
+                msg.type == int(MT.MSG_APP_RESP)
+                and not msg.reject
+                and msg.frm != self.id_of(lane)
+                and (lane, msg.frm) not in acks
+            ):
+                acks.append((lane, msg.frm))
+        inbox = MsgBatch(**{k: jnp.asarray(v) for k, v in cols.items()})
+        self.state, out_all = _scan_fn()(self.state, inbox)
+        self.view.refresh(self.state)
+        self._collect_out(out_all)
+        # post-ack drain loop per acking peer (reference: raft.go:1515-1518)
+        for lane, frm in acks:
+            self._drain(lane, frm)
 
     def campaign(self, lane: int):
         self._run_step(lane, Message(type=int(MT.MSG_HUP), to=self.id_of(lane)))

@@ -67,6 +67,10 @@ class HostBridge:
                 codec = _codec
 
         log = get_logger()
+        # group per destination host, preserving per-host order: each host
+        # steps its whole batch with amortized device dispatches
+        # (RawNodeBatch.step_many — the fan-in hot path shares dispatches)
+        per_host: dict[int, list] = {}
         for m in msgs:
             tgt = self._route.get(m.to)
             if tgt is None:
@@ -79,12 +83,15 @@ class HostBridge:
             h, lane = tgt
             if codec is not None:
                 m = codec.unmarshal_message(codec.marshal_message(m))
-            try:
-                self._hosts[h].step(lane, m)
-            except ErrProposalDropped:
-                self.dropped += 1
-                continue
+            per_host.setdefault(h, []).append((lane, m))
             self.delivered += 1
+
+        def on_drop(lane, msg):
+            self.dropped += 1
+            self.delivered -= 1
+
+        for h, batch in per_host.items():
+            self._hosts[h].step_many(batch, on_drop=on_drop)
 
     def pump(self, max_iters: int = 100, on_commit=None) -> int:
         """Drain every host's Ready output and deliver until quiescent (the

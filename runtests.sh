@@ -18,8 +18,8 @@ run() {
     python -m pytest "$@" -x -q
 }
 
-if [ $# -gt 0 ]; then
-  run "$@"
-else
+if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
   run tests/ --ignore=tests/test_sharded.py && run tests/test_sharded.py
+else
+  run "$@"
 fi
