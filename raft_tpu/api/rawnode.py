@@ -702,7 +702,16 @@ class RawNodeBatch:
             and not msg.entries
             and msg.snapshot is None
             and msg.type
-            not in (int(MT.MSG_PROP), int(MT.MSG_SNAP), int(MT.MSG_HUP), int(MT.MSG_BEAT))
+            not in (
+                int(MT.MSG_PROP),
+                int(MT.MSG_SNAP),
+                # local types take the per-message path so step() surfaces
+                # its ValueError contract (rawnode.go:108-125)
+                int(MT.MSG_HUP),
+                int(MT.MSG_BEAT),
+                int(MT.MSG_STORAGE_APPEND),
+                int(MT.MSG_STORAGE_APPLY),
+            )
         )
 
     def step_many(self, steps, on_drop=None):

@@ -1271,11 +1271,17 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     tl = tl & ~from_learner
     same = tl & (state.lead_transferee == msg.frm)
     to_self = tl & (msg.frm == state.id)
+    # a request for a DIFFERENT transferee aborts the pending transfer
+    # first (raft.go:1596-1604); when the new target is self it stops
+    # there — abort only, no new transfer (raft.go:1610-1613)
+    abort_only = to_self & ~same & (state.lead_transferee != 0)
     tl_go = tl & ~same & ~to_self
     state = dataclasses.replace(
         state,
         election_elapsed=_w(tl_go, 0, state.election_elapsed),
-        lead_transferee=_w(tl_go, msg.frm, state.lead_transferee),
+        lead_transferee=_w(
+            tl_go, msg.frm, _w(abort_only, 0, state.lead_transferee)
+        ),
     )
     ready_now = tl_go & (at_from(state.pr_match) == state.last)
     out.put_peers(

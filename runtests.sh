@@ -6,11 +6,12 @@
 # PALLAS_AXON_POOL_IPS disables the hook so CPU-only test runs don't
 # serialize on the chip claim.
 #
-# tests/test_sharded.py runs in its OWN pytest process: XLA:CPU segfaults
-# compiling its largest 8-device shard_map programs when hundreds of other
-# programs were compiled earlier in the same process (reproduced at the
-# same spot in two full-suite runs; the file passes standalone). Process
-# isolation sidesteps the backend bug without losing coverage.
+# The full suite runs as THREE pytest processes: XLA:CPU reproducibly
+# segfaults/aborts on a fresh compile once a few hundred programs were
+# compiled earlier in the same process (observed in test_sharded's big
+# 8-device programs and, after the corpus grew, mid test_scenarios; every
+# chunk passes standalone). Chunking keeps per-process compile counts well
+# under the crash threshold without losing coverage.
 
 run() {
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -19,7 +20,10 @@ run() {
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
-  run tests/ --ignore=tests/test_sharded.py && run tests/test_sharded.py
+  # (--ignore does not apply to explicitly listed files, so filter the glob)
+  run tests/test_[a-q]*.py \
+    && run $(ls tests/test_[r-z]*.py | grep -v test_sharded) \
+    && run tests/test_sharded.py
 else
   run "$@"
 fi

@@ -1,0 +1,219 @@
+"""Conf-change interaction scenarios — ports of the reference's
+raft_test.go conf-change gating/commit tests (raft.go:1259-1301 proposal
+gating, 1888-1970 applyConfChange/switchToConfig).
+
+| reference test (raft_test.go)            | here |
+|------------------------------------------|------|
+| TestStepConfig (:4337)                   | test_step_config |
+| TestStepIgnoreConfig (:4356)             | test_step_ignore_config |
+| TestNewLeaderPendingConfig (:4386)       | test_new_leader_pending_config |
+| TestAddNode (:3043)                      | test_add_node |
+| TestAddNodeCheckQuorum (:3081)           | test_add_node_check_quorum |
+| TestRemoveNode (:3124)                   | test_remove_node |
+| TestCommitAfterRemoveNode (:3578)        | test_commit_after_remove_node |
+| TestCampaignWhileLeader (:3546)          | test_campaign_while_leader |
+| TestPreCampaignWhileLeader (:3550)       | test_pre_campaign_while_leader |
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from raft_tpu import confchange as ccm
+from raft_tpu.api.rawnode import Entry, Message
+from raft_tpu.types import EntryType, MessageType as MT
+
+from tests.test_paper import make_batch, set_lane
+from tests.test_scenarios import state_name, term_of
+
+ET = 10
+
+
+def lonely_leader(n_cfg=2):
+    """A leader whose peers never answer (newTestRaft withPeers(1, 2) +
+    becomeCandidate/becomeLeader): single hosted lane, election completed
+    by a crafted vote grant."""
+    b = make_batch(n_cfg)
+    b.campaign(0)
+    b.ready(0)
+    b.advance(0)
+    if n_cfg > 1:
+        b.step(
+            0,
+            Message(
+                type=int(MT.MSG_VOTE_RESP), frm=2, to=1, term=term_of(b, 1)
+            ),
+        )
+    while b.has_ready(0):
+        b.ready(0)
+        b.advance(0)
+    assert state_name(b, 1) == "LEADER"
+    return b
+
+
+def pci(b):
+    return int(np.asarray(b.state.pending_conf_index[0]))
+
+
+def test_step_config():
+    b = lonely_leader()
+    index = int(b.view.last[0])
+    b.propose_conf_change(0, b"", v2=False)
+    assert int(b.view.last[0]) == index + 1
+    assert pci(b) == index + 1
+
+
+def test_step_ignore_config():
+    """A second conf-change proposal while one is uncommitted becomes an
+    empty NORMAL entry; pendingConfIndex stays."""
+    b = lonely_leader()
+    b.propose_conf_change(0, b"", v2=False)
+    index = int(b.view.last[0])
+    pending = pci(b)
+    b.propose_conf_change(0, b"", v2=False)
+    w = b.shape.w
+    assert int(b.view.last[0]) == index + 1
+    assert int(b.view.log_type[0, (index + 1) & (w - 1)]) == int(
+        EntryType.ENTRY_NORMAL
+    )
+    assert pci(b) == pending
+
+
+def test_new_leader_pending_config():
+    """becomeLeader seeds pendingConfIndex from the pre-election last index
+    (raft.go:918-923)."""
+    for add_entry, want in ((False, 0), (True, 1)):
+        b = make_batch(2)
+        if add_entry:
+            from tests.test_paper import set_log
+
+            set_log(b, 0, [1], committed=0)
+            set_lane(b, 0, term=1)
+        b.campaign(0)
+        b.ready(0)
+        b.advance(0)
+        b.step(
+            0,
+            Message(
+                type=int(MT.MSG_VOTE_RESP), frm=2, to=1, term=term_of(b, 1)
+            ),
+        )
+        assert state_name(b, 1) == "LEADER"
+        assert pci(b) == want, (add_entry, pci(b))
+
+
+def test_add_node():
+    b = make_batch(1)
+    b.apply_conf_change(
+        0, ccm.ConfChange(type=int(ccm.ConfChangeType.ADD_NODE), node_id=2)
+    )
+    assert b.peer_ids(0, voters=True) == (1, 2)
+
+
+def test_add_node_check_quorum():
+    """Adding a node resets the CheckQuorum clock's base: one tick after
+    the add must not depose the leader; a full election timeout without
+    hearing from the new node must."""
+    b = make_batch(1, check_quorum=True)
+    b.campaign(0)
+    while b.has_ready(0):
+        b.ready(0)
+        b.advance(0)
+    assert state_name(b, 1) == "LEADER"
+    for _ in range(ET - 1):
+        b.tick(0)
+    b.apply_conf_change(
+        0, ccm.ConfChange(type=int(ccm.ConfChangeType.ADD_NODE), node_id=2)
+    )
+    b.tick(0)  # reaches electionTimeout -> quorum check
+    assert state_name(b, 1) == "LEADER"
+    for _ in range(ET):
+        b.tick(0)
+    assert state_name(b, 1) == "FOLLOWER"
+
+
+def test_remove_node():
+    b = make_batch(2)
+    b.apply_conf_change(
+        0, ccm.ConfChange(type=int(ccm.ConfChangeType.REMOVE_NODE), node_id=2)
+    )
+    assert b.peer_ids(0, voters=True) == (1,)
+    # removing the last voter is the reference's panic -> our error
+    with pytest.raises(ccm.ConfChangeError):
+        b.apply_conf_change(
+            0,
+            ccm.ConfChange(type=int(ccm.ConfChangeType.REMOVE_NODE), node_id=1),
+        )
+
+
+def test_commit_after_remove_node():
+    """A pending proposal commits once an applied conf change shrinks the
+    quorum (raft_test.go:3578-3640)."""
+    b = lonely_leader()
+    cc = ccm.ConfChange(type=int(ccm.ConfChangeType.REMOVE_NODE), node_id=2)
+    b.propose_conf_change(0, ccm.encode(cc), v2=False)
+    cc_index = int(b.view.last[0])
+    # nothing commits yet (peer 2 is silent)
+    rd = b.ready(0)
+    b.advance(0)
+    assert rd.committed_entries == []
+
+    # a normal proposal queues behind the pending change
+    b.propose(0, b"hello")
+
+    # node 2 acks the conf-change entry: everything through it commits
+    b.step(
+        0,
+        Message(
+            type=int(MT.MSG_APP_RESP),
+            frm=2,
+            to=1,
+            term=term_of(b, 1),
+            index=cc_index,
+        ),
+    )
+    committed = []
+    while b.has_ready(0):
+        rd = b.ready(0)
+        committed.extend(rd.committed_entries)
+        b.advance(0)
+    assert [e.type for e in committed] == [
+        int(EntryType.ENTRY_NORMAL),
+        int(EntryType.ENTRY_CONF_CHANGE),
+    ]
+    assert committed[0].data == b""
+
+    # applying the change drops node 2: quorum = {1}, "hello" commits
+    b.apply_conf_change(0, cc)
+    committed = []
+    while b.has_ready(0):
+        rd = b.ready(0)
+        committed.extend(rd.committed_entries)
+        b.advance(0)
+    assert [e.data for e in committed] == [b"hello"], committed
+
+
+def _campaign_while_leader(pre_vote):
+    b = make_batch(1, pre_vote=pre_vote)
+    assert state_name(b, 1) == "FOLLOWER"
+    b.campaign(0)
+    while b.has_ready(0):
+        b.ready(0)
+        b.advance(0)
+    assert state_name(b, 1) == "LEADER"
+    term = term_of(b, 1)
+    b.campaign(0)
+    while b.has_ready(0):
+        b.ready(0)
+        b.advance(0)
+    assert state_name(b, 1) == "LEADER"
+    assert term_of(b, 1) == term
+
+
+def test_campaign_while_leader():
+    _campaign_while_leader(False)
+
+
+def test_pre_campaign_while_leader():
+    _campaign_while_leader(True)

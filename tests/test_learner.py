@@ -1,0 +1,219 @@
+"""Learner suite — ports of the reference's raft_test.go learner scenarios
+(non-voting members: tracker/tracker.go:27-78 Learners, raft.go:947-954
+promotable gating, raft.go:733-743 learner replication).
+
+| reference test (raft_test.go)       | here |
+|-------------------------------------|------|
+| TestLearnerElectionTimeout (:611)   | test_learner_election_timeout |
+| TestLearnerPromotion (:632)         | test_learner_promotion |
+| TestLearnerCanVote (:691)           | test_learner_can_vote |
+| TestLearnerLogReplication (:721)    | test_learner_log_replication |
+| TestLearnerCampaign (:3447)         | test_learner_campaign |
+| TestLearnerReceiveSnapshot (:3270)  | test_learner_receive_snapshot |
+| TestReadOnlyWithLearner (:2200)     | test_read_only_with_learner |
+| TestAddLearner (:3043)              | test_add_learner |
+| TestRemoveLearner (:3103)           | test_remove_learner |
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from raft_tpu import confchange as ccm
+from raft_tpu.api.rawnode import Message, RawNodeBatch
+from raft_tpu.config import Shape
+from raft_tpu.types import MessageType as MT
+
+from tests.test_paper import set_lane
+from tests.test_scenarios import commit_of, hup, net_of, prop, raw, state_name
+
+ET = 10
+
+
+def learner_pair() -> RawNodeBatch:
+    """Two nodes: 1 voter, 2 learner (newTestLearnerRaft withPeers(1),
+    withLearners(2))."""
+    peers = np.zeros((2, 8), np.int32)
+    peers[:, :2] = [1, 2]
+    is_learner = np.zeros((2, 8), bool)
+    is_learner[:, 1] = True
+    return RawNodeBatch(
+        Shape(n_lanes=2), ids=[1, 2], peers=peers, learners=is_learner
+    )
+
+
+def test_learner_election_timeout():
+    """A learner never starts an election, even past its timeout."""
+    b = learner_pair()
+    set_lane(b, 1, randomized_election_timeout=ET)
+    for _ in range(ET):
+        b.tick(1)
+    assert state_name(b, 2) == "FOLLOWER"
+
+
+def test_learner_promotion():
+    """A learner cannot campaign until promoted to voter; afterwards it
+    can win an election."""
+    b = learner_pair()
+    net = net_of(b)
+    hup(net, 1)
+    assert state_name(b, 1) == "LEADER"
+    assert state_name(b, 2) == "FOLLOWER"
+
+    for lane in range(2):
+        b.apply_conf_change(
+            lane,
+            ccm.ConfChange(type=int(ccm.ConfChangeType.ADD_NODE), node_id=2),
+        )
+    net.send([])
+    assert not bool(b.view.is_learner[1])
+
+    hup(net, 2)
+    assert state_name(b, 2) == "LEADER"
+    assert state_name(b, 1) == "FOLLOWER"
+
+
+def test_learner_can_vote():
+    """A learner acks vote requests (it may hold the deciding log entry
+    after a joint change)."""
+    b = learner_pair()
+    raw_votes = []
+    b.step(
+        1,
+        Message(
+            type=int(MT.MSG_VOTE), frm=1, to=2, term=2, log_term=11, index=11
+        ),
+    )
+    rd = b.ready(1)
+    b.advance(1)
+    resps = [m for m in rd.messages if m.type == int(MT.MSG_VOTE_RESP)]
+    assert len(resps) == 1 and not resps[0].reject, rd.messages
+
+
+def test_learner_log_replication():
+    """The leader replicates to and commits with learner acks tracked,
+    though the learner never counts toward the quorum."""
+    b = learner_pair()
+    net = net_of(b)
+    hup(net, 1)
+    prop(net, 1)
+    assert commit_of(b, 1) == 2
+    assert commit_of(b, 2) == commit_of(b, 1)
+    assert int(b.view.pr_match[0, 1]) == commit_of(b, 2)
+
+
+def test_learner_campaign():
+    """MsgHup at a learner is refused; a stray MsgTimeoutNow (racing a
+    demotion) is ignored too (raft_test.go:3447-3477)."""
+    b = learner_pair()
+    net = net_of(b)
+    hup(net, 2)
+    assert state_name(b, 2) == "FOLLOWER"
+    hup(net, 1)
+    assert state_name(b, 1) == "LEADER"
+    raw(net, Message(type=int(MT.MSG_TIMEOUT_NOW), frm=1, to=2))
+    assert state_name(b, 2) == "FOLLOWER"
+
+
+def test_learner_receive_snapshot():
+    """A learner catches up from the leader's snapshot."""
+    b = learner_pair()
+    net = net_of(b)
+    hup(net, 1)
+    # build state on the leader only, then compact it away
+    net.isolate(2)
+    for k in range(3):
+        prop(net, 1, b"s%d" % k)
+    b.compact(0, int(b.view.applied[0]), data=b"learner-snap")
+    net.recover()
+    for _ in range(2):
+        b.tick(0)
+        net.send([])
+    assert commit_of(b, 2) == commit_of(b, 1)
+    assert int(b.view.snap_index[1]) == int(b.view.applied[0])
+    snap = b.store.snapshot(1)
+    assert snap is not None and snap.data == b"learner-snap"
+
+
+def test_read_only_with_learner():
+    """ReadIndex serves at the leader AND via a learner's forwarded
+    request (read_only quorum excludes the learner)."""
+    b = learner_pair()
+    net = net_of(b)
+    hup(net, 1)
+
+    reads = {}
+
+    def pump_reads():
+        for _ in range(30):
+            moved = False
+            for lane in range(2):
+                if not b.has_ready(lane):
+                    continue
+                rd = b.ready(lane)
+                for rs in rd.read_states:
+                    reads.setdefault(lane, []).append(rs)
+                msgs = rd.messages
+                b.advance(lane)
+                for m in msgs:
+                    if 1 <= m.to <= 2:
+                        b.step(m.to - 1, m)
+                moved = True
+            if not moved:
+                return
+
+    expect = []
+    for i, lane in enumerate((0, 1, 0, 1)):
+        for _ in range(10):
+            prop(net, 1)
+        ctx = 100 + i
+        b.read_index(lane, ctx=ctx)
+        pump_reads()
+        expect.append((lane, ctx, commit_of(b, 1)))
+    for lane, ctx, commit in expect:
+        got = [r for r in reads.get(lane, []) if r.request_ctx == ctx]
+        assert len(got) == 1, (lane, ctx, reads)
+        assert got[0].index == commit, (got[0], commit)
+
+
+def test_add_learner():
+    """applyConfChange AddLearnerNode tracks the new node as a learner
+    (raft_test.go:3043)."""
+    from tests.test_paper import make_batch
+
+    b = make_batch(1)
+    b.apply_conf_change(
+        0,
+        ccm.ConfChange(
+            type=int(ccm.ConfChangeType.ADD_LEARNER_NODE), node_id=2
+        ),
+    )
+    st = b.status(0)
+    assert st["config"]["learners"] == (2,)
+    assert 2 not in st["config"]["voters"]
+
+
+def test_remove_learner():
+    """Removing the learner leaves a single-voter config; removing the
+    last voter is rejected (confchange invariant)."""
+    from tests.test_paper import make_batch
+
+    b = make_batch(1)
+    b.apply_conf_change(
+        0,
+        ccm.ConfChange(
+            type=int(ccm.ConfChangeType.ADD_LEARNER_NODE), node_id=2
+        ),
+    )
+    b.apply_conf_change(
+        0, ccm.ConfChange(type=int(ccm.ConfChangeType.REMOVE_NODE), node_id=2)
+    )
+    st = b.status(0)
+    assert st["config"]["learners"] == ()
+    assert st["config"]["voters"] == (1,)
+    with pytest.raises(ccm.ConfChangeError):
+        b.apply_conf_change(
+            0,
+            ccm.ConfChange(type=int(ccm.ConfChangeType.REMOVE_NODE), node_id=1),
+        )

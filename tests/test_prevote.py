@@ -1,0 +1,196 @@
+"""PreVote / disruption-avoidance suite — ports of the reference's
+raft_test.go PreVote scenarios (raft.go:226-229 PreVote config,
+1069-1076 pre-vote term handling, 1057-1066 in-lease rejection).
+
+| reference test (raft_test.go)                       | here |
+|-----------------------------------------------------|------|
+| TestDisruptiveFollower (:2966)                      | test_disruptive_follower |
+| TestDisruptiveFollowerPreVote (:3295)               | test_disruptive_follower_prevote |
+| TestPreVoteWithSplitVote (:3358)                    | test_prevote_with_split_vote |
+| TestPreVoteWithCheckQuorum (:2138)                  | test_prevote_with_check_quorum |
+| TestPreVoteMigrationCanCompleteElection (:3487)     | test_prevote_migration_completes_election |
+| TestPreVoteMigrationWithFreeStuckPreCandidate (:3524) | test_prevote_migration_frees_stuck_precandidate |
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from raft_tpu.api.rawnode import Message
+from raft_tpu.types import MessageType as MT
+
+from tests.test_paper import make_batch, set_lane
+from tests.test_scenarios import hup, net_of, prop, raw, state_name, term_of
+
+ET = 10
+
+
+def set_cfg(b, lane, **fields):
+    """Flip per-lane LaneConfig knobs mid-test (the reference pokes
+    r.preVote/r.checkQuorum directly)."""
+    cfg = b.state.cfg
+    upd = {k: getattr(cfg, k).at[lane].set(v) for k, v in fields.items()}
+    b.state = dataclasses.replace(b.state, cfg=dataclasses.replace(cfg, **upd))
+    b.view.refresh(b.state)
+
+
+def test_disruptive_follower():
+    """A follower whose election clock fires while the leader is healthy
+    campaigns at a higher term; under CheckQuorum the leader steps down
+    only via the term ladder, not the disruption itself."""
+    b = make_batch(3, check_quorum=True)
+    net = net_of(b)
+    for lane in range(3):
+        set_lane(b, lane, term=1)
+    hup(net, 1)
+    assert [state_name(b, i) for i in (1, 2, 3)] == [
+        "LEADER", "FOLLOWER", "FOLLOWER",
+    ]
+
+    set_lane(b, 2, randomized_election_timeout=ET + 2)
+    for _ in range(ET + 1):
+        b.tick(2)
+    # final tick fires the campaign (messages not yet delivered)
+    b.tick(2)
+    assert state_name(b, 1) == "LEADER"
+    assert state_name(b, 2) == "FOLLOWER"
+    assert state_name(b, 3) == "CANDIDATE"
+    # n3 is at term 3, n1 at term 2
+    assert term_of(b, 3) == term_of(b, 1) + 1
+
+    # deliver the stale-term heartbeat: leader gets a higher-term
+    # MsgAppResp back and steps down (raft_test.go:3030-3046)
+    raw(
+        net,
+        Message(
+            type=int(MT.MSG_HEARTBEAT), frm=1, to=3, term=term_of(b, 1)
+        ),
+    )
+    assert state_name(b, 1) == "FOLLOWER"
+    assert term_of(b, 1) == term_of(b, 3)
+
+
+def test_disruptive_follower_prevote():
+    """With PreVote on, the lagging rejoiner stays a pre-candidate and the
+    leader is undisturbed (raft_test.go:3295-3356)."""
+    b = make_batch(3, check_quorum=True)
+    net = net_of(b)
+    for lane in range(3):
+        set_lane(b, lane, term=1)
+    hup(net, 1)
+    net.isolate(3)
+    for _ in range(3):
+        prop(net, 1)
+    for lane in range(3):
+        set_cfg(b, lane, pre_vote=True)
+    net.recover()
+    hup(net, 3)
+    assert state_name(b, 1) == "LEADER"
+    assert state_name(b, 2) == "FOLLOWER"
+    assert state_name(b, 3) == "PRE_CANDIDATE"
+    assert term_of(b, 1) == 2 and term_of(b, 2) == 2 and term_of(b, 3) == 2
+
+
+def test_prevote_with_split_vote():
+    """Split pre-vote: the term rises once per real election, not per
+    retry (raft_test.go:3358-3445)."""
+    b = make_batch(3, pre_vote=True)
+    net = net_of(b)
+    for lane in range(3):
+        set_lane(b, lane, term=1)
+    hup(net, 1)
+    net.isolate(1)
+    # both followers campaign simultaneously: pre-votes granted (leader
+    # gone, logs equal), real election splits
+    b.campaign(1)
+    b.campaign(2)
+    net.send([])
+    assert term_of(b, 2) == 3 and term_of(b, 3) == 3
+    assert state_name(b, 2) == "CANDIDATE"
+    assert state_name(b, 3) == "CANDIDATE"
+
+    # node 2 times out first and wins
+    hup(net, 2)
+    assert term_of(b, 2) == 4 and term_of(b, 3) == 4
+    assert state_name(b, 2) == "LEADER"
+    assert state_name(b, 3) == "FOLLOWER"
+
+
+def test_prevote_with_check_quorum():
+    """Followers that recently heard a leader reject pre-votes (in-lease,
+    raft.go:1057-1066): the isolated ex-leader cannot be deposed by a
+    single disconnected peer, and a quorum CAN still elect."""
+    b = make_batch(3, pre_vote=True, check_quorum=True)
+    net = net_of(b)
+    for lane in range(3):
+        set_lane(b, lane, term=1)
+    hup(net, 1)
+    net.isolate(1)
+    # n2, n3 still in n1's lease window: advance n2's clock past timeout
+    # so it may campaign; n3 grants (it also lost the leader... after its
+    # own election elapsed passes)
+    for lane in (1, 2):
+        set_lane(b, lane, election_elapsed=ET + 1)
+    hup(net, 2)
+    assert state_name(b, 2) == "LEADER", state_name(b, 2)
+    assert state_name(b, 3) == "FOLLOWER"
+
+
+def migration_cluster():
+    """newPreVoteMigrationCluster (raft_test.go:3447-3485): n1 leader term
+    2 (PreVote on), n2 follower term 2 (PreVote on), n3 isolated
+    no-PreVote candidate at term 4 with less log."""
+    b = make_batch(3)
+    net = net_of(b)
+    for lane in range(3):
+        set_lane(b, lane, term=1)
+    set_cfg(b, 0, pre_vote=True)
+    set_cfg(b, 1, pre_vote=True)
+    hup(net, 1)
+    net.isolate(3)
+    prop(net, 1)
+    hup(net, 3)
+    hup(net, 3)
+    assert [state_name(b, i) for i in (1, 2, 3)] == [
+        "LEADER", "FOLLOWER", "CANDIDATE",
+    ]
+    assert (term_of(b, 1), term_of(b, 2), term_of(b, 3)) == (2, 2, 4)
+    # rolling upgrade reaches n3
+    set_cfg(b, 2, pre_vote=True)
+    return b, net
+
+
+def test_prevote_migration_completes_election():
+    b, net = migration_cluster()
+    net.recover()
+    net.isolate(1)
+    hup(net, 3)  # higher term but shorter log: pre-vote rejected
+    hup(net, 2)
+    assert state_name(b, 2) == "FOLLOWER"
+    assert state_name(b, 3) == "PRE_CANDIDATE"
+    # retrying eventually elects within the quorum
+    hup(net, 3)
+    hup(net, 2)
+    assert state_name(b, 2) == "LEADER" or state_name(b, 3) == "FOLLOWER"
+
+
+def test_prevote_migration_frees_stuck_precandidate():
+    b, net = migration_cluster()
+    net.recover()
+    hup(net, 3)
+    assert [state_name(b, i) for i in (1, 2, 3)] == [
+        "LEADER", "FOLLOWER", "PRE_CANDIDATE",
+    ]
+    hup(net, 3)
+    assert state_name(b, 3) == "PRE_CANDIDATE"
+    # the leader contacts the stuck peer: its higher-term response frees it
+    # (the leader steps down to the higher term and the terms equalize)
+    raw(
+        net,
+        Message(type=int(MT.MSG_HEARTBEAT), frm=1, to=3, term=term_of(b, 1)),
+    )
+    assert state_name(b, 1) == "FOLLOWER"
+    assert term_of(b, 3) == term_of(b, 1)

@@ -1,0 +1,160 @@
+"""Snapshot-restore suite — ports of the reference's raft_test.go restore
+scenarios (raft.go:1799-1879 handleSnapshot/restore, including ConfState
+adoption via confchange.Restore).
+
+The reference drives `sm.restore(s)` white-box; here the same transitions
+run through the wire path — stepping a MsgSnap — which exercises
+raft.go:1777-1797 handleSnapshot on top.
+
+| reference test (raft_test.go)        | here |
+|--------------------------------------|------|
+| TestRestore (:3121)                  | test_restore |
+| TestRestoreWithLearner (:3160)       | test_restore_with_learner |
+| TestRestoreWithVotersOutgoing (:3206)| test_restore_with_voters_outgoing |
+| TestRestoreVoterToLearner (:3246)    | test_restore_voter_to_learner |
+| TestRestoreLearnerPromotion (:3268)  | test_restore_learner_promotion |
+| TestRestoreIgnoreSnapshot (:3290)    | test_restore_ignore_snapshot |
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.api.rawnode import Message, RawNodeBatch, Snapshot
+from raft_tpu.config import Shape
+from raft_tpu.types import MessageType as MT
+
+from tests.test_paper import make_batch, set_lane, set_log
+from tests.test_scenarios import commit_of, last_of, state_name
+
+SNAP_IDX, SNAP_TERM = 11, 11  # the reference's magic numbers
+ET = 10
+
+
+def make_node(ids, learner_ids=(), self_id=1):
+    """One lane (self_id) with the given initial membership."""
+    n = 1
+    peers = np.zeros((n, 8), np.int32)
+    peers[0, : len(ids)] = ids
+    learners = np.zeros((n, 8), bool)
+    for lid in learner_ids:
+        learners[0, ids.index(lid)] = True
+    return RawNodeBatch(
+        Shape(n_lanes=n), ids=[self_id], peers=peers, learners=learners
+    )
+
+
+def snap_msg(snap: Snapshot, to: int, frm: int = 99) -> Message:
+    return Message(
+        type=int(MT.MSG_SNAP), to=to, frm=frm, term=snap.term, snapshot=snap
+    )
+
+
+def drain(b, lane=0):
+    while b.has_ready(lane):
+        b.ready(lane)
+        b.advance(lane)
+
+
+def test_restore():
+    snap = Snapshot(
+        index=SNAP_IDX, term=SNAP_TERM, data=b"app", voters=(1, 2, 3)
+    )
+    b = make_node([1, 2])
+    b.step(0, snap_msg(snap, to=1, frm=2))
+    # no campaign while the snapshot is pending application
+    # (raft.go:1962-1966 promotable checks pendingSnapshot)
+    for _ in range(2 * ET):
+        b.tick(0)
+    assert state_name(b, 1) == "FOLLOWER"
+    drain(b)
+
+    assert last_of(b, 1) == SNAP_IDX
+    w = b.shape.w
+    assert int(b.view.snap_index[0]) == SNAP_IDX
+    assert commit_of(b, 1) == SNAP_IDX
+    assert b.peer_ids(0, voters=True) == (1, 2, 3)
+
+    # restoring the same snapshot again is a no-op (raft.go:1804-1815)
+    b.step(0, snap_msg(snap, to=1, frm=2))
+    drain(b)
+    assert last_of(b, 1) == SNAP_IDX and commit_of(b, 1) == SNAP_IDX
+    assert not np.asarray(b.state.error_bits).any()
+
+
+def test_restore_with_learner():
+    snap = Snapshot(
+        index=SNAP_IDX, term=SNAP_TERM, voters=(1, 2), learners=(3,)
+    )
+    b = make_node([1, 2, 3], learner_ids=(3,), self_id=3)
+    b.step(0, snap_msg(snap, to=3, frm=1))
+    drain(b)
+    assert last_of(b, 1) == SNAP_IDX  # single lane (hosts id 3)
+    assert b.peer_ids(0, voters=True) == (1, 2)
+    assert b.peer_ids(0, learners=True) == (3,)
+    assert bool(b.view.is_learner[0])
+
+
+def test_restore_with_voters_outgoing():
+    snap = Snapshot(
+        index=SNAP_IDX,
+        term=SNAP_TERM,
+        voters=(2, 3, 4),
+        voters_outgoing=(1, 2, 3),
+    )
+    b = make_node([1, 2])
+    b.step(0, snap_msg(snap, to=1, frm=2))
+    drain(b)
+    assert last_of(b, 1) == SNAP_IDX
+    st = b.status(0)
+    assert st["config"]["voters"] == (2, 3, 4)
+    assert st["config"]["voters_outgoing"] == (1, 2, 3)
+    # union of both halves is tracked (tracker.go joint config)
+    assert b.peer_ids(0) == (1, 2, 3, 4)
+
+
+def test_restore_voter_to_learner():
+    """A snapshot may compress remove+re-add-as-learner into one config
+    (raft_test.go:3246-3266)."""
+    snap = Snapshot(
+        index=SNAP_IDX, term=SNAP_TERM, voters=(1, 2), learners=(3,)
+    )
+    b = make_node([1, 2, 3], self_id=3)
+    assert not bool(b.view.is_learner[0])
+    b.step(0, snap_msg(snap, to=3, frm=1))
+    drain(b)
+    assert bool(b.view.is_learner[0])
+    assert b.peer_ids(0, learners=True) == (3,)
+
+
+def test_restore_learner_promotion():
+    snap = Snapshot(index=SNAP_IDX, term=SNAP_TERM, voters=(1, 2, 3))
+    b = make_node([1, 2, 3], learner_ids=(3,), self_id=3)
+    assert bool(b.view.is_learner[0])
+    b.step(0, snap_msg(snap, to=3, frm=1))
+    drain(b)
+    assert not bool(b.view.is_learner[0])
+    assert b.peer_ids(0, voters=True) == (1, 2, 3)
+
+
+def test_restore_ignore_snapshot():
+    """A snapshot at/behind the commit index is refused; at most the commit
+    index fast-forwards (raft.go:1804-1815)."""
+    b = make_node([1, 2])
+    set_lane(b, 0, term=1)
+    set_log(b, 0, [1, 1, 1], committed=1)
+    commit = 1
+
+    snap = Snapshot(index=commit, term=1, voters=(1, 2))
+    b.step(0, snap_msg(snap, to=1, frm=2))
+    drain(b)
+    assert commit_of(b, 1) == commit
+    assert last_of(b, 1) == 3  # log kept, not wiped
+
+    # fast-forward: snapshot index within our log advances commit only
+    snap2 = Snapshot(index=commit + 1, term=1, voters=(1, 2))
+    b.step(0, snap_msg(snap2, to=1, frm=2))
+    drain(b)
+    assert commit_of(b, 1) == commit + 1
+    assert last_of(b, 1) == 3
+    assert not np.asarray(b.state.error_bits).any()

@@ -1,0 +1,267 @@
+"""Leadership-transfer suite — ports of the reference's raft_test.go
+transfer scenarios (raft.go:1587-1618 MsgTransferLeader handling,
+raft.go:1519-1524 completion, raft.go:823-832 + 1478-1484 timeout abort).
+
+| reference test (raft_test.go)                    | here |
+|--------------------------------------------------|------|
+| TestLeaderTransferToUpToDateNode (:3613)         | test_transfer_to_up_to_date_node |
+| TestLeaderTransferToUpToDateNodeFromFollower (:3641) | test_transfer_from_follower |
+| TestLeaderTransferWithCheckQuorum (:3668)        | test_transfer_with_check_quorum |
+| TestLeaderTransferToSlowFollower (:3703)         | test_transfer_to_slow_follower |
+| TestLeaderTransferAfterSnapshot (:3722)          | test_transfer_after_snapshot |
+| TestLeaderTransferToSelf (:3772)                 | test_transfer_to_self |
+| TestLeaderTransferToNonExistingNode (:3784)      | test_transfer_to_non_existing_node |
+| TestLeaderTransferTimeout (:3794)                | test_transfer_timeout |
+| TestLeaderTransferIgnoreProposal (:3821)         | test_transfer_ignore_proposal |
+| TestLeaderTransferReceiveHigherTermVote (:3848)  | test_transfer_receive_higher_term_vote |
+| TestLeaderTransferRemoveNode (:3866)             | test_transfer_remove_node |
+| TestLeaderTransferDemoteNode (:3889)             | test_transfer_demote_node |
+| TestLeaderTransferBack (:3918)                   | test_transfer_back |
+| TestLeaderTransferSecondTransferToAnotherNode (:3940) | test_second_transfer_to_another_node |
+| TestLeaderTransferSecondTransferToSameNode (:3962)    | test_second_transfer_to_same_node |
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from raft_tpu import confchange as ccm
+from raft_tpu.api.rawnode import ErrProposalDropped, Message
+from raft_tpu.types import MessageType as MT, StateType as ST
+
+from tests.test_paper import make_batch
+from tests.test_scenarios import (
+    commit_of,
+    hup,
+    net_of,
+    prop,
+    raw,
+    state_name,
+)
+
+ET, HT = 10, 1  # default election/heartbeat ticks (raft.go:288-336 validate)
+
+
+def transfer(net, to_leader: int, transferee: int):
+    """nt.send(MsgTransferLeader{From: transferee, To: to_leader})."""
+    raw(
+        net,
+        Message(
+            type=int(MT.MSG_TRANSFER_LEADER), to=to_leader, frm=transferee
+        ),
+    )
+
+
+def check_transfer_state(b, nid: int, state: str, lead: int):
+    """checkLeaderTransferState (raft_test.go:3983-3990)."""
+    st = b.basic_status(nid - 1)
+    assert st["raft_state"] == state, st
+    assert st["lead"] == lead, st
+    assert st["lead_transferee"] == 0, st
+
+
+def elected_1(n=3):
+    b = make_batch(n)
+    net = net_of(b)
+    hup(net, 1)
+    assert b.basic_status(0)["lead"] == 1
+    return b, net
+
+
+def ticks(net, nid: int, n: int):
+    for _ in range(n):
+        net.batch.tick(nid - 1)
+        net.send([])
+
+
+def test_transfer_to_up_to_date_node():
+    b, net = elected_1()
+    transfer(net, 1, 2)
+    check_transfer_state(b, 1, "FOLLOWER", 2)
+    # after some replication, transfer back to 1 (forwarded proposal)
+    prop(net, 1)
+    transfer(net, 2, 1)
+    check_transfer_state(b, 1, "LEADER", 1)
+
+
+def test_transfer_from_follower():
+    """Transfer requests addressed to the follower forward to the leader
+    (raft.go:1693-1699)."""
+    b, net = elected_1()
+    raw(net, Message(type=int(MT.MSG_TRANSFER_LEADER), to=2, frm=2))
+    check_transfer_state(b, 1, "FOLLOWER", 2)
+    prop(net, 1)
+    raw(net, Message(type=int(MT.MSG_TRANSFER_LEADER), to=1, frm=1))
+    check_transfer_state(b, 1, "LEADER", 1)
+
+
+def test_transfer_with_check_quorum():
+    """Transfer works even while the current leader holds its lease."""
+    from tests.test_paper import set_lane
+
+    b = make_batch(3, check_quorum=True)
+    net = net_of(b)
+    # the reference staggers randomized timeouts (ET+i per node) so ticking
+    # node 2 past the timeout can't start an election of its own
+    for lane in range(3):
+        set_lane(b, lane, randomized_election_timeout=ET + lane + 1)
+    # let peer 2's election clock pass the timeout so it may vote
+    for _ in range(ET):
+        b.tick(1)
+    net.send([])
+    hup(net, 1)
+    assert b.basic_status(0)["lead"] == 1
+    transfer(net, 1, 2)
+    check_transfer_state(b, 1, "FOLLOWER", 2)
+    prop(net, 1)
+    transfer(net, 2, 1)
+    check_transfer_state(b, 1, "LEADER", 1)
+
+
+def test_transfer_to_slow_follower():
+    b, net = elected_1()
+    net.isolate(3)
+    prop(net, 1)
+    net.recover()
+    assert int(b.view.pr_match[0, 2]) == 1  # node 3 lags
+    # the leader first catches 3 up, then sends MsgTimeoutNow
+    transfer(net, 1, 3)
+    check_transfer_state(b, 1, "FOLLOWER", 3)
+
+
+def test_transfer_after_snapshot():
+    b, net = elected_1()
+    net.isolate(3)
+    prop(net, 1)
+    applied = int(b.view.applied[0])
+    b.compact(0, applied, data=b"xfer-snap")
+    net.recover()
+    assert int(b.view.pr_match[0, 2]) == 1
+
+    # hold back node 3's accepting MsgAppResp: the transfer must stall
+    # until the snapshot is applied and acked (raft_test.go:3741-3756)
+    filtered = []
+
+    def hook(m):
+        if (
+            m.type == int(MT.MSG_APP_RESP)
+            and m.frm == 3
+            and not m.reject
+        ):
+            filtered.append(m)
+            return False
+        return True
+
+    net.msg_hook = hook
+    transfer(net, 1, 3)
+    assert state_name(b, 1) == "LEADER", "transfer must wait on the snapshot"
+    assert filtered, "follower must ack snapshot progress automatically"
+    net.msg_hook = None
+    net.send(filtered)
+    check_transfer_state(b, 1, "FOLLOWER", 3)
+
+
+def test_transfer_to_self():
+    b, net = elected_1()
+    transfer(net, 1, 1)
+    check_transfer_state(b, 1, "LEADER", 1)
+
+
+def test_transfer_to_non_existing_node():
+    b, net = elected_1()
+    transfer(net, 1, 4)
+    check_transfer_state(b, 1, "LEADER", 1)
+
+
+def test_transfer_timeout():
+    b, net = elected_1()
+    net.isolate(3)
+    transfer(net, 1, 3)
+    assert b.basic_status(0)["lead_transferee"] == 3
+    ticks(net, 1, HT)
+    assert b.basic_status(0)["lead_transferee"] == 3
+    ticks(net, 1, ET - HT)
+    check_transfer_state(b, 1, "LEADER", 1)
+
+
+def test_transfer_ignore_proposal():
+    b, net = elected_1()
+    net.isolate(3)
+    transfer(net, 1, 3)
+    assert b.basic_status(0)["lead_transferee"] == 3
+    with pytest.raises(ErrProposalDropped):
+        b.propose(0, b"")
+    assert int(b.view.pr_match[0, 0]) == 1
+
+
+def test_transfer_receive_higher_term_vote():
+    b, net = elected_1()
+    net.isolate(3)
+    transfer(net, 1, 3)
+    assert b.basic_status(0)["lead_transferee"] == 3
+    hup(net, 2)  # node 2 campaigns at a higher term
+    check_transfer_state(b, 1, "FOLLOWER", 2)
+
+
+def test_transfer_remove_node():
+    b, net = elected_1()
+    net.ignore.add(int(MT.MSG_TIMEOUT_NOW))
+    transfer(net, 1, 3)
+    assert b.basic_status(0)["lead_transferee"] == 3
+    b.apply_conf_change(
+        0, ccm.ConfChange(type=int(ccm.ConfChangeType.REMOVE_NODE), node_id=3)
+    )
+    net.send([])
+    check_transfer_state(b, 1, "LEADER", 1)
+
+
+def test_transfer_demote_node():
+    b, net = elected_1()
+    net.ignore.add(int(MT.MSG_TIMEOUT_NOW))
+    transfer(net, 1, 3)
+    assert b.basic_status(0)["lead_transferee"] == 3
+    b.apply_conf_change(
+        0,
+        ccm.ConfChangeV2(
+            changes=[
+                ccm.ConfChangeSingle(int(ccm.ConfChangeType.REMOVE_NODE), 3),
+                ccm.ConfChangeSingle(
+                    int(ccm.ConfChangeType.ADD_LEARNER_NODE), 3
+                ),
+            ],
+        ),
+    )
+    b.apply_conf_change(0, ccm.ConfChangeV2())  # leave joint
+    net.send([])
+    check_transfer_state(b, 1, "LEADER", 1)
+
+
+def test_transfer_back():
+    b, net = elected_1()
+    net.isolate(3)
+    transfer(net, 1, 3)
+    assert b.basic_status(0)["lead_transferee"] == 3
+    transfer(net, 1, 1)  # back to self aborts the pending transfer
+    check_transfer_state(b, 1, "LEADER", 1)
+
+
+def test_second_transfer_to_another_node():
+    b, net = elected_1()
+    net.isolate(3)
+    transfer(net, 1, 3)
+    assert b.basic_status(0)["lead_transferee"] == 3
+    transfer(net, 1, 2)
+    check_transfer_state(b, 1, "FOLLOWER", 2)
+
+
+def test_second_transfer_to_same_node():
+    """A second request for the same transferee must not extend the
+    election-timeout abort clock."""
+    b, net = elected_1()
+    net.isolate(3)
+    transfer(net, 1, 3)
+    assert b.basic_status(0)["lead_transferee"] == 3
+    ticks(net, 1, HT)
+    transfer(net, 1, 3)  # same transferee: no clock reset
+    ticks(net, 1, ET - HT)
+    check_transfer_state(b, 1, "LEADER", 1)
