@@ -1,0 +1,108 @@
+"""TestFastLogRejection port (raft_test.go:4430-4620) — the accelerated
+log-reconciliation protocol: a rejecting follower returns a (term, index)
+hint (raft.go:1760-1769 via log.go:178 findConflictByTerm), and the leader
+probes back using the hint (raft.go:1416-1497), skipping whole terms per
+round trip instead of decrementing by one.
+
+All nine reference table cases run through the wire path: heartbeat ->
+heartbeat resp -> probe MsgApp -> rejected MsgAppResp with hint -> next
+probe, asserting the hint and next-probe coordinates byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from raft_tpu.api.rawnode import Message, RawNodeBatch
+from raft_tpu.config import Shape
+from raft_tpu.types import MessageType as MT
+
+from tests.test_paper import set_lane, set_log
+from tests.test_scenarios import state_name
+
+CASES = [
+    # (leader_terms, follower_terms, follower_compact,
+    #  hint_term, hint_index, next_term, next_index)
+    ([1, 2, 2, 4, 4, 4, 4], [1, 2, 2, 3, 3, 3, 3, 3, 3, 3, 3], 0, 3, 7, 2, 3),
+    ([1, 2, 2, 3, 4, 4, 4, 5], [1, 2, 2, 3, 3, 3, 3, 3, 3, 3, 3], 0, 3, 8, 3, 4),
+    ([1, 1, 1, 1], [1, 2, 2, 4], 0, 1, 1, 1, 1),
+    ([1, 1, 1, 1, 1, 1], [1, 2, 2, 4], 0, 1, 1, 1, 1),
+    ([1, 1, 1, 1], [1, 2, 2, 4, 4, 4], 0, 1, 1, 1, 1),
+    ([1, 1, 1, 4, 5], [1, 1, 1, 4], 0, 4, 4, 4, 4),
+    ([2, 5, 5, 5, 5, 5, 5, 5, 5], [2, 4, 4, 4, 4, 4], 0, 4, 6, 2, 1),
+    ([2, 2, 2, 2, 2], [2, 4, 4, 4, 4, 4, 4, 4], 0, 2, 1, 2, 1),
+    ([1, 1, 3], [1, 1, 3, 3, 3], 5, 0, 3, 1, 2),
+]
+
+
+def two_nodes():
+    """Lanes for ids 1 (leader-to-be) and 2, config {1, 2, 3}."""
+    peers = np.zeros((2, 8), np.int32)
+    peers[:, :3] = [1, 2, 3]
+    return RawNodeBatch(Shape(n_lanes=2, log_window=32), ids=[1, 2], peers=peers)
+
+
+def emissions(b, lane):
+    out = []
+    while b.has_ready(lane):
+        rd = b.ready(lane)
+        out.extend(rd.messages)
+        b.advance(lane)
+    return out
+
+
+@pytest.mark.parametrize("case", range(len(CASES)))
+def test_fast_log_rejection(case):
+    (
+        leader_terms, follower_terms, follower_compact,
+        hint_term, hint_index, next_term, next_index,
+    ) = CASES[case]
+    last_term = leader_terms[-1]
+    b = two_nodes()
+
+    # leader: log + HardState{Term: last-1, Commit: last}; election bumps
+    # the term to last_term and appends the new leader's empty entry
+    set_log(b, 0, leader_terms, committed=len(leader_terms))
+    set_lane(b, 0, term=last_term - 1, applied=len(leader_terms),
+             applying=len(leader_terms))
+    b.campaign(0)
+    emissions(b, 0)  # self-vote durable + vote requests
+    b.step(
+        0,
+        Message(type=int(MT.MSG_VOTE_RESP), frm=2, to=1, term=last_term),
+    )
+    emissions(b, 0)
+    assert state_name(b, 1) == "LEADER"
+
+    # follower: conflicting log, HardState{Term: last, Vote: 1, Commit: 0}
+    set_log(b, 1, follower_terms, committed=0)
+    set_lane(b, 1, term=last_term, vote=1)
+    if follower_compact:
+        ct = follower_terms[follower_compact - 1]
+        set_lane(b, 1, snap_index=follower_compact, snap_term=ct)
+
+    # heartbeat -> resp
+    b.step(1, Message(type=int(MT.MSG_HEARTBEAT), frm=1, to=2, term=last_term))
+    msgs = [m for m in emissions(b, 1) if m.to == 1]
+    assert len(msgs) == 1 and msgs[0].type == int(MT.MSG_HEARTBEAT_RESP), msgs
+
+    # resp -> probe MsgApp
+    b.step(0, msgs[0])
+    msgs = [m for m in emissions(b, 0) if m.to == 2]
+    assert len(msgs) == 1 and msgs[0].type == int(MT.MSG_APP), msgs
+
+    # probe -> rejected MsgAppResp carrying the (term, index) hint
+    b.step(1, msgs[0])
+    msgs = [m for m in emissions(b, 1) if m.to == 1]
+    assert len(msgs) == 1 and msgs[0].type == int(MT.MSG_APP_RESP), msgs
+    assert msgs[0].reject, "expected rejected append"
+    assert msgs[0].log_term == hint_term, (msgs[0].log_term, hint_term)
+    assert msgs[0].reject_hint == hint_index, (msgs[0].reject_hint, hint_index)
+
+    # hint -> the leader's next probe coordinates
+    b.step(0, msgs[0])
+    msgs = [m for m in emissions(b, 0) if m.to == 2 and m.type == int(MT.MSG_APP)]
+    assert msgs, "leader must re-probe after the hinted rejection"
+    assert msgs[0].log_term == next_term, (msgs[0].log_term, next_term)
+    assert msgs[0].index == next_index, (msgs[0].index, next_index)
