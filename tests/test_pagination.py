@@ -1,0 +1,135 @@
+"""Size-limit / pagination node tests — reference node_test.go ports.
+
+| reference test (node_test.go)       | here |
+|-------------------------------------|------|
+| TestAppendPagination (:844)         | test_append_pagination |
+| TestCommitPagination (:888)         | test_commit_pagination |
+| TestDisableProposalForwarding (:179)| test_disable_proposal_forwarding |
+| TestBlockProposal (:397)            | test_block_proposal_until_leader |
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from raft_tpu.api.rawnode import ErrProposalDropped, Message
+from raft_tpu.types import MessageType as MT
+
+from tests.test_paper import make_batch
+from tests.test_scenarios import hup, net_of, take_msgs
+
+
+def test_append_pagination():
+    """MsgApp entry batches never exceed MaxSizePerMsg, and catch-up after
+    a partition does batch multiple entries per message."""
+    max_size = 2048
+    b = make_batch(
+        3,
+        shape_kw=dict(max_msg_entries=4, log_window=32),
+        max_size_per_msg=max_size,
+    )
+    net = net_of(b)
+    seen_full = [False]
+
+    def hook(m):
+        if m.type == int(MT.MSG_APP):
+            size = sum(len(e.data or b"") for e in m.entries)
+            assert size <= max_size, f"oversized MsgApp: {size}"
+            if size > max_size // 2:
+                seen_full[0] = True
+        return True
+
+    net.msg_hook = hook
+    hup(net, 1)
+    net.isolate(1)
+    blob = b"a" * 1000
+    for _ in range(5):
+        try:
+            b.propose(0, blob)
+        except ErrProposalDropped:
+            pytest.fail("leader must accept while partitioned")
+        net.send([])
+    net.recover()
+    b._run_step(0, Message(type=int(MT.MSG_BEAT), to=1))
+    net.send([])
+    assert seen_full[0], "expected at least one large batched MsgApp"
+    # every follower caught up
+    for nid in (2, 3):
+        assert int(b.view.committed[nid - 1]) == int(b.view.committed[0])
+
+
+def test_commit_pagination():
+    """CommittedEntries batches respect MaxCommittedSizePerReady
+    (log.go:216-240 pagination)."""
+    b = make_batch(
+        1,
+        shape_kw=dict(max_msg_entries=4, log_window=32),
+        max_committed_size_per_ready=2048,
+    )
+    b.campaign(0)
+    batches = []
+    while b.has_ready(0):
+        rd = b.ready(0)
+        if rd.committed_entries:
+            batches.append(len(rd.committed_entries))
+        b.advance(0)
+    assert batches == [1], batches  # the term's empty entry
+
+    blob = b"a" * 1000
+    for _ in range(3):
+        b.propose(0, blob)
+    batches = []
+    committed = []
+    for _ in range(10):
+        if not b.has_ready(0):
+            break
+        rd = b.ready(0)
+        if rd.committed_entries:
+            batches.append(len(rd.committed_entries))
+            committed.extend(rd.committed_entries)
+        b.advance(0)
+    # three 1000-byte entries commit in a 2-entry batch then a 1-entry one
+    assert batches == [2, 1], batches
+    assert [e.data for e in committed] == [blob] * 3
+
+
+def test_disable_proposal_forwarding():
+    b = make_batch(3)
+    # node 3 disables forwarding
+    import dataclasses
+
+    cfg = b.state.cfg
+    b.state = dataclasses.replace(
+        b.state,
+        cfg=dataclasses.replace(
+            cfg,
+            disable_proposal_forwarding=cfg.disable_proposal_forwarding.at[2].set(
+                True
+            ),
+        ),
+    )
+    b.view.refresh(b.state)
+    net = net_of(b)
+    hup(net, 1)
+
+    # follower 2 forwards
+    b.propose(1, b"testdata")
+    assert len(take_msgs(b, 1, [MT.MSG_PROP])) == 1
+
+    # follower 3 refuses (ErrProposalDropped), nothing emitted
+    with pytest.raises(ErrProposalDropped):
+        b.propose(2, b"testdata")
+    assert take_msgs(b, 2, [MT.MSG_PROP]) == []
+
+
+def test_block_proposal_until_leader():
+    """A proposal before any leader exists is dropped; after election it
+    is accepted (node_test.go:397-430, via the synchronous surface)."""
+    b = make_batch(3)
+    net = net_of(b)
+    with pytest.raises(ErrProposalDropped):
+        b.propose(0, b"early")
+    hup(net, 1)
+    b.propose(0, b"after-election")
+    net.send([])
+    assert int(b.view.committed[0]) == 2
