@@ -7,6 +7,8 @@ raft_test.go ports.
 | TestPreVoteFromAnyState (:1532)  | test_prevote_from_any_state |
 | TestLogReplication (:697)        | test_log_replication |
 | TestMsgAppRespWaitReset (:1439)  | test_msg_app_resp_wait_reset |
+| TestRaftFreesReadOnlyMem (:2840) | test_raft_frees_readonly_mem |
+| TestBcastBeat (:2722)            | test_bcast_beat |
 """
 
 from __future__ import annotations
@@ -140,3 +142,72 @@ def test_msg_app_resp_wait_reset():
     msgs = [m for m in drain_msgs(b) if m.type == int(MT.MSG_APP) and m.to == 3]
     assert len(msgs) == 1, msgs
     assert len(msgs[0].entries) == 1 and msgs[0].entries[0].index == 2, msgs[0]
+
+
+def test_raft_frees_readonly_mem():
+    """TestRaftFreesReadOnlyMem (raft_test.go:2840): a quorum ack releases
+    the pending-read slot — the ro_* ring must not grow with request
+    count (read_only.go advance + our ro_ctx=0 free-slot convention)."""
+    from tests.test_paper import set_lane
+
+    b = lone_node()
+    enter_state(b, "LEADER")
+    term = term_of(b, 1)
+    set_lane(b, 0, committed=int(b.view.last[0]),
+             applying=int(b.view.last[0]), applied=int(b.view.last[0]))
+
+    b.step(
+        0,
+        Message(type=int(MT.MSG_READ_INDEX), frm=2, to=1, context=b"ctx"),
+    )
+    msgs = [m for m in drain_msgs(b) if m.type == int(MT.MSG_HEARTBEAT)]
+    assert msgs and all(m.context == b"ctx" for m in msgs), msgs
+    assert int(np.asarray(b.state.ro_ctx[0] != 0).sum()) == 1
+
+    b.step(
+        0,
+        Message(
+            type=int(MT.MSG_HEARTBEAT_RESP), frm=2, to=1, term=term,
+            context=b"ctx",
+        ),
+    )
+    # released: the response went out and the ring slot is free again
+    resps = [m for m in drain_msgs(b) if m.type == int(MT.MSG_READ_INDEX_RESP)]
+    assert len(resps) == 1 and resps[0].to == 2 and resps[0].context == b"ctx"
+    assert int(np.asarray(b.state.ro_ctx[0] != 0).sum()) == 0
+    # and the host-side ctx intern table is drained too
+    assert b._ctx_intern[0] == {} and b._ctx_rev[0] == {}
+
+
+def test_bcast_beat():
+    """TestBcastBeat (raft_test.go:2722): heartbeats carry no log
+    positions or entries, and clamp commit to min(committed, match) so a
+    slow follower never learns a commit index beyond its log."""
+    from tests.test_paper import set_lane, set_log
+
+    offset = 64  # the window analog of the reference's offset-1000 log
+    b = lone_node()
+    set_lane(b, 0, snap_index=offset, snap_term=1, last=offset,
+             stabled=offset, committed=offset, applying=offset,
+             applied=offset, term=1)
+    enter_state(b, "LEADER")
+    for _ in range(10):
+        b.propose(0, b"x")
+    drain_msgs(b)
+    last = int(b.view.last[0])
+    # follower 2 is slow (match offset+5), follower 3 caught up (match last)
+    b.step(0, Message(type=int(MT.MSG_APP_RESP), frm=2, to=1,
+                      term=term_of(b, 1), index=offset + 5))
+    b.step(0, Message(type=int(MT.MSG_APP_RESP), frm=3, to=1,
+                      term=term_of(b, 1), index=last))
+    drain_msgs(b)
+    committed = int(b.view.committed[0])
+    assert committed == last  # quorum {1,3}
+
+    b._run_step(0, Message(type=int(MT.MSG_BEAT), to=1))
+    beats = [m for m in drain_msgs(b) if m.type == int(MT.MSG_HEARTBEAT)]
+    want = {2: min(committed, offset + 5), 3: min(committed, last)}
+    got = {m.to: m.commit for m in beats}
+    assert got == want, (got, want)
+    for m in beats:
+        assert m.index == 0 and m.log_term == 0 and m.entries == []
