@@ -148,8 +148,6 @@ def test_raft_frees_readonly_mem():
     """TestRaftFreesReadOnlyMem (raft_test.go:2840): a quorum ack releases
     the pending-read slot — the ro_* ring must not grow with request
     count (read_only.go advance + our ro_ctx=0 free-slot convention)."""
-    from tests.test_paper import set_lane
-
     b = lone_node()
     enter_state(b, "LEADER")
     term = term_of(b, 1)
@@ -183,8 +181,6 @@ def test_bcast_beat():
     """TestBcastBeat (raft_test.go:2722): heartbeats carry no log
     positions or entries, and clamp commit to min(committed, match) so a
     slow follower never learns a commit index beyond its log."""
-    from tests.test_paper import set_lane, set_log
-
     offset = 64  # the window analog of the reference's offset-1000 log
     b = lone_node()
     set_lane(b, 0, snap_index=offset, snap_term=1, last=offset,
@@ -206,6 +202,7 @@ def test_bcast_beat():
 
     b._run_step(0, Message(type=int(MT.MSG_BEAT), to=1))
     beats = [m for m in drain_msgs(b) if m.type == int(MT.MSG_HEARTBEAT)]
+    assert len(beats) == 2, beats
     want = {2: min(committed, offset + 5), 3: min(committed, last)}
     got = {m.to: m.commit for m in beats}
     assert got == want, (got, want)
