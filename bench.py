@@ -27,52 +27,56 @@ import jax
 import jax.numpy as jnp
 
 
-def run_fused(n_groups, n_voters, n_iters, block):
+def run_fused(n_groups, n_voters, n_iters, block, block_groups=None):
     from raft_tpu.config import Shape
-    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.scheduler import BlockedFusedCluster
 
     # lean window: steady state commits 1 entry/group/round with continuous
     # compaction, so a small resident window maximizes throughput (HBM
     # traffic scales with W and E); raise via env for bursty workloads
     w = int(os.environ.get("BENCH_WINDOW", 16))
     e = int(os.environ.get("BENCH_ENTRIES", 2))
+    block_groups = block_groups or n_groups
     shape = Shape(
-        n_lanes=n_groups * n_voters,
+        n_lanes=block_groups * n_voters,
         max_peers=n_voters,
         log_window=w,
         max_msg_entries=e,
         max_inflight=min(8, e),
+        max_read_index=2,
     )
-    c = FusedCluster(n_groups, n_voters, seed=42, shape=shape)
+    c = BlockedFusedCluster(
+        n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape
+    )
     lag = min(8, w // 2)  # must leave window headroom or appends stall
 
     t0 = time.perf_counter()
     c.run(block, auto_propose=True, auto_compact_lag=lag)
-    jax.block_until_ready(c.state.term)
+    c.block_until_ready()
     compile_s = time.perf_counter() - t0
 
     # warm through the election phase so the timed region is steady state
     # (bounded: persistent split votes should fail loudly, not hang)
     warm_rounds = 0
-    while len(c.leader_lanes()) < n_groups:
+    while c.leader_count() < n_groups:
         c.run(block, auto_propose=True, auto_compact_lag=lag)
         warm_rounds += block
         if warm_rounds > 40 * 16:
             raise RuntimeError(
-                f"warm-up stalled: {len(c.leader_lanes())}/{n_groups} "
+                f"warm-up stalled: {c.leader_count()}/{n_groups} "
                 f"groups elected after {warm_rounds} rounds"
             )
 
-    com0 = int(jnp.sum(c.state.committed))
+    com0 = c.total_committed()
     t0 = time.perf_counter()
     for _ in range(n_iters):
         c.run(block, auto_propose=True, auto_compact_lag=lag)
-    jax.block_until_ready(c.state.term)
+    c.block_until_ready()
     dt = time.perf_counter() - t0
-    commits = int(jnp.sum(c.state.committed)) - com0
+    commits = c.total_committed() - com0
     c.check_no_errors()
     assert commits > 0, "benchmark workload stalled: no entries committed"
-    return dt, compile_s, len(c.leader_lanes()), commits
+    return dt, compile_s, c.leader_count(), commits
 
 
 def run_serial(n_groups, n_voters, n_iters, block):
@@ -111,22 +115,50 @@ def run_serial(n_groups, n_voters, n_iters, block):
 def main():
     platform = jax.devices()[0].platform
     engine = os.environ.get("BENCH_ENGINE", "fused")
-    # 65k groups measured as the single-chip throughput peak (round-3
-    # scaling ladder, BASELINE.md): 1.77M groups*ticks/s vs 1.49M at 16k
+    # The headline shape is BASELINE.json config 5's 1M groups, held
+    # resident as 16 blocks of 64k groups (scheduler.BlockedFusedCluster):
+    # one compiled 64k-group kernel serves all 16, XLA temporaries stay at
+    # block size, and the slim carry keeps 3M lanes of state on one chip.
     n_groups = int(
-        os.environ.get("BENCH_GROUPS", 65536 if platform == "tpu" else 512)
+        os.environ.get("BENCH_GROUPS", 1048576 if platform == "tpu" else 512)
+    )
+    block_groups = int(
+        os.environ.get(
+            "BENCH_BLOCK_GROUPS", min(n_groups, 65536 if platform == "tpu" else 256)
+        )
     )
     n_iters = int(os.environ.get("BENCH_ITERS", 10))
     block = int(os.environ.get("BENCH_BLOCK", 32))
     n_voters = int(os.environ.get("BENCH_VOTERS", 3))
 
-    runner = run_fused if engine == "fused" else run_serial
     from raft_tpu.utils.profiling import env_trace_dir, trace
 
+    fallback = False
     with trace(env_trace_dir()):
-        dt, compile_s, n_leaders, commits = runner(
-            n_groups, n_voters, n_iters, block
-        )
+        if engine == "fused":
+            try:
+                dt, compile_s, n_leaders, commits = run_fused(
+                    n_groups, n_voters, n_iters, block, block_groups
+                )
+            except Exception as e:  # noqa: BLE001 — still print a record
+                if n_groups <= block_groups:
+                    raise
+                import sys, traceback
+
+                traceback.print_exc(file=sys.stderr)
+                print(
+                    f"# {n_groups}-group run failed ({type(e).__name__}); "
+                    f"falling back to one {block_groups}-group block",
+                    file=sys.stderr,
+                )
+                fallback, n_groups = True, block_groups
+                dt, compile_s, n_leaders, commits = run_fused(
+                    n_groups, n_voters, n_iters, block, block_groups
+                )
+        else:
+            dt, compile_s, n_leaders, commits = run_serial(
+                n_groups, n_voters, n_iters, block
+            )
 
     groups_ticks_per_sec = n_groups * n_iters * block / dt
     target = 1_000_000.0
@@ -140,6 +172,9 @@ def main():
                 "extra": {
                     "engine": engine,
                     "groups": n_groups,
+                    "block_groups": block_groups,
+                    "resident_blocks": -(-n_groups // block_groups),
+                    "fallback": fallback,
                     "voters": n_voters,
                     "leaders_elected": n_leaders,
                     "commits_per_group_round": round(

@@ -38,6 +38,13 @@ class ErrCanceled(Exception):
     the raft goroutine proceeds even as the caller returns ctx.Err()."""
 
 
+# op lifecycle: PENDING -> STARTED (loop wins) xor CANCELED (waiter wins).
+# The transition is taken under `lock`, making the reference's atomic select
+# between the channel send and ctx.Done() (node.go:502-545) a real guarantee:
+# a caller that observes CANCELED knows the loop will never execute the op.
+_PENDING, _STARTED, _CANCELED = 0, 1, 2
+
+
 @dataclasses.dataclass
 class _Op:
     kind: str
@@ -46,10 +53,27 @@ class _Op:
     done: threading.Event | None = None
     result: object = None
     error: Exception | None = None
-    # cancellation (the ctx.Done() analog): checked by the loop immediately
-    # before execution; a canceled op is skipped, never half-applied
+    # cancellation (the ctx.Done() analog): raced against execution via the
+    # locked `state` transition; a canceled op is skipped, never half-applied
     cancel: threading.Event | None = None
-    started: bool = False
+    state: int = _PENDING
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+
+    def try_start(self) -> bool:
+        """Loop side: claim the op for execution. False if already canceled."""
+        with self.lock:
+            if self.state == _CANCELED:
+                return False
+            self.state = _STARTED
+            return True
+
+    def try_cancel(self) -> bool:
+        """Waiter side: claim cancellation. False if the loop already won."""
+        with self.lock:
+            if self.state == _STARTED:
+                return False
+            self.state = _CANCELED
+            return True
 
 
 class NodeHost:
@@ -97,14 +121,20 @@ class NodeHost:
 
     def _handle(self, op: _Op):
         b = self.batch
-        if op.cancel is not None and op.cancel.is_set():
-            # reference: the select never picks the channel send once
-            # ctx.Done() fired — the message is not stepped at all
+        # a cancel event observed set before execution claims the CANCELED
+        # transition on the waiter's behalf (the waiter may still be inside
+        # its poll interval); then the PENDING->STARTED claim races any
+        # concurrent try_cancel atomically. Either way: the reference's
+        # select never picks the channel send once ctx.Done() fired — a
+        # skipped message is not stepped at all.
+        canceled = (
+            op.cancel is not None and op.cancel.is_set() and op.try_cancel()
+        )
+        if canceled or not op.try_start():
             op.error = ErrCanceled()
             if op.done is not None:
                 op.done.set()
             return
-        op.started = True
         try:
             if op.kind == "tick":
                 b.tick(op.lane)
@@ -153,10 +183,14 @@ class NodeHost:
         # executed late) once the caller has given up on it
         if wait and timeout is not None and cancel is None:
             cancel = threading.Event()
+        # the cancel event is honored even for fire-and-forget submissions:
+        # the loop checks it before claiming the op (the documented
+        # "canceled before the loop reaches it => never applied" guarantee
+        # does not depend on anyone waiting)
         op = _Op(
             kind, lane, payload,
             threading.Event() if wait else None,
-            cancel=cancel if wait else None,
+            cancel=cancel,
         )
         self._ops.put(op)
         if wait:
@@ -169,15 +203,16 @@ class NodeHost:
                 if self._stop.is_set():
                     raise ErrStopped()
                 if cancel is not None and cancel.is_set():
-                    if op.started:
-                        # the loop is already executing it (the reference's
-                        # ctx race: the proposal proceeds); keep waiting
+                    if not op.try_cancel():
+                        # the loop already won the transition and is executing
+                        # it (the reference's ctx race: the proposal proceeds);
+                        # keep waiting for it to finish
                         continue
-                    # not started: the loop is guaranteed to skip it
+                    # we won: the loop is guaranteed to skip it
                     raise ErrCanceled()
                 if deadline is not None and _time.monotonic() > deadline:
-                    cancel.set()  # the loop must not execute it late
-                    if op.started:
+                    cancel.set()  # belt-and-braces for external observers
+                    if not op.try_cancel():
                         continue  # already executing: let it finish
                     raise TimeoutError(f"{kind} timed out after {timeout}s")
             if op.error is not None:

@@ -368,6 +368,11 @@ class RawNodeBatch:
         # offsetInProgress; _applying mirrors the accepted applying cursor
         self._async = [False] * n
         self._inprog = [0] * n
+        # staged-snapshot index already handed to the append thread; while it
+        # matches pending_snap_index the snapshot is withheld from Ready —
+        # unstable.snapshotInProgress (reference: log_unstable.go:49-56,
+        # nextSnapshot:84-90)
+        self._snap_inprog = [0] * n
         self._applying = [0] * n
         self._prev_hs = [HardState() for _ in range(n)]
         self._prev_ss = [SoftState() for _ in range(n)]
@@ -529,6 +534,7 @@ class RawNodeBatch:
         old_last = int(self.view.last[lane])
         old_term = int(self.view.term[lane])
         old_lt = old_stabled = None
+        old_psi = int(self.view.pending_snap_index[lane])
         if self._async[lane]:
             old_lt = np.array(self.view.log_term[lane])
             old_stabled = int(self.view.stabled[lane])
@@ -537,6 +543,12 @@ class RawNodeBatch:
         self.view.refresh(self.state)
         if old_lt is not None:
             self._rewind_inprog(lane, old_lt, old_stabled, old_last)
+            # a restore replaces the staged snapshot (snapshotInProgress :=
+            # false, log_unstable.go:188-194) and an append-thread ack clears
+            # it — either way the marker no longer matches what is staged
+            new_psi = int(self.view.pending_snap_index[lane])
+            if new_psi != old_psi or (msg.type == int(MT.MSG_SNAP) and new_psi):
+                self._snap_inprog[lane] = 0
         # payloads first: fan-out messages emitted by this same step resolve
         # their entry bytes from the store
         self._store_accepted_payloads(lane, msg, old_last, old_term)
@@ -904,8 +916,11 @@ class RawNodeBatch:
             t = int(v.log_term[lane, i & (w - 1)])
             etype, data = self.store.get(lane, i, t)
             rd.entries.append(Entry(t, i, int(v.log_type[lane, i & (w - 1)]), data))
-        # pending snapshot to persist (reference Ready.Snapshot)
-        psi = int(v.pending_snap_index[lane])
+        # pending snapshot to persist (reference Ready.Snapshot); in async
+        # mode one already accepted by the append thread is withheld until
+        # acked (unstable.nextSnapshot, log_unstable.go:84-90)
+        raw_psi = int(v.pending_snap_index[lane])
+        psi = 0 if (is_async and self._snap_inprog[lane] == raw_psi) else raw_psi
         if psi:
             snap = self.store.snapshot(lane)
             rd.snapshot = snap if snap and snap.index == psi else Snapshot(
@@ -921,8 +936,9 @@ class RawNodeBatch:
             hi = min(commit, stabled)
         else:
             lo, hi = int(v.applied[lane]) + 1, commit
-        if psi:
-            hi = lo - 1  # snapshot must be applied first
+        if raw_psi:
+            hi = lo - 1  # snapshot must be applied first (even one whose
+            # persistence is still in flight on the append thread)
         size = 0
         for i in range(lo, hi + 1):
             t = int(v.log_term[lane, i & (w - 1)])
@@ -973,6 +989,10 @@ class RawNodeBatch:
             if is_async:
                 if rd.entries:
                     self._inprog[lane] = rd.entries[-1].index
+                if rd.snapshot:
+                    # acceptInProgress: the append thread now owns it
+                    # (reference: log_unstable.go:106-115)
+                    self._snap_inprog[lane] = rd.snapshot.index
                 if rd.committed_entries:
                     self._applying[lane] = rd.committed_entries[-1].index
             if nrs:
@@ -1269,6 +1289,7 @@ class RawNodeBatch:
         self._steps_on_advance[lane] = []
         self._read_states[lane] = []
         self._inprog[lane] = 0
+        self._snap_inprog[lane] = 0
         self._applying[lane] = applied
         self._prev_hs[lane] = HardState(hs.term, hs.vote, hs.commit)
         self._prev_ss[lane] = SoftState(0, int(StateType.FOLLOWER))
@@ -1356,6 +1377,7 @@ class RawNodeBatch:
                 hs.term, hs.vote, max(hs.commit - delta, 0)
             )
             self._inprog[lane] = max(self._inprog[lane] - delta, 0)
+            self._snap_inprog[lane] = max(self._snap_inprog[lane] - delta, 0)
             self._applying[lane] = max(self._applying[lane] - delta, 0)
         return delta
 

@@ -302,7 +302,12 @@ class Cluster:
             for vid in range(1, n_voters + 1):
                 lane_of[g, vid] = g * n_voters + (vid - 1)
         self.lane_of = jnp.asarray(lane_of)
-        self.m_in = 2 * self.shape.v + 2
+        # inbox capacity: a leader can address one lane with up to 2 fan-out
+        # messages + self-ack + reply per step, and the batch-released
+        # ReadIndex prefix can add up to R-1 extra MsgReadIndexResp to the
+        # SAME requester in one step (step.py drain slots) — size for the
+        # burst so route() never silently drops read responses
+        self.m_in = 2 * self.shape.v + 2 + (self.shape.max_read_index - 1)
         # pending inbox is host-mutable so tests can inject local messages
         self._pending = jax.tree.map(
             lambda x: np.array(x), empty_batch((n, self.m_in), self.shape.max_msg_entries)

@@ -58,6 +58,12 @@ class Shape:
             raise ValueError("log_window must be a power of two")
         if self.outbox == 0:
             object.__setattr__(self, "outbox", 2 * self.max_peers + 2)
+        # the slim carry (state.STATE_SLIM / fused.FABRIC_SLIM) stores these
+        # counters as int8
+        for f in ("max_inflight", "max_read_index", "max_msg_entries"):
+            if not 1 <= getattr(self, f) <= 127:
+                raise ValueError(f"{f} must be in 1..127 (int8 carry diet; "
+                                 "inbox sizing assumes at least 1)")
 
     @property
     def n(self) -> int:

@@ -175,6 +175,39 @@ def empty_fabric(n: int, v: int, e: int) -> Fabric:
     )
 
 
+# Fabric carry diet (see state.STATE_SLIM): message-type and count columns
+# store as int8 between rounds; term/index/commit columns stay int32. The
+# paths below ("rep.kind") address nested channel fields.
+FABRIC_SLIM = {
+    ("rep", "kind"): jnp.int8,
+    ("rep", "n_ents"): jnp.int8,
+    ("rep", "ent_type"): jnp.int8,
+    ("hb", "kind"): jnp.int8,
+    ("vote", "kind"): jnp.int8,
+    ("vresp", "kind"): jnp.int8,
+    ("self_", "kind"): jnp.int8,
+}
+
+
+def _cast_fabric(fab: Fabric, widen: bool) -> Fabric:
+    for (chan_name, field), dt in FABRIC_SLIM.items():
+        chan = getattr(fab, chan_name)
+        x = getattr(chan, field)
+        target = jnp.int32 if widen else dt
+        if x.dtype != target:
+            chan = dataclasses.replace(chan, **{field: x.astype(target)})
+            fab = dataclasses.replace(fab, **{chan_name: chan})
+    return fab
+
+
+def slim_fabric(fab: Fabric) -> Fabric:
+    return _cast_fabric(fab, widen=False)
+
+
+def fat_fabric(fab: Fabric) -> Fabric:
+    return _cast_fabric(fab, widen=True)
+
+
 def route_fabric(out: Fabric, v: int, mute=None) -> Fabric:
     """Deliver: inbox[g, j, i] = outbox[g, i, j]. Pure transpose per field;
     the self slot passes through (it is the lane's own queued ack).
@@ -367,6 +400,24 @@ def no_ops(n: int) -> LocalOps:
     z = jnp.zeros((n,), I32)
     zb = jnp.zeros((n,), BOOL)
     return LocalOps(zb, z, z, z, z, zb, z)
+
+
+def make_local_ops(n: int, **kw) -> LocalOps:
+    """LocalOps over `n` lanes with the given columns set; values may be
+    dicts {lane: value} or full arrays."""
+    import numpy as np
+
+    base = {
+        f: np.zeros((n,), np.bool_ if f in ("hup", "forget") else np.int32)
+        for f in LocalOps._fields
+    }
+    for k, val in kw.items():
+        if isinstance(val, dict):
+            for lane, x in val.items():
+                base[k][lane] = x
+        else:
+            base[k][:] = val
+    return LocalOps(**{k: jnp.asarray(x) for k, x in base.items()})
 
 
 # --------------------------------------------------------------------------
@@ -1055,7 +1106,16 @@ def fused_rounds(
     ops_first_round_only: bool = True,
 ):
     """n_rounds fused rounds in one dispatch. `ops` applies to the first
-    round only (one-shot injections) unless ops_first_round_only=False."""
+    round only (one-shot injections) unless ops_first_round_only=False.
+
+    The scan carry rides in the slim storage dtypes (state.STATE_SLIM /
+    FABRIC_SLIM): each round widens to int32, computes, and narrows back, so
+    HBM holds the dieted layout while the ALU path is unchanged. XLA fuses
+    the casts into the adjacent ops."""
+    from raft_tpu.state import fat_state, slim_state
+
+    state = slim_state(state)
+    fab = slim_fabric(fab)
 
     def body(carry, i):
         st, f = carry
@@ -1068,9 +1128,9 @@ def fused_rounds(
                 ),
                 ops,
             )
-        inb = route_fabric(f, v, mute)
+        inb = route_fabric(fat_fabric(f), v, mute)
         st, f = fused_round(
-            st,
+            fat_state(st),
             inb,
             o,
             mute,
@@ -1078,7 +1138,7 @@ def fused_rounds(
             auto_propose=auto_propose,
             auto_compact_lag=auto_compact_lag,
         )
-        return (st, f), None
+        return (slim_state(st), slim_fabric(f)), None
 
     (state, fab), _ = jax.lax.scan(
         body, (state, fab), jnp.arange(n_rounds, dtype=I32)
@@ -1135,10 +1195,14 @@ class FusedCluster:
                 raise ValueError(f"learner id {lid} outside canonical 1..{n_voters}")
             is_learner[:, lid - 1] = True
         lane_cfg = make_lane_config(self.shape, **cfg)
-        self.state = init_state(
-            self.shape, ids, peers, is_learner, seed=seed, cfg=lane_cfg
+        from raft_tpu.state import slim_state
+
+        # the carry lives in the slim storage dtypes from birth so every
+        # run() call presents one jit signature (no fat->slim recompile)
+        self.state = slim_state(
+            init_state(self.shape, ids, peers, is_learner, seed=seed, cfg=lane_cfg)
         )
-        self.fab = empty_fabric(n, n_voters, self.shape.max_msg_entries)
+        self.fab = slim_fabric(empty_fabric(n, n_voters, self.shape.max_msg_entries))
         self.mute = jnp.zeros((n,), BOOL)
 
     # -- driving ----------------------------------------------------------
@@ -1170,18 +1234,7 @@ class FusedCluster:
     def ops(self, **kw) -> LocalOps:
         """Build a LocalOps with the given per-lane columns set. Values may
         be dicts {lane: value} or full arrays."""
-        import numpy as np
-
-        n = self.state.id.shape[0]
-        base = {f: np.zeros((n,), np.bool_ if f in ("hup", "forget") else np.int32)
-                for f in LocalOps._fields}
-        for k, val in kw.items():
-            if isinstance(val, dict):
-                for lane, x in val.items():
-                    base[k][lane] = x
-            else:
-                base[k][:] = val
-        return LocalOps(**{k: jnp.asarray(x) for k, x in base.items()})
+        return make_local_ops(self.state.id.shape[0], **kw)
 
     def campaign(self, lane: int):
         self.run(1, ops=self.ops(hup={lane: True}), do_tick=False)

@@ -48,6 +48,7 @@ import numpy as np
 
 from raft_tpu import confchange as ccm
 from raft_tpu.ops import progress as pg
+from raft_tpu.ops import step as st
 from raft_tpu.state import RaftState
 from raft_tpu.types import ProgressState, StateType
 
@@ -108,19 +109,17 @@ def install_config(
         state, is_learner=jnp.where(lane_mask, self_learner, state.is_learner)
     )
     # StepDownOnRemoval (raft.go:1930-1936): a leader removed or demoted
-    # steps down to follower at its own term
+    # steps down to follower at its own term via the full becomeFollower
+    # reset (raft.go:864-871 -> reset:760-790) — heartbeat counter, fresh
+    # randomized timeout, vote/ack/readOnly bookkeeping all cleared, not
+    # just the three headline fields
     step_down = (
         lane_mask
         & state.cfg.step_down_on_removal
         & (state.state == StateType.LEADER)
         & (~self_voter | self_learner)
     )
-    state = dataclasses.replace(
-        state,
-        state=jnp.where(step_down, jnp.int32(StateType.FOLLOWER), state.state),
-        lead=jnp.where(step_down, 0, state.lead),
-        election_elapsed=jnp.where(step_down, 0, state.election_elapsed),
-    )
+    state = st.become_follower(state, step_down, state.term, jnp.int32(0))
     # abort a pending transfer to a now-untracked transferee
     # (raft.go:1945-1948: abortLeaderTransfer if transferee was removed)
     tr = state.lead_transferee
@@ -130,7 +129,11 @@ def install_config(
         state,
         lead_transferee=jnp.where(step_down | tr_gone, 0, state.lead_transferee),
     )
-    return state
+    # keep the carry diet invariant: a state installed mid-run must present
+    # the same dtypes the fused scan carries (state.STATE_SLIM)
+    from raft_tpu.state import slim_state
+
+    return slim_state(state)
 
 
 class FusedConfChanger:

@@ -186,6 +186,53 @@ class RaftState:
         return index & (w - 1)
 
 
+# --------------------------------------------------------------------------
+# Carry diet: narrow on-HBM dtypes for enum/counter fields.
+#
+# All round *compute* stays int32 (TPU-native); these narrow types exist only
+# at storage boundaries — the lax.scan carry between fused rounds and the
+# resident state of idle blocks — where HBM footprint, not ALU width, is what
+# bounds how many groups fit one chip (BASELINE config 5: 1M resident
+# groups). Values round-trip exactly: every slimmed field is a small enum or
+# a bounded counter (bounds asserted in make_lane_config / Shape).
+#
+# reference scaling intent: tracker/inflights.go:83-85 sizes for "thousands
+# of Raft groups per process"; this is that frugality taken to tensor form.
+
+STATE_SLIM = {
+    "state": jnp.int8,  # StateType 0..3
+    "votes": jnp.int8,  # VoteState 0..2
+    "pr_state": jnp.int8,  # ProgressState 0..2
+    "log_type": jnp.int8,  # EntryType 0..2
+    "election_elapsed": jnp.int16,  # < 2*election_tick (<= 2^14 asserted)
+    "heartbeat_elapsed": jnp.int16,
+    "randomized_election_timeout": jnp.int16,
+    "infl_start": jnp.int8,  # < max_inflight (<= 64 asserted in Shape use)
+    "infl_count": jnp.int8,
+    "rs_count": jnp.int8,  # <= max_read_index
+}
+
+
+def _cast_fields(obj, dtype_map, widen: bool):
+    upd = {}
+    for f, dt in dtype_map.items():
+        x = getattr(obj, f)
+        target = jnp.int32 if widen else dt
+        if x.dtype != target:
+            upd[f] = x.astype(target)
+    return dataclasses.replace(obj, **upd) if upd else obj
+
+
+def slim_state(state: "RaftState") -> "RaftState":
+    """Cast the dieted fields to their narrow storage dtypes (idempotent)."""
+    return _cast_fields(state, STATE_SLIM, widen=False)
+
+
+def fat_state(state: "RaftState") -> "RaftState":
+    """Restore all dieted fields to int32 for round compute (idempotent)."""
+    return _cast_fields(state, STATE_SLIM, widen=True)
+
+
 def make_lane_config(shape: Shape, **overrides) -> LaneConfig:
     n = shape.n
 
@@ -216,6 +263,12 @@ def make_lane_config(shape: Shape, **overrides) -> LaneConfig:
     for k in ("election_tick", "heartbeat_tick"):
         if not bool(np.all(np.asarray(defaults[k]) >= 1)):
             raise ValueError(f"{k} must be >= 1 for every lane")
+    # the slim carry stores tick counters as int16 (STATE_SLIM): the
+    # randomized timeout is < 2*election_tick and heartbeat_elapsed resets
+    # at heartbeat_tick, so 2^14 keeps headroom for both
+    for k in ("election_tick", "heartbeat_tick"):
+        if not bool(np.all(np.asarray(defaults[k]) <= 1 << 14)):
+            raise ValueError(f"{k} must be <= 16384 (int16 carry diet)")
     return LaneConfig(**defaults)
 
 

@@ -1,0 +1,129 @@
+"""The carry diet (state.STATE_SLIM / fused.FABRIC_SLIM) and the multi-block
+scheduler (scheduler.BlockedFusedCluster).
+
+The diet must be *storage-only*: narrowing the scan carry to int8/int16 enums
+and counters cannot change a single decision, because all round compute
+widens back to int32. The differential test below replays the exact same
+workload through an un-dieted python loop of fused_round calls and demands
+bit-identical state. (The serial-vs-fused differential suites in
+test_fused_invariants.py cover the same property against the reference
+semantics.)
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.ops.fused import (
+    FusedCluster,
+    empty_fabric,
+    fused_round,
+    no_ops,
+    route_fabric,
+)
+from raft_tpu.scheduler import BlockedFusedCluster
+from raft_tpu.state import STATE_SLIM, fat_state, init_state, slim_state
+
+
+def _fat_reference(g, v, seed, rounds, **round_kw):
+    """The pre-diet semantics: a python loop of fat fused_round calls."""
+    c = FusedCluster(g, v, seed=seed)
+    state = fat_state(c.state)
+    fab = empty_fabric(g * v, v, c.shape.max_msg_entries)
+    mute = c.mute
+    step = jax.jit(
+        lambda st, f: fused_round(
+            st, route_fabric(f, v, mute), no_ops(g * v), mute, **round_kw
+        ),
+        static_argnames=(),
+    )
+    for _ in range(rounds):
+        state, fab = step(state, fab)
+    return state
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_slim_carry_bit_identical(seed):
+    g, v, rounds = 4, 3, 60
+    c = FusedCluster(g, v, seed=seed)
+    c.run(rounds, auto_propose=True)
+    ref = _fat_reference(g, v, seed, rounds, do_tick=True, auto_propose=True)
+
+    got = fat_state(c.state)
+    for f in dataclasses.fields(got):
+        if f.name == "cfg":
+            continue
+        a, b = np.asarray(getattr(got, f.name)), np.asarray(getattr(ref, f.name))
+        np.testing.assert_array_equal(a, b, err_msg=f"field {f.name} diverged")
+
+
+def test_slim_dtypes_stable_across_runs():
+    c = FusedCluster(2, 3, seed=5)
+    for f, dt in STATE_SLIM.items():
+        assert getattr(c.state, f).dtype == dt, f"init not slim: {f}"
+    c.run(10)
+    for f, dt in STATE_SLIM.items():
+        assert getattr(c.state, f).dtype == dt, f"run widened: {f}"
+    # fabric kinds stay narrow too
+    assert c.fab.rep.kind.dtype == jnp.int8
+    assert c.fab.self_.kind.dtype == jnp.int8
+
+
+def test_slim_roundtrip_exact():
+    shape_ids = np.array([1, 2, 3], np.int32)
+    peers = np.tile(np.array([[1, 2, 3, 0]], np.int32), (3, 1))
+    from raft_tpu.config import Shape
+
+    st = init_state(Shape(n_lanes=3, max_peers=4), shape_ids, peers)
+    st2 = fat_state(slim_state(st))
+    for f in STATE_SLIM:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st, f)), np.asarray(getattr(st2, f))
+        )
+
+
+# --------------------------------------------------------------------------
+# BlockedFusedCluster
+
+
+def test_blocked_elects_and_commits():
+    c = BlockedFusedCluster(8, 3, block_groups=4, seed=2)
+    assert c.k == 2 and len(c.blocks) == 2
+    for _ in range(6):
+        c.run(20, auto_propose=True, auto_compact_lag=4)
+        if c.leader_count() == 8:
+            break
+    assert c.leader_count() == 8, "every group across blocks elects a leader"
+    before = c.total_committed()
+    c.run(20, auto_propose=True, auto_compact_lag=4)
+    assert c.total_committed() > before, "blocks keep committing"
+    c.check_no_errors()
+
+
+def test_blocked_global_lane_ops_routing():
+    """A hup injected at a *global* lane lands in the right block."""
+    c = BlockedFusedCluster(4, 3, block_groups=2, seed=9)
+    # global lane 8 = block 1, local lane 2 (group 2's voter 3... lane
+    # layout: block 1 owns global lanes 6..11)
+    target = 7  # block 1, local lane 1
+    c.run(1, ops=c.ops(hup={target: True}), do_tick=False)
+    c.run(2, do_tick=False)
+    lanes = c.leader_lanes()
+    assert target in lanes, f"leader lanes {lanes}"
+    # the other block held no election
+    assert all(l >= 6 for l in lanes)
+
+
+def test_blocked_one_compiled_program():
+    """All blocks share one jit cache entry for the fused kernel."""
+    from raft_tpu.ops import fused as fz
+
+    fz._fused_rounds_jit.clear_cache()
+    c = BlockedFusedCluster(4, 3, block_groups=2, seed=4)
+    c.run(3, auto_propose=True)
+    c.block_until_ready()
+    sizes = fz._fused_rounds_jit._cache_size()
+    assert sizes == 1, f"expected one compiled program, got {sizes}"

@@ -1,0 +1,296 @@
+"""Ports of the reference's unstable-log unit tier
+(/root/reference/log_unstable_test.go) onto the merged circular window.
+
+The engine has no separate `unstable` object: the window IS the merged
+raftLog/unstable/Storage view (ops/log.py docstring), so the reference's
+fields map to cursors:
+
+  unstable.offset             -> state.stabled + 1
+  unstable.entries            -> window slice (stabled, last]
+  unstable.offsetInProgress   -> RawNodeBatch._inprog + 1 (async mode only)
+  unstable.snapshot           -> pending_snap_index/_term (staged restore)
+  unstable.snapshotInProgress -> accepted Ready carrying rd.snapshot (async)
+
+Port map (reference file:line -> test below):
+  TestUnstableMaybeFirstIndex   log_unstable_test.go:26  -> test_maybe_first_index
+  TestMaybeLastIndex            log_unstable_test.go:70  -> test_maybe_last_index
+  TestUnstableMaybeTerm         log_unstable_test.go:115 -> test_maybe_term
+  TestUnstableRestore           log_unstable_test.go:194 -> test_restore_resets_window_and_inprog
+  TestUnstableNextEntries       log_unstable_test.go:213 -> test_next_entries_skip_in_progress
+  TestUnstableNextSnapshot      log_unstable_test.go:252 -> test_next_snapshot_gating
+  TestUnstableAcceptInProgress  log_unstable_test.go:289 -> test_accept_in_progress
+  TestUnstableStableTo          log_unstable_test.go:407 -> test_stable_to_table
+  TestUnstableTruncateAndAppend log_unstable_test.go:504 -> test_truncate_and_append_table,
+                                                            test_truncate_rewinds_in_progress
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.api.rawnode import Entry, Message, Snapshot
+from raft_tpu.config import Shape
+from raft_tpu.ops import log as lg
+from raft_tpu.state import init_state
+from raft_tpu.types import MessageType as MT
+from tests.test_log import SHAPE, arr2, ents, lane0, mk
+from tests.test_rawnode import make_group
+
+
+def mku(terms, offset, snap=None, stabled=None):
+    """A lane whose unstable tail starts at `offset` (reference table shape):
+    entries hold the given terms at indexes offset..offset+len-1, everything
+    below offset-1 is stable, snapshot = (index, term) when staged."""
+    snap_index, snap_term = snap if snap else (offset - 1, 0)
+    st = mk(
+        list(terms),
+        snap_index=offset - 1,
+        snap_term=snap_term if snap else 0,
+        stabled=offset - 1 if stabled is None else stabled,
+    )
+    return st
+
+
+# -- maybeFirstIndex (log_unstable_test.go:26) ------------------------------
+
+
+def test_maybe_first_index():
+    # no snapshot: the unstable tail alone never defines firstIndex — the
+    # merged view falls through to the stable prefix / compaction point
+    st = mku([1], offset=5)
+    assert lane0(st.first_index) == 5  # merged: snap_index(4) + 1
+    # with a snapshot (4, 1): firstIndex = 5 (reference cases 3, 4)
+    st = mku([1], offset=5, snap=(4, 1))
+    assert lane0(st.first_index) == 5
+    st = mku([], offset=5, snap=(4, 1))
+    assert lane0(st.first_index) == 5
+
+
+# -- maybeLastIndex (log_unstable_test.go:70) -------------------------------
+
+
+def test_maybe_last_index():
+    # last in entries
+    st = mku([1], offset=5)
+    assert lane0(st.last) == 5
+    st = mku([1], offset=5, snap=(4, 1))
+    assert lane0(st.last) == 5
+    # last in snapshot (empty tail)
+    st = mku([], offset=5, snap=(4, 1))
+    assert lane0(st.last) == 4
+    # empty unstable, empty log
+    st = mku([], offset=1)
+    assert lane0(st.last) == 0
+
+
+# -- maybeTerm (log_unstable_test.go:115) -----------------------------------
+
+
+def test_maybe_term():
+    one = mku([1], offset=5)  # entries [{5, t1}], no snapshot
+    one_s = mku([1], offset=5, snap=(4, 1))  # + snapshot (4, 1)
+    none_s = mku([], offset=5, snap=(4, 1))  # snapshot only
+    empty = mku([], offset=1)
+    cases = [
+        (one, 5, 1),  # term from entries
+        (one, 6, 0),  # above last: unknown
+        (one, 4, 0),  # below offset, no snapshot: unknown
+        (one_s, 5, 1),
+        (one_s, 6, 0),
+        (one_s, 4, 1),  # term from snapshot point
+        (one_s, 3, 0),  # below snapshot: compacted, unknown
+        (none_s, 5, 0),
+        (none_s, 4, 1),
+        (empty, 5, 0),
+    ]
+    for i, (st, idx, want) in enumerate(cases):
+        assert lane0(lg.term_at(st, arr2(idx))) == want, (i, idx, want)
+
+
+# -- restore (log_unstable_test.go:194) -------------------------------------
+
+
+def _async_follower():
+    """A 2-voter group; lane 1 is an async-storage follower driven by
+    hand-built messages from 'leader' id 2 (the reference tables poke the
+    struct directly; here the message layer is the struct's public face)."""
+    b = make_group(2)
+    b.set_async_storage_writes(1, True)
+    return b
+
+
+def _app(term, prev_index, prev_term, entries, commit=0):
+    return Message(
+        type=int(MT.MSG_APP), to=2, frm=1, term=term,
+        index=prev_index, log_term=prev_term, commit=commit,
+        entries=entries,
+    )
+
+
+def test_restore_resets_window_and_inprog():
+    """reference: log_unstable_test.go:194 — restore(s) resets offset and
+    offsetInProgress to s.Index+1, drops entries, un-marks snapshotInProgress
+    for the new snapshot."""
+    b = _async_follower()
+    # entries {5,t1}-analog: deliver an append, accept its Ready so the
+    # entries are in progress (offsetInProgress = 6-analog)
+    b.step(1, _app(1, 0, 0, [Entry(1, 1, data=b"a")]))
+    rd = b.ready(1)
+    assert [e.index for e in rd.entries] == [1]
+    assert b._inprog[1] == 1
+    # restore: a snapshot at (6, 2) arrives
+    b.step(1, Message(
+        type=int(MT.MSG_SNAP), to=2, frm=1, term=2,
+        snapshot=Snapshot(index=6, term=2, voters=(1, 2)),
+    ))
+    v = b.view
+    assert int(v.last[1]) == 6  # offset-analog: (stabled, last] is empty
+    assert int(v.pending_snap_index[1]) == 6
+    assert b._inprog[1] == 0, "offsetInProgress reset on restore"
+    rd = b.ready(1)
+    assert rd.snapshot is not None and rd.snapshot.index == 6
+    assert rd.entries == []
+
+
+# -- nextEntries / acceptInProgress (log_unstable_test.go:213, 289) ---------
+
+
+def test_next_entries_skip_in_progress():
+    b = _async_follower()
+    # two entries, nothing in progress -> both emitted
+    b.step(1, _app(1, 0, 0, [Entry(1, 1, data=b"a"), Entry(1, 2, data=b"b")]))
+    rd = b.ready(1)
+    assert [e.index for e in rd.entries] == [1, 2]
+    # everything in progress -> nothing emitted
+    b.step(1, Message(type=int(MT.MSG_HEARTBEAT), to=2, frm=1, term=1))
+    rd2 = b.ready(1)
+    assert rd2.entries == []
+    # partially in progress: a third entry arrives -> only it is emitted
+    b.step(1, _app(1, 2, 1, [Entry(1, 3, data=b"c")]))
+    rd3 = b.ready(1)
+    assert [e.index for e in rd3.entries] == [3]
+
+
+def test_accept_in_progress():
+    """reference: log_unstable_test.go:289 — accepting a Ready advances
+    offsetInProgress past its entries and marks the snapshot in progress."""
+    b = _async_follower()
+    b.step(1, _app(1, 0, 0, [Entry(1, 1), Entry(1, 2)]))
+    assert b._inprog[1] == 0  # nothing accepted yet
+    b.ready(1)
+    assert b._inprog[1] == 2  # woffsetInProgress 7-analog (both entries)
+    # accepting again with no new entries leaves it alone
+    b.step(1, Message(type=int(MT.MSG_HEARTBEAT), to=2, frm=1, term=1))
+    b.ready(1)
+    assert b._inprog[1] == 2
+
+
+def test_next_snapshot_gating():
+    """reference: log_unstable_test.go:252 — a staged snapshot is emitted
+    until accepted (in progress), then withheld."""
+    b = _async_follower()
+    b.step(1, Message(
+        type=int(MT.MSG_SNAP), to=2, frm=1, term=2,
+        snapshot=Snapshot(index=4, term=1, voters=(1, 2)),
+    ))
+    rd = b.ready(1, peek=True)
+    assert rd.snapshot is not None and rd.snapshot.index == 4
+    rd = b.ready(1)  # accept: snapshot now in progress
+    assert rd.snapshot is not None
+    rd2 = b.ready(1, peek=True)
+    assert rd2.snapshot is None, "in-progress snapshot must not re-emit"
+
+
+# -- stableTo (log_unstable_test.go:407) ------------------------------------
+
+
+def test_stable_to_table():
+    """All 13 reference cases, expressed as (state, ack index, ack term) ->
+    expected stabled cursor (= woffset - 1) and unstable length (= wlen).
+    offsetInProgress rows collapse here (tracked host-side, tested above)."""
+    s41 = (4, 1)
+    s51 = (5, 1)
+    s42 = (4, 2)
+    cases = [
+        # (terms, offset, snap, ack_idx, ack_term, woffset, wlen)
+        ([], 1, None, 5, 1, 1, 0),  # empty: no-op
+        ([1], 5, None, 5, 1, 6, 0),  # stable to the first entry
+        ([1, 1], 5, None, 5, 1, 6, 1),
+        ([1, 1], 5, None, 5, 1, 6, 1),  # (in-progress variant collapses)
+        ([2], 6, None, 6, 1, 6, 1),  # term mismatch: ABA, no-op
+        ([1], 5, None, 4, 1, 5, 1),  # stable to old entry: no-op
+        ([1], 5, None, 4, 2, 5, 1),
+        ([1], 5, s41, 5, 1, 6, 0),  # with snapshot
+        ([1, 1], 5, s41, 5, 1, 6, 1),
+        ([1, 1], 5, s41, 5, 1, 6, 1),
+        ([2], 6, s51, 6, 1, 6, 1),  # term mismatch with snapshot
+        ([1], 5, s41, 4, 1, 5, 1),  # stable to snapshot point: no-op
+        ([2], 5, s42, 4, 1, 5, 1),  # stable to old entry below snapshot
+    ]
+    for i, (terms, off, snap, idx, term, woff, wlen) in enumerate(cases):
+        st = mku(terms, offset=off, snap=snap)
+        st2 = lg.stable_to(st, arr2(idx), arr2(term))
+        got_off = lane0(st2.stabled) + 1
+        got_len = lane0(st2.last) - lane0(st2.stabled)
+        assert (got_off, got_len) == (woff, wlen), (
+            i, terms, off, snap, idx, term, (got_off, got_len), (woff, wlen)
+        )
+
+
+# -- truncateAndAppend (log_unstable_test.go:504) ---------------------------
+
+
+def test_truncate_and_append_table():
+    """The 9 reference cases on the window append (ops/log.py append): the
+    result entry terms and the stabled rollback (= woffset - 1). Cases whose
+    offset moves below the original (case 4) build the stable prefix in the
+    window instead of in Storage — same merged result."""
+
+    def run(terms, offset, toappend, stabled=None):
+        # window content: stable filler term-9 entries below `offset`, then
+        # the unstable tail
+        full = [9] * (offset - 1) + list(terms)
+        st = mk(full, stabled=offset - 1 if stabled is None else stabled)
+        at, ty, by, n = ents([t for _, t in toappend])
+        prev = toappend[0][0] - 1
+        st2 = lg.append(st, arr2(prev), at, ty, by, n)
+        got_terms = [
+            lane0(lg.term_at(st2, arr2(i)))
+            for i in range(offset, lane0(st2.last) + 1)
+        ]
+        return st2, got_terms
+
+    # 1) append to the end
+    st, terms = run([1], 5, [(6, 1), (7, 1)])
+    assert terms == [1, 1, 1] and lane0(st.stabled) + 1 == 5
+    # 3) replace the unstable entries
+    st, terms = run([1], 5, [(5, 2), (6, 2)])
+    assert terms == [2, 2] and lane0(st.stabled) + 1 == 5
+    # 4) replace reaching below offset: offset moves down to 4
+    st, terms = run([1], 5, [(4, 2), (5, 2), (6, 2)])
+    assert lane0(st.stabled) + 1 == 4
+    assert [lane0(lg.term_at(st, arr2(i))) for i in range(4, 7)] == [2, 2, 2]
+    # 6) truncate inside and append
+    st, terms = run([1, 1, 1], 5, [(6, 2)])
+    assert terms == [1, 2] and lane0(st.stabled) + 1 == 5
+    # 7) append exactly at the tail end after truncation point
+    st, terms = run([1, 1, 1], 5, [(7, 2), (8, 2)])
+    assert terms == [1, 1, 2, 2] and lane0(st.stabled) + 1 == 5
+
+
+def test_truncate_rewinds_in_progress():
+    """reference: log_unstable_test.go:504 cases 8-9 — a truncation below
+    offsetInProgress rewinds it to the truncation point, so the replaced
+    suffix is re-emitted by the next Ready (the ABA corner the async goldens
+    guard end-to-end; here the table-level check)."""
+    b = _async_follower()
+    b.step(1, _app(1, 0, 0, [Entry(1, 1), Entry(1, 2), Entry(1, 3)]))
+    b.ready(1)
+    assert b._inprog[1] == 3  # all three in progress
+    # a higher-term leader truncates at 2: entries {2,t2}
+    b.step(1, _app(2, 1, 1, [Entry(2, 2)]))
+    assert b._inprog[1] == 1, "offsetInProgress rewound to the truncation"
+    rd = b.ready(1)
+    # the replaced suffix re-emits from index 2 with the new term
+    assert [(e.index, e.term) for e in rd.entries] == [(2, 2)]
