@@ -20,7 +20,12 @@ import threading
 from typing import Callable
 
 from raft_tpu.api.rawnode import Message, RawNodeBatch, Ready
-from raft_tpu.types import MessageType as MT
+from raft_tpu.types import (
+    LOCAL_APPEND_THREAD,
+    LOCAL_APPLY_THREAD,
+    LOCAL_MSGS,
+    MessageType as MT,
+)
 
 
 class ErrStopped(Exception):
@@ -271,7 +276,14 @@ class Node:
     ):
         """Non-blocking for network messages (reference node.Step); pass
         wait=True for the stepWait contract on local proposals."""
-        if msg.type in (int(MT.MSG_HUP), int(MT.MSG_BEAT)):
+        if msg.type in LOCAL_MSGS and msg.frm not in (
+            LOCAL_APPEND_THREAD,
+            LOCAL_APPLY_THREAD,
+        ):
+            # reference: node.go:525-530 — local messages are silently
+            # ignored by node.Step; here we reject loudly so misuse of the
+            # tick/campaign/report_* APIs is visible. Storage-thread acks
+            # (async-storage mode) pass, as in rawnode.go:108-125.
             raise ValueError("cannot step raft local message")
         self.host._submit(
             "step", self.lane, msg, wait=wait, timeout=timeout, cancel=cancel

@@ -40,6 +40,7 @@ from raft_tpu.state import LaneConfig, RaftState, init_state, make_lane_config
 from raft_tpu.types import (
     LOCAL_APPEND_THREAD,
     LOCAL_APPLY_THREAD,
+    LOCAL_MSGS,
     EntryType,
     MessageType as MT,
     ProgressState,
@@ -473,6 +474,16 @@ class RawNodeBatch:
             if m.type == int(MT.MSG_READ_INDEX_RESP):
                 # the response is this ticket's final engine artifact
                 self._ctx_release(lane, ctx_ticket)
+                if m.to == int(self.view.id[lane]):
+                    # a locally-requested read resolves synchronously into
+                    # readStates, never onto the wire (reference:
+                    # raft.go:1318-1331, 2081-2097 responseToReadIndexReq
+                    # with req.From in {None, r.id}) — the very next Ready
+                    # carries it
+                    self._read_states[lane].append(
+                        ReadState(index=m.index, request_ctx=m.context)
+                    )
+                    continue
             ne = int(cols["n_ents"][lane, slot])
             if ne and m.type == int(MT.MSG_PROP):
                 # proposal forwarded to the leader: entries ride verbatim with
@@ -650,11 +661,13 @@ class RawNodeBatch:
     # -- public API (the RawNode method set, reference rawnode.go) ---------
 
     def step(self, lane: int, msg: Message):
-        """reference: rawnode.go:108-125 (rejects local message types)."""
-        if msg.type in (int(MT.MSG_HUP), int(MT.MSG_BEAT)) or msg.type in (
-            int(MT.MSG_STORAGE_APPEND),
-            int(MT.MSG_STORAGE_APPLY),
-        ):
+        """reference: rawnode.go:108-125 — every local message type is
+        rejected (ErrStepLocalMsg) unless it comes from a local storage
+        thread (MsgStorageAppendResp/MsgStorageApplyResp with From in
+        {LocalAppendThread, LocalApplyThread}); use tick()/campaign()/
+        report_unreachable()/report_snapshot() for the local inputs."""
+        local_target = msg.frm in (LOCAL_APPEND_THREAD, LOCAL_APPLY_THREAD)
+        if msg.type in LOCAL_MSGS and not local_target:
             raise ValueError(f"cannot step raft local message {msg.type}")
         if msg.type == int(MT.MSG_STORAGE_APPLY_RESP) and msg.entries:
             # the kernel's apply-ack convention: last applied index rides
@@ -717,13 +730,12 @@ class RawNodeBatch:
             not in (
                 int(MT.MSG_PROP),
                 int(MT.MSG_SNAP),
-                # local types take the per-message path so step() surfaces
-                # its ValueError contract (rawnode.go:108-125)
-                int(MT.MSG_HUP),
-                int(MT.MSG_BEAT),
-                int(MT.MSG_STORAGE_APPEND),
-                int(MT.MSG_STORAGE_APPLY),
             )
+            # every local type takes the per-message path so step() applies
+            # the full rawnode.go:108-125 filter (ValueError for local
+            # messages unless from a storage thread) instead of the batched
+            # fast lane silently applying e.g. a forged MsgStorageApplyResp
+            and msg.type not in LOCAL_MSGS
         )
 
     def step_many(self, steps, on_drop=None):
@@ -1140,6 +1152,95 @@ class RawNodeBatch:
     # -- restart/recovery (reference: node.go:281-289 RestartNode,
     # raft.go:432-477 newRaft from Storage, doc.go:46-67) ------------------
 
+    def bootstrap_lane(self, lane: int, peers, contexts: dict | None = None):
+        """The reference's `StartNode(c, peers)` bootstrap (reference:
+        bootstrap.go:30-80 via node.go:271-279): on an EMPTY lane, become
+        follower at term 1, synthesize one committed `ConfChangeAddNode`
+        entry per peer at indexes 1..k (term 1), and install the membership
+        so `campaign()` works immediately. The entries stay UNSTABLE and
+        `applied` stays 0, so the application observes every conf change in
+        the first Ready (its Entries, HardState{Term:1, Commit:k} and
+        CommittedEntries) and re-applies them through `apply_conf_change` —
+        the reference's deliberate double-add (bootstrap.go:63-71).
+
+        peers: iterable of raft ids; contexts: optional {id: bytes} riding
+        each ConfChange's Context (bootstrap.go:53)."""
+        from raft_tpu import confchange as ccm
+        from raft_tpu.state import draw_timeout
+
+        peers = list(peers)
+        if not peers:
+            raise ValueError("must provide at least one peer to bootstrap")
+        v = self.view
+        if int(v.last[lane]) or int(v.term[lane]) or int(v.snap_index[lane]):
+            raise ValueError("can't bootstrap a nonempty lane")
+        k = len(peers)
+        w = self.shape.w
+        if k > w - 1 or k > self.shape.v:
+            raise ValueError("too many bootstrap peers for the static shape")
+
+        # the synthesized entries (term 1, indexes 1..k), payloads host-side
+        log_term = np.zeros((w,), np.int32)
+        log_type = np.zeros((w,), np.int32)
+        log_bytes = np.zeros((w,), np.int32)
+        for i, pid in enumerate(peers):
+            cc = ccm.ConfChange(
+                type=int(ccm.ConfChangeType.ADD_NODE),
+                node_id=pid,
+                context=(contexts or {}).get(pid, b""),
+            )
+            data = ccm.encode(cc)
+            idx = i + 1
+            log_term[idx & (w - 1)] = 1
+            log_type[idx & (w - 1)] = int(EntryType.ENTRY_CONF_CHANGE)
+            log_bytes[idx & (w - 1)] = len(data)
+            self.store.put(
+                lane, Entry(1, idx, int(EntryType.ENTRY_CONF_CHANGE), data)
+            )
+
+        st = self.state
+        new_to = draw_timeout(
+            st.rng[lane][None], st.cfg.election_tick[lane][None]
+        )[0]
+        st = dataclasses.replace(
+            st,
+            # becomeFollower(1, None) (bootstrap.go:50)
+            term=st.term.at[lane].set(1),
+            vote=st.vote.at[lane].set(0),
+            lead=st.lead.at[lane].set(0),
+            state=st.state.at[lane].set(int(StateType.FOLLOWER)),
+            randomized_election_timeout=(
+                st.randomized_election_timeout.at[lane].set(new_to)
+            ),
+            log_term=st.log_term.at[lane].set(jnp.asarray(log_term)),
+            log_type=st.log_type.at[lane].set(
+                jnp.asarray(log_type).astype(st.log_type.dtype)
+            ),
+            log_bytes=st.log_bytes.at[lane].set(jnp.asarray(log_bytes)),
+            last=st.last.at[lane].set(k),
+            # unstable AND committed (bootstrap.go:73-75) — the first Ready
+            # both persists and applies them
+            stabled=st.stabled.at[lane].set(0),
+            committed=st.committed.at[lane].set(k),
+            applying=st.applying.at[lane].set(0),
+            applied=st.applied.at[lane].set(0),
+        )
+        self.state = st
+        self.view.refresh(st)
+
+        # applyConfChange per peer (bootstrap.go:76-78): progress.next lands
+        # after the bootstrap entries
+        cfg = ccm.TrackerConfig(voters_in=set(peers))
+        trk = {
+            pid: ccm.Progress(match=0, next=k + 1, is_learner=False)
+            for pid in peers
+        }
+        self._write_tracker(lane, cfg, trk)
+        # empty prevHardSt so the first Ready emits the bootstrap HardState
+        # (bootstrap.go:43-46)
+        self._prev_hs[lane] = HardState()
+        self._prev_ss[lane] = SoftState(0, int(StateType.FOLLOWER))
+
     def restart_lane(self, lane: int, storage, applied: int = 0):
         """Rebuild this lane from persisted state — the batched analog of
         `RestartNode`/`NewRawNode` reading `Storage.InitialState` + stored
@@ -1167,6 +1268,10 @@ class RawNodeBatch:
             raise ValueError(
                 f"hardstate commit {hs.commit} out of range [0, {last}]"
             )  # reference: raft.go:1972-1976 loadState panic
+        # the log's commit floor is the snapshot point even when the
+        # HardState is empty (reference: log.go:74-90 newLog starts
+        # committed at firstIndex-1; loadState only ever raises it)
+        hs = dataclasses.replace(hs, commit=max(hs.commit, snap_index))
         applied = max(applied, snap_index)
         if applied > hs.commit:
             raise ValueError(

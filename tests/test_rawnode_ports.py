@@ -1,0 +1,485 @@
+"""Ports of the uncited /root/reference/rawnode_test.go tests.
+
+Port map (reference rawnode_test.go:line -> test below):
+  TestRawNodeStep                    :77   -> test_step_rejects_local_messages
+  TestRawNodeProposeAndConfChange    :117  -> test_propose_and_conf_change (8 cases)
+  TestRawNodeJointAutoLeave          :384  -> test_joint_auto_leave_survives_leader_loss
+  TestRawNodeProposeAddDuplicateNode :523  -> test_propose_add_duplicate_node
+  TestRawNodeReadIndex               :599  -> test_read_index_surfaces_and_resets
+  TestRawNodeStart                   :670  -> test_start_from_bootstrap_snapshot
+  TestRawNodeRestart                 :792  -> (already ported: tests/test_restart.py
+                                              test_node_restart)
+  TestRawNodeRestartFromSnapshot     :823  -> test_restart_from_snapshot_ready_shape
+  TestRawNodeStatus                  :864  -> test_status_progress_only_on_leader
+  TestRawNodeCommitPaginationAfterRestart :913 -> test_commit_pagination_no_gaps
+  TestRawNodeConsumeReady            :1116 -> test_consume_ready_peek_vs_accept
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from raft_tpu import confchange as ccm
+from raft_tpu.api.rawnode import (
+    Entry,
+    HardState,
+    Message,
+    RawNodeBatch,
+    Snapshot,
+)
+from raft_tpu.config import Shape
+from raft_tpu.storage import MemoryStorage
+from raft_tpu.types import (
+    LOCAL_MSGS,
+    EntryType,
+    MessageType as MT,
+    StateType,
+)
+from tests.test_rawnode import drive, make_group
+
+
+# -- TestRawNodeStep (rawnode_test.go:77) -----------------------------------
+
+
+def test_step_rejects_local_messages():
+    for t in MT:
+        if t == MT.MSG_NONE:
+            continue
+        b = make_group(1)
+        msg = Message(type=int(t), to=1, frm=2)
+        if t in LOCAL_MSGS:
+            with pytest.raises(ValueError):
+                b.step(0, msg)
+            # ...unless it comes from a local storage thread
+            if t in (MT.MSG_STORAGE_APPEND_RESP, MT.MSG_STORAGE_APPLY_RESP):
+                b.step(0, dataclasses.replace(msg, frm=-1))
+        else:
+            try:
+                b.step(0, msg)
+            except Exception as e:  # ErrProposalDropped for MsgProp is fine
+                from raft_tpu.api.rawnode import ErrProposalDropped
+
+                assert isinstance(e, ErrProposalDropped), (t, e)
+
+
+# -- TestRawNodeProposeAndConfChange (rawnode_test.go:117) ------------------
+
+T = ccm.ConfChangeType
+TR = ccm.ConfChangeTransition
+CS = ccm.ConfState
+
+CC_CASES = [
+    # (cc, exp ConfState, exp2 ConfState-or-None)
+    (
+        ccm.ConfChange(type=int(T.ADD_NODE), node_id=2),
+        CS(voters=(1, 2)),
+        None,
+    ),
+    (
+        ccm.ConfChangeV2(changes=[ccm.ConfChangeSingle(int(T.ADD_NODE), 2)]),
+        CS(voters=(1, 2)),
+        None,
+    ),
+    (
+        ccm.ConfChangeV2(changes=[ccm.ConfChangeSingle(int(T.ADD_LEARNER_NODE), 2)]),
+        CS(voters=(1,), learners=(2,)),
+        None,
+    ),
+    (
+        ccm.ConfChangeV2(
+            changes=[ccm.ConfChangeSingle(int(T.ADD_LEARNER_NODE), 2)],
+            transition=int(TR.JOINT_EXPLICIT),
+        ),
+        CS(voters=(1,), voters_outgoing=(1,), learners=(2,)),
+        CS(voters=(1,), learners=(2,)),
+    ),
+    (
+        ccm.ConfChangeV2(
+            changes=[ccm.ConfChangeSingle(int(T.ADD_LEARNER_NODE), 2)],
+            transition=int(TR.JOINT_IMPLICIT),
+        ),
+        CS(voters=(1,), voters_outgoing=(1,), learners=(2,), auto_leave=True),
+        CS(voters=(1,), learners=(2,)),
+    ),
+    (
+        ccm.ConfChangeV2(changes=[
+            ccm.ConfChangeSingle(int(T.ADD_NODE), 2),
+            ccm.ConfChangeSingle(int(T.ADD_LEARNER_NODE), 1),
+            ccm.ConfChangeSingle(int(T.ADD_LEARNER_NODE), 3),
+        ]),
+        CS(voters=(2,), voters_outgoing=(1,), learners=(3,),
+           learners_next=(1,), auto_leave=True),
+        CS(voters=(2,), learners=(1, 3)),
+    ),
+    (
+        ccm.ConfChangeV2(
+            changes=[
+                ccm.ConfChangeSingle(int(T.ADD_NODE), 2),
+                ccm.ConfChangeSingle(int(T.ADD_LEARNER_NODE), 1),
+                ccm.ConfChangeSingle(int(T.ADD_LEARNER_NODE), 3),
+            ],
+            transition=int(TR.JOINT_EXPLICIT),
+        ),
+        CS(voters=(2,), voters_outgoing=(1,), learners=(3,), learners_next=(1,)),
+        CS(voters=(2,), learners=(1, 3)),
+    ),
+    (
+        ccm.ConfChangeV2(
+            changes=[
+                ccm.ConfChangeSingle(int(T.ADD_NODE), 2),
+                ccm.ConfChangeSingle(int(T.ADD_LEARNER_NODE), 1),
+                ccm.ConfChangeSingle(int(T.ADD_LEARNER_NODE), 3),
+            ],
+            transition=int(TR.JOINT_IMPLICIT),
+        ),
+        CS(voters=(2,), voters_outgoing=(1,), learners=(3,),
+           learners_next=(1,), auto_leave=True),
+        CS(voters=(2,), learners=(1, 3)),
+    ),
+]
+
+
+def _single_node():
+    """One-voter RawNodeBatch; lane 0, id 1 (newTestConfig(1, 10, 1, s))."""
+    return make_group(1)
+
+
+def _pump_until_applied_cc(b, cc, v1):
+    """Campaign, propose data + the conf change, Ready-loop until the typed
+    entry applies; returns (cs, entries_before_apply, ccdata)."""
+    b.campaign(0)
+    ccdata = ccm.encode(cc)
+    proposed = False
+    cs = None
+    log = []
+    for _ in range(40):
+        if cs is not None:
+            break
+        while b.has_ready(0):
+            rd = b.ready(0)
+            log.extend(rd.entries)
+            for ent in rd.committed_entries:
+                got = None
+                if ent.type == int(EntryType.ENTRY_CONF_CHANGE):
+                    got = ccm.decode(ent.data, v1=True)
+                elif ent.type == int(EntryType.ENTRY_CONF_CHANGE_V2):
+                    got = ccm.decode(ent.data, v1=False)
+                if got is not None and cs is None:
+                    cs = b.apply_conf_change(0, got)
+            b.advance(0)
+            if cs is not None:
+                break  # the reference's `for cs == nil` exits here
+            if not proposed and b.basic_status(0)["raft_state"] == "LEADER":
+                b.propose(0, b"somedata")
+                b.propose_conf_change(0, ccdata, v2=not v1)
+                proposed = True
+        if cs is not None:
+            break
+    assert cs is not None, "conf change never applied"
+    return cs, log, ccdata
+
+
+@pytest.mark.parametrize("case", range(len(CC_CASES)))
+def test_propose_and_conf_change(case):
+    cc, exp, exp2 = CC_CASES[case]
+    v1 = isinstance(cc, ccm.ConfChange)
+    b = _single_node()
+    cs, log, ccdata = _pump_until_applied_cc(b, cc, v1)
+
+    # the two proposed entries are bit-exact in the persisted log
+    datas = [(e.type, e.data) for e in log if e.index in (2, 3)]
+    want_type = int(
+        EntryType.ENTRY_CONF_CHANGE if v1 else EntryType.ENTRY_CONF_CHANGE_V2
+    )
+    assert datas == [
+        (int(EntryType.ENTRY_NORMAL), b"somedata"),
+        (want_type, ccdata),
+    ]
+    assert cs == exp, (cs, exp)
+
+    # pendingConfIndex: the applied change's index, +1 if auto-leave already
+    # appended its own entry
+    cc2 = cc.as_v2()
+    auto_leave, use_joint = cc2.enter_joint()
+    want_pci = 3 + (1 if (use_joint and auto_leave) else 0)
+    assert int(b.view.pending_conf_index[0]) == want_pci
+
+    if exp2 is None:
+        # simple change: nothing more appends
+        if b.has_ready(0):
+            rd = b.ready(0)
+            assert rd.entries == []
+            b.advance(0)
+        return
+
+    if not exp.auto_leave:
+        # leave joint manually with a ConfChangeV2 carrying context
+        context = b"manual"
+        leave = ccm.ConfChangeV2(context=context)
+        b.propose_conf_change(0, ccm.encode(leave), v2=True)
+    else:
+        context = b""
+    # the leave entry comes out of the next Readys
+    leave_ent = None
+    for _ in range(10):
+        if not b.has_ready(0):
+            break
+        rd = b.ready(0)
+        for e in rd.entries:
+            if e.type == int(EntryType.ENTRY_CONF_CHANGE_V2) and leave_ent is None:
+                if e.index > 3:
+                    leave_ent = e
+        b.advance(0)
+        if leave_ent:
+            break
+    assert leave_ent is not None, "no auto/manual leave entry appended"
+    got = ccm.decode(leave_ent.data, v1=False)
+    assert ccm.encode(got) == ccm.encode(ccm.ConfChangeV2(context=context))
+    # "lie and pretend it applied"
+    cs = b.apply_conf_change(0, got)
+    assert cs == exp2, (cs, exp2)
+
+
+# -- TestRawNodeJointAutoLeave (rawnode_test.go:384) ------------------------
+
+
+def test_joint_auto_leave_survives_leader_loss():
+    cc = ccm.ConfChangeV2(
+        changes=[ccm.ConfChangeSingle(int(T.ADD_LEARNER_NODE), 2)],
+        transition=int(TR.JOINT_IMPLICIT),
+    )
+    exp = CS(voters=(1,), voters_outgoing=(1,), learners=(2,), auto_leave=True)
+    exp2 = CS(voters=(1,), learners=(2,))
+    b = _single_node()
+    b.campaign(0)
+    ccdata = ccm.encode(cc)
+    proposed = False
+    cs = None
+    for _ in range(40):
+        if cs is not None:
+            break
+        while b.has_ready(0) and cs is None:
+            rd = b.ready(0)
+            for ent in rd.committed_entries:
+                if ent.type == int(EntryType.ENTRY_CONF_CHANGE_V2):
+                    # force a step-down before applying (the reference's
+                    # higher-term MsgHeartbeatResp)
+                    b.step(0, Message(
+                        type=int(MT.MSG_HEARTBEAT_RESP), to=1, frm=1,
+                        term=int(b.view.term[0]) + 1,
+                    ))
+                    cs = b.apply_conf_change(0, ccm.decode(ent.data, v1=False))
+            b.advance(0)
+            if not proposed and b.basic_status(0)["raft_state"] == "LEADER":
+                b.propose(0, b"somedata")
+                b.propose_conf_change(0, ccdata, v2=True)
+                proposed = True
+    assert cs == exp
+    assert b.basic_status(0)["raft_state"] == "FOLLOWER"
+    # follower: auto-leave armed but NOT proposed (raft.go:717-745)
+    assert int(b.view.pending_conf_index[0]) == 0
+    rd = b.ready(0, peek=True)
+    assert rd.entries == []
+    # re-elect; the auto-leave now appends
+    b.campaign(0)
+    leave_ent = None
+    for _ in range(10):
+        if not b.has_ready(0):
+            break
+        rd = b.ready(0)
+        for e in rd.entries:
+            if e.type == int(EntryType.ENTRY_CONF_CHANGE_V2):
+                leave_ent = e
+        b.advance(0)
+        if leave_ent:
+            break
+    assert leave_ent is not None
+    got = ccm.decode(leave_ent.data, v1=False)
+    assert ccm.encode(got) == ccm.encode(ccm.ConfChangeV2())
+    cs = b.apply_conf_change(0, got)
+    assert cs == exp2
+
+
+# -- TestRawNodeProposeAddDuplicateNode (rawnode_test.go:523) ---------------
+
+
+def test_propose_add_duplicate_node():
+    b = _single_node()
+    b.campaign(0)
+    drive(b)
+
+    applied_log = []
+
+    def propose_and_apply(cc_bytes):
+        b.propose_conf_change(0, cc_bytes, v2=False)
+        for _ in range(10):
+            if not b.has_ready(0):
+                break
+            rd = b.ready(0)
+            for ent in rd.committed_entries:
+                applied_log.append((ent.type, ent.data))
+                if ent.type == int(EntryType.ENTRY_CONF_CHANGE):
+                    b.apply_conf_change(0, ccm.decode(ent.data, v1=True))
+            b.advance(0)
+
+    cc1 = ccm.encode(ccm.ConfChange(type=int(T.ADD_NODE), node_id=1))
+    propose_and_apply(cc1)
+    propose_and_apply(cc1)  # duplicate add: applies harmlessly
+    cc2 = ccm.encode(ccm.ConfChange(type=int(T.ADD_NODE), node_id=2))
+    propose_and_apply(cc2)
+
+    ccs = [d for t, d in applied_log if t == int(EntryType.ENTRY_CONF_CHANGE)]
+    assert ccs == [cc1, cc1, cc2]
+    assert b.peer_ids(0, voters=True) == (1, 2)
+
+
+# -- TestRawNodeReadIndex (rawnode_test.go:599) -----------------------------
+
+
+def test_read_index_surfaces_and_resets():
+    b = _single_node()
+    b.campaign(0)
+    drive(b)
+    # issue a ReadIndex with a foreign byte context; single-voter leaders
+    # answer immediately via the rs ring
+    b.read_index(0, b"somedata2")
+    assert b.has_ready(0)
+    rd = b.ready(0)
+    assert [(rs.index, rs.request_ctx) for rs in rd.read_states] == [
+        (1, b"somedata2")
+    ]
+    b.advance(0)
+    # readStates reset after the Ready consumed them
+    rd = b.ready(0, peek=True)
+    assert rd.read_states == []
+
+
+# -- TestRawNodeStart (rawnode_test.go:670) ---------------------------------
+
+
+def test_start_from_bootstrap_snapshot():
+    """Bootstrap by persisting a ConfState snapshot at index 1 (the
+    CockroachDB pattern the reference test demonstrates), then campaign,
+    propose, and check the final applying Ready's exact shape."""
+    b = make_group(1)
+    storage = MemoryStorage()
+    storage.apply_snapshot(Snapshot(index=1, term=0, voters=(1,)))
+    b.restart_lane(0, storage, applied=1)
+    assert not b.has_ready(0)
+
+    b.campaign(0)
+    rd = b.ready(0)
+    b.advance(0)
+    b.propose(0, b"foo")
+    assert b.has_ready(0)
+    rd = b.ready(0)
+    assert [(e.term, e.index, e.data) for e in rd.entries] == [
+        (1, 2, b""), (1, 3, b"foo")
+    ]
+    b.advance(0)
+
+    assert b.has_ready(0)
+    rd = b.ready(0)
+    assert rd.entries == []
+    assert rd.must_sync is False  # only applying, not appending
+    assert rd.hard_state is not None and rd.hard_state.commit == 3
+    assert [(e.term, e.index, e.data) for e in rd.committed_entries] == [
+        (1, 2, b""), (1, 3, b"foo")
+    ]
+    b.advance(0)
+    assert not b.has_ready(0)
+
+
+# -- TestRawNodeRestartFromSnapshot (rawnode_test.go:823) -------------------
+
+
+def test_restart_from_snapshot_ready_shape():
+    b = make_group(2)
+    storage = MemoryStorage()
+    storage.apply_snapshot(Snapshot(index=2, term=1, voters=(1, 2)))
+    storage.set_hard_state(HardState(term=1, vote=0, commit=3))
+    storage.append([Entry(1, 3, data=b"foo")])
+    b.restart_lane(0, storage, applied=2)
+
+    rd = b.ready(0)
+    assert rd.hard_state is None  # no change vs the restored HardState
+    assert rd.entries == []
+    assert rd.must_sync is False
+    assert [(e.term, e.index, e.data) for e in rd.committed_entries] == [
+        (1, 3, b"foo")
+    ]
+    b.advance(0)
+    assert not b.has_ready(0)
+
+
+# -- TestRawNodeStatus (rawnode_test.go:864) --------------------------------
+
+
+def test_status_progress_only_on_leader():
+    b = _single_node()
+    st = b.status(0)
+    assert st.get("progress") in (None, {}), "no Progress when not leader"
+    b.campaign(0)
+    drive(b)
+    st = b.status(0)
+    assert st["lead"] == 1
+    assert st["raft_state"] == "LEADER"
+    pr = st["progress"][1]
+    assert pr["match"] == int(b.view.last[0])
+    assert pr["next"] == pr["match"] + 1
+    # config: single majority of {1}, no outgoing
+    assert st["config"]["voters"] == (1,)
+    assert st["config"]["voters_outgoing"] == ()
+
+
+# -- TestRawNodeCommitPaginationAfterRestart (rawnode_test.go:913) ----------
+
+
+def test_commit_pagination_no_gaps():
+    """The anomaly the reference guards: paginated committed-entry emission
+    across restart must never skip an index. Restart with 11 committed
+    entries and a budget that forces several pages; assert the applied
+    sequence is gapless and complete."""
+    entry_bytes = 8
+    b = make_group(1, max_committed_size_per_ready=3 * (entry_bytes + 10))
+    storage = MemoryStorage()
+    ents = [Entry(1, i, data=b"a" * entry_bytes) for i in range(1, 12)]
+    storage.append(ents)
+    storage.set_hard_state(HardState(term=1, vote=1, commit=11))
+    b.restart_lane(0, storage, applied=0)
+
+    applied = []
+    for _ in range(20):
+        if not b.has_ready(0):
+            break
+        rd = b.ready(0)
+        applied.extend(e.index for e in rd.committed_entries)
+        b.advance(0)
+    assert applied == list(range(1, 12)), applied
+
+
+# -- TestRawNodeConsumeReady (rawnode_test.go:1116) -------------------------
+
+
+def test_consume_ready_peek_vs_accept():
+    b = make_group(2)
+    # produce a real message: campaign emits a vote request to peer 2
+    b.campaign(0)
+    peek = b.ready(0, peek=True)
+    msgs1 = [m.type for m in peek.messages]
+    assert int(MT.MSG_VOTE) in msgs1, "expected the vote request to be visible"
+    # peek (readyWithoutAccept) leaves the messages in place
+    peek2 = b.ready(0, peek=True)
+    assert [m.type for m in peek2.messages] == msgs1
+    # Ready() consumes them exactly once
+    rd = b.ready(0)
+    assert [m.type for m in rd.messages] == msgs1
+    b.advance(0)
+    assert [m.type for m in b.ready(0, peek=True).messages] == []
+    # a message produced after the accept is not dropped by the advance:
+    # a higher-term heartbeat triggers a response
+    b.step(0, Message(type=int(MT.MSG_HEARTBEAT), to=1, frm=2,
+                      term=int(b.view.term[0]) + 1))
+    peek3 = b.ready(0, peek=True)
+    assert int(MT.MSG_HEARTBEAT_RESP) in [m.type for m in peek3.messages]

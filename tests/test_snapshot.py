@@ -199,7 +199,7 @@ def test_snapshot_failure():  # TestSnapshotFailure
         pr_state=[((0, 1), int(ProgressState.SNAPSHOT))],
         pr_pending_snapshot=[((0, 1), SNAP_IDX)],
     )
-    b.step(0, Message(type=int(MT.MSG_SNAP_STATUS), frm=2, to=1, reject=True))
+    b.report_snapshot(0, 2, ok=False)  # = Step(MsgSnapStatus, reject) inside raft
     assert int(b.view.pr_pending_snapshot[0, 1]) == 0
     assert int(b.view.pr_next[0, 1]) == 1
     assert bool(b.view.pr_msg_app_flow_paused[0, 1])
@@ -214,7 +214,7 @@ def test_snapshot_succeed():  # TestSnapshotSucceed
         pr_state=[((0, 1), int(ProgressState.SNAPSHOT))],
         pr_pending_snapshot=[((0, 1), SNAP_IDX)],
     )
-    b.step(0, Message(type=int(MT.MSG_SNAP_STATUS), frm=2, to=1, reject=False))
+    b.report_snapshot(0, 2, ok=True)  # = Step(MsgSnapStatus) inside raft
     assert int(b.view.pr_pending_snapshot[0, 1]) == 0
     assert int(b.view.pr_next[0, 1]) == SNAP_IDX + 1
     assert bool(b.view.pr_msg_app_flow_paused[0, 1])
