@@ -95,14 +95,24 @@ def config1_single_group_proposals(n_proposals=1000):
 
 def config2_1k_groups_heartbeat(n_groups=1024):
     """1k independent 3-voter groups, synchronized tick/heartbeat — the
-    batched-quorum steady state with no proposals."""
+    batched-quorum steady state with no proposals.
+
+    Small batches are dispatch-latency-bound on the tunnel (~130-400 ms per
+    call), so like config 1 the run rides long multi-round scans: one
+    dispatch covers 512 rounds, amortizing the tunnel cost to <1 ms/round
+    (the round-3 VERDICT's config-2 ask)."""
+    from raft_tpu.config import Shape
     from raft_tpu.ops.fused import FusedCluster
 
-    c = FusedCluster(n_groups, 3, seed=3)
+    shape = Shape(
+        n_lanes=n_groups * 3, max_peers=3, log_window=16,
+        max_msg_entries=2, max_inflight=2, max_read_index=2,
+    )
+    c = FusedCluster(n_groups, 3, seed=3, shape=shape)
     c.run(40)
     assert len(c.leader_lanes()) == n_groups
-    c.run(32)
-    iters, block = 10, 32
+    iters, block = 4, 512
+    c.run(block)  # compile + warm the timed program
     t0 = time.perf_counter()
     for _ in range(iters):
         c.run(block)
@@ -113,7 +123,8 @@ def config2_1k_groups_heartbeat(n_groups=1024):
         "2_1k_groups_sync_heartbeat",
         n_groups * iters * block / dt,
         "groups*ticks/s",
-        {"groups": n_groups, "round_ms": round(1000 * dt / (iters * block), 3)},
+        {"groups": n_groups, "round_ms": round(1000 * dt / (iters * block), 3),
+         "rounds_per_dispatch": block},
     )
 
 

@@ -1,0 +1,126 @@
+"""Cross-host bridge throughput: packed-frame msgs/s through the full
+pipeline (drain -> pack_frame -> pipe -> unpack_frame -> step_many).
+
+Workload: K spanning 3-voter groups, leaders on host A (lane i of A), both
+followers on host B; steady-state replication traffic (one proposal per
+group per round) flows A->B as ONE frame per round and the acks flow back
+as one frame. Prints a JSON line with msgs/s and bytes/s.
+
+Run: JAX_PLATFORMS=cpu python -m benches.bridge_bench [n_groups] [rounds]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main(n_groups: int = 64, rounds: int = 30):
+    from raft_tpu.api.rawnode import RawNodeBatch
+    from raft_tpu.config import Shape
+    from raft_tpu.runtime.bridge import BridgeEndpoint
+
+    # host A: lanes 0..K-1 = leader member (id 3g+1 of group g)
+    # host B: lanes 2g, 2g+1 = members 3g+2, 3g+3
+    a_local = {3 * g + 1: g for g in range(n_groups)}
+    b_local = {}
+    for g in range(n_groups):
+        b_local[3 * g + 2] = 2 * g
+        b_local[3 * g + 3] = 2 * g + 1
+
+    def mk(local, remote, n):
+        shape = Shape(n_lanes=n, max_peers=4)
+        ids = [0] * n
+        for nid, lane in local.items():
+            ids[lane] = nid
+        peers = np.zeros((n, shape.v), np.int32)
+        for nid, lane in local.items():
+            g = (nid - 1) // 3
+            peers[lane, :3] = [3 * g + 1, 3 * g + 2, 3 * g + 3]
+        return BridgeEndpoint(
+            RawNodeBatch(shape, ids, peers, election_tick=6), local, remote
+        )
+
+    ep_a = mk(a_local, {nid: "B" for nid in b_local}, n_groups)
+    ep_b = mk(b_local, {nid: "A" for nid in a_local}, 2 * n_groups)
+
+    def exchange():
+        moved = True
+        frames = msgs = byts = 0
+        while moved:
+            moved = False
+            for host, frame in ep_a.drain().items():
+                got = ep_b.codec.unpack_frame(frame)
+                frames += 1
+                msgs += len(got)
+                byts += len(frame)
+                ep_b.receive(frame)
+                moved = True
+            for host, frame in ep_b.drain().items():
+                got = ep_a.codec.unpack_frame(frame)
+                frames += 1
+                msgs += len(got)
+                byts += len(frame)
+                ep_a.receive(frame)
+                moved = True
+        return frames, msgs, byts
+
+    for g in range(n_groups):
+        ep_a.batch.campaign(g)
+    exchange()
+    n_leaders = sum(
+        ep_a.batch.basic_status(g)["raft_state"] == "LEADER"
+        for g in range(n_groups)
+    )
+    assert n_leaders == n_groups, f"{n_leaders}/{n_groups} elected"
+
+    # transport-layer throughput: pack -> unpack of a realistic 128-message
+    # frame (the DCN work per round), separated from the engine stepping
+    from raft_tpu.api.rawnode import Entry, Message
+    from raft_tpu.runtime import codec
+    from raft_tpu.types import MessageType as MT
+
+    sample = [
+        Message(type=int(MT.MSG_APP), to=2 + i, frm=1, term=3, index=7 + i,
+                log_term=2, commit=6, entries=[Entry(3, 8 + i, data=b"x" * 16)])
+        for i in range(128)
+    ]
+    t0 = time.perf_counter()
+    reps = 50
+    for _ in range(reps):
+        codec.unpack_frame(codec.pack_frame(sample))
+    dt_t = time.perf_counter() - t0
+    transport_msgs_s = reps * len(sample) / dt_t
+
+    total_msgs = total_bytes = total_frames = 0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for g in range(n_groups):
+            ep_a.batch.propose(g, b"x" * 16)
+        f, m, by = exchange()
+        total_frames += f
+        total_msgs += m
+        total_bytes += by
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "bridge_msgs_per_sec",
+        "value": round(total_msgs / dt, 1),
+        "unit": "msgs/s",
+        "extra": {
+            "groups": n_groups,
+            "rounds": rounds,
+            "frames": total_frames,
+            "msgs_per_frame": round(total_msgs / max(1, total_frames), 1),
+            "bytes_per_sec": round(total_bytes / dt, 1),
+            "transport_msgs_per_sec": round(transport_msgs_s, 1),
+            "commits": sum(len(v) for v in ep_b.committed.values()),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    args = [int(x) for x in sys.argv[1:]]
+    main(*args)

@@ -1,6 +1,13 @@
 """Per-lane throughput scaling probe: where does the per-lane cost grow as
 the resident group count rises? (BASELINE.md measured ~3x from 49k to 300k
-lanes in round 1.) Prints one JSON line per shape."""
+lanes in round 1.) Prints one JSON line per shape.
+
+Two ladders:
+  per-size programs (default): each rung compiles its own kernel.
+  PROBE_BLOCKED=1: every rung = K resident blocks of PROBE_BLOCK_GROUPS
+  groups stepped by ONE compiled kernel (scheduler.BlockedFusedCluster) —
+  a fresh session pays one compile for the whole ladder and reaches its
+  first north-star measurement in minutes (VERDICT r3 item 8)."""
 
 from __future__ import annotations
 
@@ -76,6 +83,65 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
     del c
 
 
+def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
+                    w=16, e=2):
+    from raft_tpu.config import Shape
+    from raft_tpu.scheduler import BlockedFusedCluster
+
+    f = int(os.environ.get("PROBE_INFLIGHT", min(8, e)))
+    r = int(os.environ.get("PROBE_READS", 2))
+    shape = Shape(
+        n_lanes=block_groups * n_voters, max_peers=n_voters, log_window=w,
+        max_msg_entries=e, max_inflight=f, max_read_index=r,
+    )
+    c = BlockedFusedCluster(
+        n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape
+    )
+    lag = min(8, w // 2)
+    t0 = time.perf_counter()
+    c.run(block, auto_propose=True, auto_compact_lag=lag)
+    c.block_until_ready()
+    compile_s = time.perf_counter() - t0  # ~0 after the first ladder rung
+    warm = 0
+    while c.leader_count() < n_groups and warm < 40 * 16:
+        c.run(block, auto_propose=True, auto_compact_lag=lag)
+        warm += block
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        c.run(block, auto_propose=True, auto_compact_lag=lag)
+        c.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    lanes = n_groups * n_voters
+    mem = {}
+    try:
+        ms = jax.local_devices()[0].memory_stats() or {}
+        mem = {
+            "hbm_in_use_gb": round(ms.get("bytes_in_use", 0) / 2**30, 2),
+            "hbm_peak_gb": round(ms.get("peak_bytes_in_use", 0) / 2**30, 2),
+        }
+    except Exception:
+        pass
+    print(
+        json.dumps(
+            {
+                "groups": n_groups,
+                "resident_blocks": c.k,
+                "block_groups": block_groups,
+                "voters": n_voters,
+                "lanes": lanes,
+                "round_ms": round(1000 * best / block, 3),
+                "groups_ticks_per_s": round(n_groups * block / best, 1),
+                "us_per_lane_round": round(1e6 * best / block / lanes, 2),
+                "compile_s": round(compile_s, 1),
+                **mem,
+            }
+        ),
+        flush=True,
+    )
+    del c
+
+
 if __name__ == "__main__":
     voters = int(os.environ.get("PROBE_VOTERS", 3))
     w = int(os.environ.get("PROBE_WINDOW", 16))
@@ -84,5 +150,13 @@ if __name__ == "__main__":
     shapes = os.environ.get(
         "PROBE_GROUPS", "4096,16384,65536,131072,262144"
     )
-    for g in [int(x) for x in shapes.split(",")]:
-        measure(g, voters, block=block, w=w, e=e)
+    if os.environ.get("PROBE_BLOCKED"):
+        bg = int(os.environ.get("PROBE_BLOCK_GROUPS", 65536))
+        for g in [int(x) for x in shapes.split(",")]:
+            if g % bg == 0:
+                measure_blocked(g, voters, bg, block=block, w=w, e=e)
+            else:
+                measure(g, voters, block=block, w=w, e=e)
+    else:
+        for g in [int(x) for x in shapes.split(",")]:
+            measure(g, voters, block=block, w=w, e=e)

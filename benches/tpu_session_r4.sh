@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-4 TPU measurement session: run everything in ONE session so numbers
+# are comparable (the tunnel varies ~2x across sessions). Appends JSON lines
+# to benches/results_r4.jsonl via tee so a crash loses nothing.
+set -x
+OUT=benches/results_r4.jsonl
+: > "$OUT"
+
+echo '# 1. headline: 1M groups resident as 16x64k blocks' | tee -a "$OUT"
+BENCH_ITERS=6 timeout 3000 python bench.py 2>>/tmp/tpu_r4.err | tee -a "$OUT"
+
+echo '# 2. bigger rounds-per-dispatch A/B (dispatch amortization)' | tee -a "$OUT"
+BENCH_ITERS=3 BENCH_BLOCK=128 timeout 3000 python bench.py 2>>/tmp/tpu_r4.err | tee -a "$OUT"
+
+echo '# 3. stretch: 524k x 7 voters as 8x64k blocks' | tee -a "$OUT"
+BENCH_GROUPS=524288 BENCH_BLOCK_GROUPS=65536 BENCH_VOTERS=7 BENCH_ITERS=3 \
+  timeout 3600 python bench.py 2>>/tmp/tpu_r4.err | tee -a "$OUT"
+
+echo '# 4. config 2 (1024 groups, long scans)' | tee -a "$OUT"
+timeout 1800 python -m benches.baseline_configs 2 2>>/tmp/tpu_r4.err | tee -a "$OUT"
+
+echo '# 5. WAL A/B with the engine-integrated stream, 131k x 3' | tee -a "$OUT"
+WAL_MODES=none,engine,sync timeout 3000 python -m benches.wal_ab 2>>/tmp/tpu_r4.err | tee -a "$OUT"
+
+echo '# 6. blocked scaling ladder: one compile serves all rungs' | tee -a "$OUT"
+PROBE_BLOCKED=1 PROBE_BLOCK_GROUPS=65536 PROBE_GROUPS=65536,131072,262144,524288,1048576 \
+  PROBE_READS=2 timeout 3600 python -m benches.scaling_probe 2>>/tmp/tpu_r4.err | tee -a "$OUT"
+
+echo '# session done' | tee -a "$OUT"

@@ -96,6 +96,17 @@ def run(mode: str, n_groups: int, n_voters: int, iters: int, block: int):
         delta = jax.device_get(prev)
         wal_bytes += sum(a.nbytes for a in delta)
         jax.block_until_ready(c.state.term)
+    elif mode == "engine":
+        # the built-in pipeline (FusedCluster.run(wal=...)): async D2H copy
+        # started at push, resolved one block behind
+        from raft_tpu.runtime.wal import WalStream
+
+        wal = WalStream()
+        for _ in range(iters):
+            c.run(block, auto_propose=True, auto_compact_lag=lag, wal=wal)
+        wal.flush()
+        jax.block_until_ready(c.state.term)
+        wal_bytes = wal.bytes
     else:
         raise ValueError(mode)
     dt = time.perf_counter() - t0
@@ -120,5 +131,5 @@ if __name__ == "__main__":
     v = int(os.environ.get("WAL_VOTERS", 3))
     iters = int(os.environ.get("WAL_ITERS", 8))
     block = int(os.environ.get("WAL_BLOCK", 16))
-    for mode in os.environ.get("WAL_MODES", "none,sync,async").split(","):
+    for mode in os.environ.get("WAL_MODES", "none,sync,async,engine").split(","):
         run(mode, g, v, iters, block)

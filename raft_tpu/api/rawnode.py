@@ -432,14 +432,20 @@ class RawNodeBatch:
             self._ctx_intern[lane].pop(b, None)
 
     def _inbox_one(self, lane: int, msg: Message) -> MsgBatch:
+        # assembled host-side in numpy and shipped as ONE transfer per
+        # column — per-field eager `.at[].set` device ops made this the
+        # serving path's hottest line (a dispatch per field per message)
         n, e = self.shape.n, self.shape.max_msg_entries
         base = empty_batch((n,), e)
         row = _msg_to_row(msg, e)
         upd = {}
         for f in dataclasses.fields(base):
             arr = getattr(base, f.name)
-            val = jnp.asarray(row[f.name], arr.dtype)
-            upd[f.name] = arr.at[lane].set(val)
+            col = np.zeros(arr.shape, arr.dtype)
+            if f.name == "type":
+                col[:] = int(MT.MSG_NONE)
+            col[lane] = np.asarray(row[f.name])
+            upd[f.name] = jnp.asarray(col)
         return MsgBatch(**upd)
 
     def _collect_out(
@@ -896,11 +902,45 @@ class RawNodeBatch:
     # -- Ready/Advance (reference: rawnode.go:141-200, 404-491) ------------
 
     def has_ready(self, lane: int) -> bool:
-        # pending after-append self-messages require an accept/advance cycle
-        # to be delivered (reference rawnode.go:450-472 checks msgsAfterAppend)
-        if self._after_append[lane]:
+        """The reference's cheap predicate set (rawnode.go:450-472) — NOT a
+        full Ready construction; this is the serving loop's poll and must
+        stay O(1). tests/test_rawnode.py::test_has_ready_matches_peek keeps
+        it equivalent to `ready(peek=True).contains_updates()`."""
+        if (
+            self._after_append[lane]
+            or self._msgs[lane]
+            or self._read_states[lane]
+        ):
             return True
-        return self.ready(lane, peek=True).contains_updates()
+        v = self.view
+        if int(v.rs_count[lane]):
+            return True
+        ss = SoftState(int(v.lead[lane]), int(v.state[lane]))
+        if ss != self._prev_ss[lane]:
+            return True
+        hs = HardState(
+            int(v.term[lane]), int(v.vote[lane]), int(v.committed[lane])
+        )
+        if hs != self._prev_hs[lane] and not hs.is_empty():
+            return True
+        is_async = self._async[lane]
+        last, stabled = int(v.last[lane]), int(v.stabled[lane])
+        ent_lo = (
+            max(stabled, min(self._inprog[lane], last)) if is_async else stabled
+        )
+        if last > ent_lo:
+            return True
+        raw_psi = int(v.pending_snap_index[lane])
+        if raw_psi and not (is_async and self._snap_inprog[lane] == raw_psi):
+            return True
+        if is_async:
+            lo = max(int(v.applied[lane]), self._applying[lane]) + 1
+            hi = min(int(v.committed[lane]), stabled)
+        else:
+            lo, hi = int(v.applied[lane]) + 1, int(v.committed[lane])
+        if raw_psi:
+            hi = lo - 1  # the staged snapshot must apply first
+        return hi >= lo
 
     def ready(self, lane: int, peek: bool = False) -> Ready:
         v = self.view

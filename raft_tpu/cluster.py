@@ -284,23 +284,49 @@ class Cluster:
         n_voters: int,
         shape: Shape | None = None,
         seed: int = 1,
+        group_ids=None,
         **cfg_overrides,
     ):
+        """group_ids: optional [G][V] table of distinct member ids per group
+        (reference ids are arbitrary uint64, raft.go:338-430; here the
+        delivery table is dense over [0, max_id], so ids must stay modest —
+        <= 2^20 enforced below. Truly sparse/huge id spaces ride the rank
+        re-canonicalization wrapper, ops/fused_ids.py, whose maps are
+        per-group dicts). Default: the canonical 1..V layout. With arbitrary
+        ids, delivery routes through the general sorted path."""
         self.g, self.v = n_groups, n_voters
         n = n_groups * n_voters
         self.shape = shape or Shape(n_lanes=n, max_peers=max(4, n_voters))
         if self.shape.n_lanes != n:
             raise ValueError("shape.n_lanes must equal groups*voters")
-        ids = np.tile(np.arange(1, n_voters + 1, dtype=np.int32), n_groups)
+        self.canonical = group_ids is None
+        if self.canonical:
+            group_ids = [list(range(1, n_voters + 1))] * n_groups
+        self.group_ids = [list(map(int, row)) for row in group_ids]
+        if len(self.group_ids) != n_groups or any(
+            len(r) != n_voters or len(set(r)) != n_voters or min(r) < 1
+            for r in self.group_ids
+        ):
+            raise ValueError("group_ids must be [G][V] distinct positive ids")
+        if max(max(r) for r in self.group_ids) > 1 << 20:
+            raise ValueError(
+                "ids above 2^20 would blow up the dense delivery table; "
+                "use ops/fused_ids.IdMappedFusedCluster for sparse id spaces"
+            )
+        ids = np.asarray(
+            [i for row in self.group_ids for i in row], np.int32
+        )
         peers = np.zeros((n, self.shape.v), np.int32)
-        peers[:, :n_voters] = np.arange(1, n_voters + 1, dtype=np.int32)[None, :]
+        for g, row in enumerate(self.group_ids):
+            peers[g * n_voters : (g + 1) * n_voters, :n_voters] = row
         cfg = make_lane_config(self.shape, **cfg_overrides)
         self.state = init_state(self.shape, ids, peers, seed=seed, cfg=cfg)
         self.group_of = jnp.repeat(jnp.arange(n_groups, dtype=I32), n_voters)
-        lane_of = np.full((n_groups, n_voters + 1), -1, np.int32)
-        for g in range(n_groups):
-            for vid in range(1, n_voters + 1):
-                lane_of[g, vid] = g * n_voters + (vid - 1)
+        max_id = max(max(r) for r in self.group_ids)
+        lane_of = np.full((n_groups, max_id + 1), -1, np.int32)
+        for g, row in enumerate(self.group_ids):
+            for j, vid in enumerate(row):
+                lane_of[g, vid] = g * n_voters + j
         self.lane_of = jnp.asarray(lane_of)
         # inbox capacity: a leader can address one lane with up to 2 fan-out
         # messages + self-ack + reply per step, and the batch-released
@@ -325,7 +351,7 @@ class Cluster:
             self.lane_of,
             m_in=self.m_in,
             do_tick=do_tick,
-            v=self.v,
+            v=self.v if self.canonical else None,
         )
         self._pending = jax.tree.map(lambda x: np.array(x), nxt)
         self.dropped += int(dropped)
@@ -343,7 +369,8 @@ class Cluster:
         inbox = jax.tree.map(jnp.asarray, self._pending)
         self.state, nxt, dropped = cluster_rounds(
             self.state, inbox, self.group_of, self.lane_of,
-            m_in=self.m_in, do_tick=do_tick, n_rounds=rounds, v=self.v,
+            m_in=self.m_in, do_tick=do_tick, n_rounds=rounds,
+            v=self.v if self.canonical else None,
         )
         self._pending = jax.tree.map(lambda x: np.array(x), nxt)
         self.dropped += int(dropped)

@@ -12,9 +12,15 @@ requires (doc.go:79-86; `RawNodeBatch.ready()` only surfaces messages the
 sync persist already covers) — and steps them into the destination host.
 
 Addressing: a global raft id space; each bridge member registers which ids
-it hosts and at which lane. Delivery between hosts is per-message here
-(clarity over throughput — cross-host groups are the rare tail; co-resident
-groups never touch the bridge).
+it hosts and at which lane.
+
+The DCN unit is a PACKED FRAME of messages per destination host
+(codec.pack_frame: u32 count + length-prefixed byte-exact raftpb messages),
+not a message: `HostBridge(wire=True)` moves whole frames between its
+in-process hosts, and `BridgeEndpoint` is one process's side of the same
+protocol over a real byte stream (socket/pipe standing in for DCN) — see
+tests/test_bridge_process.py for a genuine two-process spanning-group
+election + failover.
 """
 
 from __future__ import annotations
@@ -67,9 +73,7 @@ class HostBridge:
                 codec = _codec
 
         log = get_logger()
-        # group per destination host, preserving per-host order: each host
-        # steps its whole batch with amortized device dispatches
-        # (RawNodeBatch.step_many — the fan-in hot path shares dispatches)
+        # group per destination host, preserving per-host order
         per_host: dict[int, list] = {}
         for m in msgs:
             tgt = self._route.get(m.to)
@@ -80,10 +84,7 @@ class HostBridge:
                     m.type, m.to,
                 )
                 continue
-            h, lane = tgt
-            if codec is not None:
-                m = codec.unmarshal_message(codec.marshal_message(m))
-            per_host.setdefault(h, []).append((lane, m))
+            per_host.setdefault(tgt[0], []).append(m)
             self.delivered += 1
 
         def on_drop(lane, msg):
@@ -91,7 +92,16 @@ class HostBridge:
             self.delivered -= 1
 
         for h, batch in per_host.items():
-            self._hosts[h].step_many(batch, on_drop=on_drop)
+            if codec is not None:
+                # the DCN shape: ONE packed frame per destination host, the
+                # receiver unpacks and routes by m.to — not N marshal calls
+                # interleaved with N steps
+                batch = codec.unpack_frame(codec.pack_frame(batch))
+            # each host steps its whole batch with amortized device
+            # dispatches (RawNodeBatch.step_many, the fan-in hot path)
+            self._hosts[h].step_many(
+                [(self._route[m.to][1], m) for m in batch], on_drop=on_drop
+            )
 
     def pump(self, max_iters: int = 100, on_commit=None) -> int:
         """Drain every host's Ready output and deliver until quiescent (the
@@ -126,3 +136,77 @@ class HostBridge:
         for b in self._hosts:
             for lane in range(b.shape.n):
                 b.tick(lane)
+
+
+class BridgeEndpoint:
+    """One PROCESS's side of the cross-host protocol: a RawNodeBatch hosting
+    the local members of (possibly spanning) groups, draining Readys into
+    packed per-destination frames and stepping received frames. The byte
+    transport between endpoints is the application's (socket/pipe/DCN),
+    exactly as the reference prescribes (README.md:10-14).
+
+    local_ids: {raft id -> lane} served by this batch.
+    remote_ids: {raft id -> host key} for members living elsewhere; the host
+    key is opaque to the endpoint (it keys the frames returned by drain()).
+    """
+
+    def __init__(self, batch: RawNodeBatch, local_ids: dict, remote_ids: dict):
+        from raft_tpu.runtime import codec as _codec
+
+        self.batch = batch
+        self.local = dict(local_ids)
+        self.remote = dict(remote_ids)
+        self.codec = _codec
+        self.delivered = 0
+        self.dropped = 0
+        self.committed: dict[int, list] = {}
+
+    def drain(self) -> dict:
+        """Run the local Ready/advance loop to its fixed point; returns
+        {host key: frame bytes} of outbound traffic. Committed entries
+        accumulate in self.committed[lane] (persist-before-send holds: the
+        sync Ready only surfaces messages the persist already covers)."""
+        out: dict[object, list] = {}
+        b = self.batch
+        for _ in range(100):
+            moved = False
+            local_msgs = []
+            for lane in range(b.shape.n):
+                if not b.has_ready(lane):
+                    continue
+                rd = b.ready(lane)
+                for e in rd.committed_entries:
+                    self.committed.setdefault(lane, []).append(e)
+                b.advance(lane)
+                moved = True
+                for m in rd.messages:
+                    if m.to in self.local:
+                        local_msgs.append(m)
+                    elif m.to in self.remote:
+                        out.setdefault(self.remote[m.to], []).append(m)
+                    else:
+                        self.dropped += 1
+            if local_msgs:
+                self._step_local(local_msgs)
+            if not moved:
+                break
+        return {h: self.codec.pack_frame(ms) for h, ms in out.items()}
+
+    def receive(self, frame: bytes):
+        """Step one received frame into the local batch."""
+        msgs = self.codec.unpack_frame(frame)
+        self._step_local([m for m in msgs if m.to in self.local])
+
+    def _step_local(self, msgs):
+        def on_drop(lane, msg):
+            self.dropped += 1
+            self.delivered -= 1  # same convention as HostBridge.deliver
+
+        self.delivered += len(msgs)
+        self.batch.step_many(
+            [(self.local[m.to], m) for m in msgs], on_drop=on_drop
+        )
+
+    def tick_all(self):
+        for lane in self.local.values():
+            self.batch.tick(lane)

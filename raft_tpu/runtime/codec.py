@@ -118,6 +118,41 @@ def marshal_message(m: Message) -> bytes:
         cap = int(-n)
 
 
+# --------------------------------------------------------------------------
+# batch framing — the DCN unit is a PACKED frame of messages per destination
+# host, not a message (SURVEY §5.8: cross-host groups ship message batches).
+# Layout: u32le count, then per message u32le length + raftpb wire bytes.
+# (The per-message bytes stay byte-exact gogoproto, so a Go peer can split
+# the frame and unmarshal each message with pb.Message.Unmarshal.)
+
+
+def pack_frame(msgs) -> bytes:
+    import struct
+
+    parts = [struct.pack("<I", len(msgs))]
+    for m in msgs:
+        b = marshal_message(m)
+        parts.append(struct.pack("<I", len(b)))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def unpack_frame(data: bytes) -> list[Message]:
+    import struct
+
+    (count,) = struct.unpack_from("<I", data, 0)
+    off = 4
+    out = []
+    for _ in range(count):
+        (ln,) = struct.unpack_from("<I", data, off)
+        off += 4
+        out.append(unmarshal_message(data[off : off + ln]))
+        off += ln
+    if off != len(data):
+        raise ValueError(f"trailing bytes in frame: {len(data) - off}")
+    return out
+
+
 def unmarshal_message(data: bytes, max_entries: int | None = None,
                       max_responses: int | None = None) -> Message:
     lib = _lib()

@@ -1,0 +1,70 @@
+"""Double-buffered WAL delta stream for the fused engine.
+
+The reference's AsyncStorageWrites (reference: doc.go:172-258) exists so the
+state machine keeps stepping while the WAL fsync is in flight. The fused
+engine persists in-device within the round (stabled=last); what a real
+deployment additionally streams to host durability is the per-block delta:
+HardState cursors + the resident (term, type, size) log columns (entry
+payload bytes never live on device — SURVEY §7 state layout).
+
+`WalStream` is that pipeline, built into `FusedCluster.run(wal=...)`:
+
+  push(state):  start an ASYNC device->host copy of this block's delta
+                (jax.Array.copy_to_host_async — the transfer rides while
+                the next block computes), and resolve + sink the PREVIOUS
+                block's delta, which by now overlapped a whole block of
+                compute. This is the AsyncStorageWrites=true shape: the
+                device never waits for durability, and the sink sees
+                deltas exactly one block behind the live state.
+  flush():      resolve the in-flight tail (call when the run stops).
+
+The sink contract mirrors the reference's append-thread ordering rule
+(raft.go:160-185): deltas arrive in block order, each internally consistent
+(one atomic device state), so replaying sink outputs rebuilds a valid
+HardState + log prefix for every lane.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class WalStream:
+    # log_bytes is deliberately NOT streamed: entry payload bytes (and
+    # therefore their sizes) already live host-side (EntryStore / the
+    # application), so shipping the size column would duplicate ~40% of the
+    # frame for data the durability layer must already hold
+    FIELDS = (
+        "term", "vote", "committed", "last",
+        "log_term", "log_type",
+    )
+
+    def __init__(self, sink=None):
+        self._pending = None  # (block_id, {field: jax array})
+        self.sink = sink
+        self.blocks = 0
+        self.bytes = 0
+
+    def push(self, state):
+        cur = {f: getattr(state, f) for f in self.FIELDS}
+        for a in cur.values():
+            # start the D2H transfer now; it overlaps the next block's
+            # device execution (JAX async dispatch + async host copy)
+            a.copy_to_host_async()
+        prev = self._pending
+        self._pending = (self.blocks, cur)
+        self.blocks += 1
+        if prev is not None:
+            self._resolve(prev)
+
+    def flush(self):
+        if self._pending is not None:
+            self._resolve(self._pending)
+            self._pending = None
+
+    def _resolve(self, item):
+        block_id, arrs = item
+        delta = {f: np.asarray(a) for f, a in arrs.items()}
+        self.bytes += sum(a.nbytes for a in delta.values())
+        if self.sink is not None:
+            self.sink(block_id, delta)

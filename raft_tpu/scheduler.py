@@ -71,10 +71,11 @@ class BlockedFusedCluster:
 
     # -- driving ----------------------------------------------------------
 
-    def run(self, rounds: int = 1, ops: LocalOps | None = None, **kw):
+    def run(self, rounds: int = 1, ops: LocalOps | None = None, wal=None, **kw):
         """`rounds` fused rounds on every block. Dispatches are enqueued
         without host syncs, so the device pipelines block b+1's rounds
-        behind block b's (JAX async dispatch)."""
+        behind block b's (JAX async dispatch). wal: optional list of K
+        runtime.wal.WalStream, one per block."""
         for i, b in enumerate(self.blocks):
             o = None if ops is None else jax.tree.map(
                 lambda x, i=i: x[
@@ -82,7 +83,7 @@ class BlockedFusedCluster:
                 ],
                 ops,
             )
-            b.run(rounds, ops=o, **kw)
+            b.run(rounds, ops=o, wal=None if wal is None else wal[i], **kw)
 
     def ops(self, **kw) -> LocalOps:
         """Global-lane LocalOps (same contract as FusedCluster.ops)."""

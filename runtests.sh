@@ -6,12 +6,9 @@
 # PALLAS_AXON_POOL_IPS disables the hook so CPU-only test runs don't
 # serialize on the chip claim.
 #
-# The full suite runs as THREE pytest processes: XLA:CPU reproducibly
-# segfaults/aborts on a fresh compile once a few hundred programs were
-# compiled earlier in the same process (observed in test_sharded's big
-# 8-device programs and, after the corpus grew, mid test_scenarios; every
-# chunk passes standalone). Chunking keeps per-process compile counts well
-# under the crash threshold without losing coverage.
+# XLA:CPU reproducibly segfaults/aborts on a fresh compile once a few
+# hundred programs were compiled earlier in the same process; the suite
+# therefore spreads over multiple worker processes (details below).
 
 run() {
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -20,9 +17,14 @@ run() {
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
-  # (--ignore does not apply to explicitly listed files, so filter the glob)
-  run tests/test_[a-q]*.py \
-    && run $(ls tests/test_[r-z]*.py | grep -v test_sharded) \
+  # pytest-xdist, one file per worker (--dist loadfile): 6 worker processes
+  # keep every process's XLA:CPU compile count far under the crash
+  # threshold (the round-4 corpus outgrew even 4 sequential chunks), and
+  # the wall time drops ~4x. test_sharded still runs in its own process
+  # LAST: its big 8-device shard_map programs are the original crash
+  # trigger and its autouse fixture disables the persistent compile cache.
+  run -n 6 --dist loadfile --max-worker-restart 0 \
+    $(ls tests/test_*.py | grep -v test_sharded) \
     && run tests/test_sharded.py
 else
   run "$@"

@@ -1,0 +1,87 @@
+"""Index-overflow recovery on the RUNNING fused engine (VERDICT r3 item 9).
+
+The serial-path rebase (tests/test_rebase.py) is quiescent and
+host-coordinated; here a fused batch is driven up to the 2^30 index guard
+MID-REPLICATION — messages in the fabric, commits flowing every round —
+then re-keyed between two dispatch blocks with `FusedCluster.rebase_groups`
+(state + in-flight fabric shift together) and keeps committing with
+`error_bits` clean throughout.
+
+reference: indexes are uint64 (raftpb/raft.proto:21-26) so the reference
+never rebases; this is the int32 device engine's recovery path
+(ops/log.py:ERR_INDEX_NEAR_OVERFLOW, margin 2^30).
+"""
+
+import numpy as np
+
+from raft_tpu.ops.fused import FusedCluster
+from raft_tpu.ops.log import ERR_INDEX_NEAR_OVERFLOW, INDEX_OVERFLOW_MARGIN
+from tests.test_fused_invariants import cursor_order, log_matching
+
+
+def test_rebase_under_live_fused_traffic():
+    g, v, w = 4, 3, 64
+    c = FusedCluster(g, v, seed=17)
+    # elect + steady replication with continuous compaction
+    c.run(60, auto_propose=True, auto_compact_lag=8)
+    assert len(c.leader_lanes()) == g
+    com0 = int(np.asarray(c.state.committed).min())
+    assert com0 > 0
+    c.check_no_errors()
+
+    # fast-forward the whole batch to just below the overflow guard:
+    # a negative window-aligned rebase (pure renaming, same machinery)
+    base = ((INDEX_OVERFLOW_MARGIN - 2 * w) // w) * w
+    c.rebase_groups(range(g), delta=-base)
+    assert int(np.asarray(c.state.committed).min()) >= base
+    c.check_no_errors()
+
+    # keep committing until appends cross 2^30: the guard must fire
+    for _ in range(40):
+        c.run(8, auto_propose=True, auto_compact_lag=8)
+        bits = np.asarray(c.state.error_bits)
+        if (bits & ERR_INDEX_NEAR_OVERFLOW).any():
+            break
+    bits = np.asarray(c.state.error_bits)
+    assert (bits & ERR_INDEX_NEAR_OVERFLOW).any(), "guard never fired"
+    assert (bits & ~np.int32(ERR_INDEX_NEAR_OVERFLOW) == 0).all(), (
+        "only the overflow flag may be set"
+    )
+    assert int(np.asarray(c.state.last).max()) >= INDEX_OVERFLOW_MARGIN
+
+    # MID-TRAFFIC rebase: messages are in flight in the fabric right now
+    in_flight = int((np.asarray(c.fab.rep.kind) != 63).sum()) + int(
+        (np.asarray(c.fab.hb.kind) != 63).sum()
+    )
+    assert in_flight > 0, "fabric should be carrying live traffic"
+    com_before = np.asarray(c.state.committed).copy()
+    applied = c.rebase_groups(range(g))
+    assert set(applied) == set(range(g))
+    deltas = np.asarray([applied[lane // v] for lane in range(g * v)])
+    # the flag cleared, every cursor shifted by exactly the group delta
+    c.check_no_errors()
+    np.testing.assert_array_equal(
+        np.asarray(c.state.committed), com_before - deltas
+    )
+    cursor_order(c)
+
+    # ...and the batch just keeps serving: commits advance, logs match
+    com1 = np.asarray(c.state.committed).copy()
+    c.run(40, auto_propose=True, auto_compact_lag=8)
+    c.check_no_errors()
+    com2 = np.asarray(c.state.committed)
+    assert (com2 > com1).all(), "commits stalled after rebase"
+    log_matching(c)
+    cursor_order(c)
+    assert len(c.leader_lanes()) == g
+
+
+def test_rebase_rejects_unaligned_delta():
+    c = FusedCluster(1, 3, seed=1)
+    c.run(40, auto_propose=True, auto_compact_lag=8)
+    try:
+        c.rebase_groups([0], delta=7)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("unaligned delta accepted")

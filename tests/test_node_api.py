@@ -15,7 +15,7 @@ from raft_tpu.testing.network import LossyNetwork, SyncNetwork
 from tests.test_rawnode import make_group
 
 
-def run_cluster(n_nodes, drop_prob, n_proposals, deadline_s=300.0):
+def run_cluster(n_nodes, drop_prob, n_proposals, deadline_s=600.0):
     """5 real Nodes over the lossy simulator, app loop per node — the
     reference's TestBasicProgress shape (rafttest/node_test.go:25-60)."""
     b = make_group(n_nodes)
@@ -86,7 +86,9 @@ def run_cluster(n_nodes, drop_prob, n_proposals, deadline_s=300.0):
 
     target = n_proposals  # at least the proposals (plus empty entries)
     ok = False
-    while time.monotonic() - t0 < deadline_s:
+    t1 = time.monotonic()  # the commit wait gets its own budget: under a
+    # parallel test run (xdist) election + proposing can eat the shared one
+    while time.monotonic() - t1 < deadline_s:
         if min(commits) >= target:
             ok = True
             break

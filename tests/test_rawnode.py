@@ -243,3 +243,58 @@ def test_step_many_mixed_batch_order_preserved():
     b.step_many([(lead, prop)])
     drive_batched(b)
     assert min(int(b.view.committed[i]) for i in range(3)) >= 2
+
+
+def test_has_ready_matches_peek():
+    """has_ready is the reference's cheap predicate set (rawnode.go:450-472);
+    it must agree with the full `ready(peek=True).contains_updates()` at
+    every point of a mixed sync/async drive."""
+    import numpy as np
+
+    from raft_tpu.api.rawnode import Entry, Message
+    from raft_tpu.types import MessageType as MT
+
+    b = make_group(3)
+    b.set_async_storage_writes(2, True)
+
+    def check():
+        for lane in range(3):
+            fast = b.has_ready(lane)
+            slow = b.ready(lane, peek=True).contains_updates() or bool(
+                b._after_append[lane]
+            )
+            assert fast == slow, (lane, fast, slow)
+
+    check()
+    b.campaign(0)
+    check()
+    rng = np.random.default_rng(5)
+    for i in range(60):
+        moved = False
+        for lane in range(3):
+            check()
+            if not b.has_ready(lane):
+                continue
+            rd = b.ready(lane)
+            msgs = rd.messages
+            if lane != 2:
+                b.advance(lane)
+            for m in msgs:
+                if m.to in (1, 2, 3):
+                    b.step(m.to - 1, m)
+                elif m.to == -1:  # lane 2's append thread
+                    for r in m.responses:
+                        b.step(2, r)
+                elif m.to == -2:  # apply thread ack
+                    b.step(2, Message(
+                        type=int(MT.MSG_STORAGE_APPLY_RESP), to=3, frm=-2,
+                        entries=list(m.entries),
+                    ))
+            moved = True
+        if i == 10:
+            b.propose(0, b"x")
+        if i == 20:
+            b.read_index(0, 55)
+        if not moved and i > 25:
+            break
+    check()
