@@ -22,6 +22,11 @@ import sys
 import time
 
 import jax
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+if jax.default_backend() != "cpu":
+    enable_persistent_cache()
 import jax.numpy as jnp
 import numpy as np
 

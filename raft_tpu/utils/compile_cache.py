@@ -1,0 +1,36 @@
+"""Persistent XLA compilation cache for the TPU path.
+
+The fused round kernel costs 1.5-3 minutes to compile over the tunnel
+(BENCH_r03 measured 98 s; round 4 saw up to 190 s at 64k groups). The JAX
+persistent cache works on this backend — measured 187 s cold -> 44 s warm
+across FRESH processes for the 64k-group bench program — so every bench
+entry point enables it: a new session reaches its first north-star
+measurement in well under two minutes once the cache is warm (VERDICT r3
+item 8).
+
+The CPU test suite does NOT use this module: tests/test_sharded.py
+deliberately disables the persistent cache (its write path is one of the
+XLA:CPU crash modes — see runtests.sh).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """Idempotently point JAX at a persistent compilation cache directory
+    (default: $RAFT_TPU_CACHE_DIR or <repo>/.xla_cache)."""
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.environ.get("RAFT_TPU_CACHE_DIR") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+            ".xla_cache",
+        )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir
