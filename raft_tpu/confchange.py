@@ -263,6 +263,30 @@ class ConfState:
     auto_leave: bool = False
 
 
+def equivalent(cs1: ConfState, cs2: ConfState) -> str | None:
+    """None when the two ConfStates describe the same configuration after
+    sorting each id list; a descriptive message on mismatch (the reference
+    returns nil/error, raftpb/confstate.go:25-45). Insensitive to ordering
+    and nil-vs-empty; sensitive to AutoLeave."""
+
+    def norm(cs: ConfState):
+        return (
+            tuple(sorted(cs.voters)),
+            tuple(sorted(cs.learners)),
+            tuple(sorted(cs.voters_outgoing)),
+            tuple(sorted(cs.learners_next)),
+            bool(cs.auto_leave),
+        )
+
+    a, b = norm(cs1), norm(cs2)
+    if a != b:
+        return (
+            f"ConfStates not equivalent after sorting:\n{a}\n{b}\n"
+            f"Inputs were:\n{cs1}\n{cs2}"
+        )
+    return None
+
+
 def conf_state(cfg: TrackerConfig) -> ConfState:
     return ConfState(
         voters=tuple(sorted(cfg.voters_in)),

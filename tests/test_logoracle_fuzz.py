@@ -1,0 +1,141 @@
+"""Randomized cross-check of the log oracle against the kernel.
+
+The oracle (testing/logoracle.py) re-derives the reference's logging
+decision tree from (pre-state, message, post-state); the goldens verify it
+only where scripts have coverage (VERDICT r3 weak item 7). This fuzz drives
+random traffic — ticks, proposals, drops, duplicate/stale deliveries,
+transfers, reads — through a TRACED batch and, at every step, checks that
+the oracle's role-transition predictions ("became leader/follower/candidate
+at term T", the reference's raft.go:864-939 log lines) agree with the
+kernel's actual post-state. Any control-flow divergence between the scalar
+mirror and the tensor kernel trips these asserts even with no golden
+watching.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from raft_tpu.api.rawnode import ErrProposalDropped, Message
+from raft_tpu.testing.logoracle import LogOracle
+from raft_tpu.types import MessageType as MT, StateType
+from tests.test_rawnode import make_group
+
+
+class _Out:
+    def __init__(self):
+        self.lines = []
+
+    def quiet(self):
+        return False
+
+    def logf(self, lvl, text):
+        self.lines.append(text)
+
+
+class _Env:
+    def __init__(self):
+        self.output = _Out()
+
+
+_BECAME = re.compile(
+    r"became (leader|follower|candidate|pre-candidate) at term (\d+)"
+)
+_ROLE = {
+    "leader": int(StateType.LEADER),
+    "follower": int(StateType.FOLLOWER),
+    "candidate": int(StateType.CANDIDATE),
+    "pre-candidate": int(StateType.PRE_CANDIDATE),
+}
+
+
+class CheckingOracle(LogOracle):
+    """After every traced step, the LAST role-transition line the oracle
+    predicted must match the kernel's post-state exactly."""
+
+    checked = 0
+
+    def after_step(self, lane, msg, pre):
+        start = len(self.env.output.lines)
+        super().after_step(lane, msg, pre)
+        new = self.env.output.lines[start:]
+        trans = [m for line in new for m in [_BECAME.search(line)] if m]
+        if not trans:
+            return
+        role, term = trans[-1].group(1), int(trans[-1].group(2))
+        v = self.batch.view
+        assert int(v.state[lane]) == _ROLE[role], (
+            f"oracle said 'became {role}' but kernel state is "
+            f"{int(v.state[lane])} (msg {msg.type}, lane {lane})\n"
+            + "\n".join(new)
+        )
+        assert int(v.term[lane]) == term, (
+            f"oracle said term {term}, kernel term {int(v.term[lane])} "
+            f"(msg {msg.type}, lane {lane})\n" + "\n".join(new)
+        )
+        CheckingOracle.checked += 1
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+def test_oracle_agrees_with_kernel_under_random_traffic(seed):
+    rng = np.random.default_rng(seed)
+    b = make_group(3, election_tick=6)
+    oracle = CheckingOracle(_Env(), b)
+    b.trace = oracle
+    pool: list[Message] = []
+    stale: list[Message] = []
+    checked0 = CheckingOracle.checked
+
+    for step in range(250):
+        action = rng.random()
+        lane = int(rng.integers(3))
+        if action < 0.45:
+            b.tick(lane)
+        elif action < 0.60 and pool:
+            k = int(rng.integers(len(pool)))
+            m = pool.pop(k)
+            if rng.random() < 0.15:
+                stale.append(m)  # duplicate it later
+            if rng.random() < 0.1:
+                continue  # drop
+            dst = m.to - 1
+            if 0 <= dst < 3:
+                try:
+                    b.step(dst, m)
+                except ErrProposalDropped:
+                    pass  # forwarded proposals are droppable by contract
+        elif action < 0.70 and stale and rng.random() < 0.5:
+            m = stale.pop()
+            dst = m.to - 1
+            if 0 <= dst < 3:
+                try:
+                    b.step(dst, m)  # stale/duplicate delivery
+                except ErrProposalDropped:
+                    pass
+        elif action < 0.80:
+            try:
+                b.propose(lane, b"p%d" % step)
+            except Exception:
+                pass
+        elif action < 0.85:
+            sts = [b.basic_status(i)["raft_state"] for i in range(3)]
+            if "LEADER" in sts:
+                ldr = sts.index("LEADER")
+                b.transfer_leadership(ldr, int(rng.integers(1, 4)))
+        elif action < 0.90:
+            try:
+                b.read_index(lane, int(step + 1000))
+            except Exception:
+                pass
+        # drain Readys into the pool
+        for ln in range(3):
+            if b.has_ready(ln):
+                rd = b.ready(ln)
+                pool.extend(rd.messages)
+                b.advance(ln)
+        if len(pool) > 64:
+            del pool[:32]
+    # the run exercised real transitions (elections happened under ticks)
+    assert CheckingOracle.checked > checked0, "no transitions were checked"
+    assert (np.asarray(b.state.error_bits) == 0).all()

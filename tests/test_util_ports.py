@@ -1,0 +1,106 @@
+"""Ports of /root/reference/util_test.go and raftpb/confstate_test.go.
+
+Port map:
+  TestConfState_Equivalent   confstate_test.go:21 -> test_conf_state_equivalent
+  TestDescribeEntry          util_test.go:32      -> test_describe_entry
+  TestLimitSize              util_test.go:43      -> test_limit_size_rule
+  TestIsLocalMsg             util_test.go:71      -> test_is_local_msg_table
+  TestIsResponseMsg          util_test.go:108     -> test_is_response_msg_table
+  TestPayloadSizeOfEmptyEntry util_test.go:149    -> test_empty_entry_sizes
+"""
+
+from raft_tpu import confchange as ccm
+from raft_tpu.api.rawnode import Entry, entry_go_size
+from raft_tpu.testing.describe import describe_entry
+from raft_tpu.types import LOCAL_MSGS, RESPONSE_MSGS, MessageType as MT
+
+CS = ccm.ConfState
+
+
+def test_conf_state_equivalent():
+    cases = [
+        # reordered voters/learners are equivalent
+        (CS(voters=(1, 2, 3), learners=(5, 4, 6), voters_outgoing=(9, 8, 7),
+            learners_next=(10, 20, 15)),
+         CS(voters=(1, 2, 3), learners=(4, 5, 6), voters_outgoing=(7, 9, 8),
+            learners_next=(20, 10, 15)), True),
+        # nil vs empty: the dataclass default () vs explicit ()
+        (CS(voters=()), CS(), True),
+        # non-equivalent voters
+        (CS(voters=(1, 2, 3, 4)), CS(voters=(2, 1, 3)), False),
+        (CS(voters=(1, 4, 3)), CS(voters=(2, 1, 3)), False),
+        # sensitive to AutoLeave
+        (CS(auto_leave=True), CS(), False),
+    ]
+    for cs1, cs2, ok in cases:
+        err = ccm.equivalent(cs1, cs2)
+        assert (err is None) == ok, (cs1, cs2, err)
+
+
+def test_describe_entry():
+    e = Entry(term=1, index=2, type=0, data=b"hello\x00world")
+    assert describe_entry(e) == '1/2 EntryNormal "hello\\x00world"'
+    assert (
+        describe_entry(e, formatter=lambda d: d.decode("latin1").upper())
+        == "1/2 EntryNormal HELLO\x00WORLD"
+    )
+
+
+def test_limit_size_rule():
+    """util.go:266 limitSize semantics live in the Ready pagination: at
+    least one entry always; otherwise the total never exceeds the budget.
+    (End-to-end rows in tests/test_log_tables.py::test_slice_size_limits;
+    here the pure size function.)"""
+    ents = [Entry(term=4, index=4), Entry(term=5, index=5), Entry(term=6, index=6)]
+    sizes = [entry_go_size(e) for e in ents]
+
+    def limit(max_size):
+        out, total = [], 0
+        for e in ents:
+            total += entry_go_size(e)
+            if out and total > max_size:
+                break
+            out.append(e)
+        return out
+
+    assert limit(1 << 62) == ents
+    assert limit(0) == ents[:1]  # never empty
+    assert limit(sizes[0] + sizes[1]) == ents[:2]
+    assert limit(sizes[0] + sizes[1] + sizes[2] // 2) == ents[:2]
+    assert limit(sum(sizes) - 1) == ents[:2]
+    assert limit(sum(sizes)) == ents
+
+
+def test_is_local_msg_table():
+    """util.go:29-46 — the exact reference membership."""
+    want_local = {
+        MT.MSG_HUP, MT.MSG_BEAT, MT.MSG_UNREACHABLE, MT.MSG_SNAP_STATUS,
+        MT.MSG_CHECK_QUORUM, MT.MSG_STORAGE_APPEND, MT.MSG_STORAGE_APPEND_RESP,
+        MT.MSG_STORAGE_APPLY, MT.MSG_STORAGE_APPLY_RESP,
+    }
+    for t in MT:
+        if t == MT.MSG_NONE:
+            continue
+        assert (t in LOCAL_MSGS) == (t in want_local), t
+
+
+def test_is_response_msg_table():
+    """util.go:48-63."""
+    want_resp = {
+        MT.MSG_APP_RESP, MT.MSG_VOTE_RESP, MT.MSG_HEARTBEAT_RESP,
+        MT.MSG_UNREACHABLE, MT.MSG_READ_INDEX_RESP, MT.MSG_PRE_VOTE_RESP,
+        MT.MSG_STORAGE_APPEND_RESP, MT.MSG_STORAGE_APPLY_RESP,
+    }
+    for t in MT:
+        if t == MT.MSG_NONE:
+            continue
+        assert (t in RESPONSE_MSGS) == (t in want_resp), t
+
+
+def test_empty_entry_sizes():
+    # payload of an empty entry is 0; its wire size is not
+    e = Entry(term=0, index=0, data=b"")
+    assert len(e.data or b"") == 0
+    assert entry_go_size(e) > 0
+    # and gogoproto sizing grows with the payload exactly
+    assert entry_go_size(Entry(data=b"x" * 10)) > entry_go_size(e)
