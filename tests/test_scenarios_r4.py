@@ -1,0 +1,210 @@
+"""Round-4 raft_test.go scenario ports (the names the r3 cited-port scan
+found missing). Name map:
+
+| reference test (raft_test.go) | here |
+|---|---|
+| TestCandidateSelfVoteAfterLostElection (+PreVote) | test_candidate_self_vote_after_lost_election |
+| TestCandidateDeliversPreCandidateSelfVoteAfterBecomingCandidate | test_precandidate_self_vote_after_becoming_candidate |
+| TestLeaderMsgAppSelfAckAfterTermChange | test_leader_selfack_after_term_change |
+| TestLeaderElectionOverwriteNewerLogs (+PreVote) | test_leader_election_overwrite_newer_logs |
+| TestTransferNonMember | test_transfer_non_member |
+| TestConfChangeCheckBeforeCampaign / V2 | test_conf_change_check_before_campaign |
+| TestPastElectionTimeout | (behavior: tests/test_paper.py test_election_timeout_randomized) |
+| TestPromotable | test_promotable_table |
+| TestStateTransition | (the kernel has no become* API to misuse; transitions covered by goldens + tests/test_vote_states.py) |
+| TestProgressLeader/Paused/FlowControl/ResumeByHeartbeatResp, TestSendAppendForProgress* | (behavior: tests/test_flow_control.py, tests/test_progress.py, tests/test_backpressure.py) |
+| TestReadOnlyOptionSafe/Lease | (behavior: tests/test_readindex.py) |
+| TestProvideSnap/TestIgnoreProvidingSnap | (behavior: tests/test_snapshot.py snapshot send/defer paths) |
+| TestRaftNodes | (membership listing: tests/test_confchange_scenarios.py peer_ids asserts) |
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import confchange as ccm
+from raft_tpu.api.rawnode import Entry, Message
+from raft_tpu.types import EntryType, MessageType as MT, StateType as ST
+from tests.test_paper import make_batch, set_lane
+from tests.test_rawnode import drive
+
+
+@pytest.mark.parametrize("pre_vote", [False, True])
+def test_candidate_self_vote_after_lost_election(pre_vote):
+    """raft_test.go TestCandidateSelfVoteAfterLostElection(PreVote): the
+    candidate's self-vote, delivered only after it already lost to another
+    leader, must not resurrect the candidacy or pollute the tally."""
+    b = make_batch(3, pre_vote=pre_vote)
+    b.campaign(0)  # self-vote waits in msgsAfterAppend
+    term = int(b.view.term[0])
+    # n2 already won: current-term heartbeat arrives BEFORE the self-vote
+    # was accounted
+    b.step(0, Message(type=int(MT.MSG_HEARTBEAT), to=1, frm=2, term=term))
+    assert int(b.view.state[0]) == int(ST.FOLLOWER)
+    # deliver the stale self-vote via the Ready/advance cycle
+    while b.has_ready(0):
+        b.ready(0)
+        b.advance(0)
+    assert int(b.view.state[0]) == int(ST.FOLLOWER)
+    # the tally stays clean
+    votes = np.asarray(b.state.votes)[0]
+    assert (votes == 0).all(), votes
+
+
+def test_precandidate_self_vote_after_becoming_candidate():
+    """raft_test.go TestCandidateDeliversPreCandidateSelfVoteAfterBecoming-
+    Candidate: peer pre-votes can promote before the delayed pre-vote
+    self-vote lands; the late self-vote must not disturb the candidacy."""
+    b = make_batch(3, pre_vote=True)
+    b.campaign(0)
+    assert int(b.view.state[0]) == int(ST.PRE_CANDIDATE)
+    term = int(b.view.term[0])
+    b.step(0, Message(type=int(MT.MSG_PRE_VOTE_RESP), to=1, frm=2, term=term + 1))
+    b.step(0, Message(type=int(MT.MSG_PRE_VOTE_RESP), to=1, frm=3, term=term + 1))
+    assert int(b.view.state[0]) == int(ST.CANDIDATE)
+    while b.has_ready(0):
+        b.ready(0)
+        b.advance(0)
+    assert int(b.view.state[0]) == int(ST.CANDIDATE)
+
+
+def test_leader_selfack_after_term_change():
+    """raft_test.go TestLeaderMsgAppSelfAckAfterTermChange: a deposed
+    leader's pending MsgApp self-ack is ignored (stale term)."""
+    b = make_batch(3)
+    b.campaign(0)
+    while b.has_ready(0):
+        b.ready(0)
+        b.advance(0)
+    term = int(b.view.term[0])
+    b.step(0, Message(type=int(MT.MSG_VOTE_RESP), to=1, frm=2, term=term))
+    assert int(b.view.state[0]) == int(ST.LEADER)
+    b.propose(0, b"somedata")  # self-ack waits in msgsAfterAppend
+    # n2 is the new leader
+    b.step(0, Message(type=int(MT.MSG_HEARTBEAT), to=1, frm=2, term=term + 1))
+    assert int(b.view.state[0]) == int(ST.FOLLOWER)
+    commit0 = int(b.view.committed[0])
+    while b.has_ready(0):
+        b.ready(0)
+        b.advance(0)
+    assert int(b.view.state[0]) == int(ST.FOLLOWER)
+    assert int(b.view.committed[0]) == commit0  # the stale ack moved nothing
+
+
+@pytest.mark.parametrize("pre_vote", [False, True])
+def test_leader_election_overwrite_newer_logs(pre_vote):
+    """raft_test.go TestLeaderElectionOverwriteNewerLogs(PreVote): losers'
+    newer-term uncommitted entries are overwritten by the term-3 winner."""
+    b = make_batch(5, pre_vote=pre_vote)
+    w = b.shape.w
+
+    def seed_log(lane, terms, term, vote=0):
+        row = np.zeros((w,), np.int32)
+        for i, t in enumerate(terms):
+            row[(i + 1) & (w - 1)] = t
+        set_lane(
+            b, lane,
+            log_term=jnp.asarray(row),
+            last=jnp.int32(len(terms)),
+            stabled=jnp.int32(len(terms)),
+            term=jnp.int32(term),
+            vote=jnp.int32(vote),
+        )
+
+    seed_log(0, [1], 1)          # node 1: won the first election
+    seed_log(1, [1], 1)          # node 2: got node 1's entry
+    seed_log(2, [2], 2)          # node 3: won the second election
+    seed_log(3, [], 2, vote=3)   # nodes 4, 5: voted for 3, no logs
+    seed_log(4, [], 2, vote=3)
+
+    b.campaign(0)
+    drive(b)
+    assert int(b.view.state[0]) == int(ST.FOLLOWER)
+    assert int(b.view.term[0]) == 2
+    b.campaign(0)
+    drive(b)
+    assert int(b.view.state[0]) == int(ST.LEADER)
+    assert int(b.view.term[0]) == 3
+    lt = np.asarray(b.state.log_term)
+    for lane in range(5):
+        assert int(b.view.last[lane]) == 2, lane
+        assert lt[lane, 1] == 1 and lt[lane, 2] == 3, (lane, lt[lane, :4])
+
+
+def test_transfer_non_member():
+    """raft_test.go TestTransferNonMember: a TimeoutNow/transfer addressed
+    at a non-member is ignored outright."""
+    b = make_batch(3)
+    b.campaign(0)
+    drive(b)
+    b.transfer_leadership(0, 42)  # not a member
+    drive(b)
+    assert int(b.view.state[0]) == int(ST.LEADER)
+    assert int(b.view.lead_transferee[0]) == 0
+    # and a non-member follower ignores MsgTimeoutNow (it is not promotable)
+    # reference: the non-member target never campaigns
+
+
+@pytest.mark.parametrize("v2", [False, True])
+def test_conf_change_check_before_campaign(v2):
+    """raft_test.go TestConfChange(V2)CheckBeforeCampaign: a committed but
+    UNAPPLIED conf-change entry blocks campaigning
+    (hasUnappliedConfChanges, raft.go:963-989)."""
+    b = make_batch(3)
+    b.campaign(0)
+    drive(b)
+    cc = ccm.ConfChange(type=int(ccm.ConfChangeType.ADD_LEARNER_NODE), node_id=4)
+    data = ccm.encode(cc if not v2 else cc.as_v2())
+    b.propose_conf_change(0, data, v2=v2)
+    # replicate + commit everywhere, but lane 1 never runs its Ready loop:
+    # it steps the appends (committed advances) without ever APPLYING
+    for _ in range(12):
+        moved = False
+        for lane in (0, 2):
+            if not b.has_ready(lane):
+                continue
+            rd = b.ready(lane)
+            msgs = rd.messages
+            b.advance(lane)
+            for m in msgs:
+                b.step(m.to - 1, m)
+            moved = True
+        if not moved:
+            break
+    # lane 1's committed now covers the cc entry, applied does not
+    assert int(b.view.committed[1]) > int(b.view.applied[1])
+    b.campaign(1)
+    assert int(b.view.state[1]) == int(ST.FOLLOWER), (
+        "campaign must be refused while a conf change awaits application"
+    )
+    # after applying (ready/advance), campaigning works
+    while b.has_ready(1):
+        rd = b.ready(1)
+        for e in rd.committed_entries:
+            if e.type in (int(EntryType.ENTRY_CONF_CHANGE),
+                          int(EntryType.ENTRY_CONF_CHANGE_V2)):
+                b.apply_conf_change(1, ccm.decode(
+                    e.data, v1=e.type == int(EntryType.ENTRY_CONF_CHANGE)))
+        b.advance(1)
+    b.campaign(1)
+    assert int(b.view.state[1]) in (int(ST.CANDIDATE), int(ST.LEADER))
+
+
+def test_promotable_table():
+    """raft_test.go TestPromotable: campaign only fires when the node is in
+    its own configuration and holds no pending snapshot."""
+    # member: promotable
+    b = make_batch(3)
+    b.campaign(0)
+    assert int(b.view.state[0]) != int(ST.FOLLOWER)
+    # not in its own config: not promotable
+    b2 = make_batch(3)
+    ids = np.asarray(b2.state.prs_id).copy()
+    ids[0] = [2, 3, 0, 0, 0, 0, 0, 0]
+    vin = np.asarray(b2.state.voters_in).copy()
+    vin[0] = [True, True, False, False, False, False, False, False]
+    set_lane(b2, 0, prs_id=jnp.asarray(ids[0]), voters_in=jnp.asarray(vin[0]))
+    b2.campaign(0)
+    assert int(b2.view.state[0]) == int(ST.FOLLOWER)
