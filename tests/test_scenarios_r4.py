@@ -18,14 +18,12 @@ found missing). Name map:
 | TestRaftNodes | (membership listing: tests/test_confchange_scenarios.py peer_ids asserts) |
 """
 
-import dataclasses
-
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from raft_tpu import confchange as ccm
-from raft_tpu.api.rawnode import Entry, Message
+from raft_tpu.api.rawnode import Message
 from raft_tpu.types import EntryType, MessageType as MT, StateType as ST
 from tests.test_paper import make_batch, set_lane
 from tests.test_rawnode import drive
