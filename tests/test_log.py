@@ -79,6 +79,7 @@ def test_term_at_bounds():
 
 
 def test_is_up_to_date():
+    """reference: log_test.go TestIsUpToDate (:115)."""
     st = mk([1, 1, 2])  # last=(3, term 2)
     cases = [
         ((4, 3), True),  # higher term wins regardless of index
@@ -123,6 +124,8 @@ def test_maybe_append_accept_and_reject():
 
 
 def test_maybe_append_truncates_conflict():
+    """reference: log_test.go TestAppend (:145) — the conflicting-suffix
+    truncation cases, via maybeAppend's find_conflict + truncate path."""
     st = mk([1, 2, 3], committed=1, stabled=3)
     # prev (1, term 1) with entries [4, 4]: conflict at 2, truncate 2-3
     et, ty, by, n = ents([4, 4])

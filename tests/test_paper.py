@@ -1,7 +1,34 @@
 """Raft-paper clause tests over the batched engine — the tier-2 suite
 (reference: raft_paper_test.go, which mirrors §5 of the Raft paper
 clause-by-clause). Re-derived against the same scenarios, driven through
-RawNodeBatch + SyncNetwork instead of the Go network fixture."""
+RawNodeBatch + SyncNetwork instead of the Go network fixture.
+
+Complete name map (all 26 raft_paper_test.go functions):
+
+| reference test (raft_paper_test.go) | here |
+|---|---|
+| TestFollowerUpdateTermFromMessage, TestCandidateUpdateTermFromMessage, TestLeaderUpdateTermFromMessage | test_update_term_from_message[follower/candidate/leader] |
+| TestRejectStaleTermMessage | test_reject_stale_term_message |
+| TestStartAsFollower | test_start_as_follower |
+| TestLeaderBcastBeat | test_leader_bcast_beat |
+| TestFollowerStartElection, TestCandidateStartNewElection | test_nonleader_start_election[follower/candidate] |
+| TestLeaderElectionInOneRoundRPC | test_leader_election_in_one_round_rpc |
+| TestFollowerVote | test_follower_vote |
+| TestCandidateFallback | test_candidate_fallback |
+| TestFollowerElectionTimeoutRandomized, TestCandidateElectionTimeoutRandomized | test_election_timeout_randomized |
+| TestFollowersElectionTimeoutNonconflict, TestCandidatesElectionTimeoutNonconflict | test_nonleaders_election_timeout_nonconflict |
+| TestLeaderStartReplication | test_leader_start_replication |
+| TestLeaderCommitEntry | test_leader_commit_entry |
+| TestLeaderAcknowledgeCommit | test_leader_acknowledge_commit |
+| TestLeaderCommitPrecedingEntries | test_leader_commit_preceding_entries |
+| TestFollowerCommitEntry | test_follower_commit_entry |
+| TestFollowerCheckMsgApp | test_follower_check_msg_app |
+| TestFollowerAppendEntries | test_follower_append_entries |
+| TestLeaderSyncFollowerLog | test_leader_sync_follower_log |
+| TestVoteRequest | test_vote_request |
+| TestVoter | test_voter |
+| TestLeaderOnlyCommitsLogFromCurrentTerm | test_leader_only_commits_log_from_current_term |
+"""
 
 from __future__ import annotations
 

@@ -90,7 +90,10 @@ def test_storage_append_cases():
 
 
 def test_storage_compact_and_snapshot():
-    """reference: storage_test.go TestStorageCompact/TestStorageCreateSnapshot."""
+    """reference: storage_test.go TestStorageCompact/TestStorageCreateSnapshot,
+    plus TestStorageApplySnapshot (:229, reset-to-snapshot + stale rejection)
+    and the TestStorageFirstIndex (:106) / TestStorageLastIndex (:92) cursor
+    checks inline."""
     ms = ms_with(
         [Entry(term=4, index=4), Entry(term=5, index=5)],
         offset_index=3, offset_term=3,

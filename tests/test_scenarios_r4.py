@@ -3,17 +3,19 @@ found missing). Name map:
 
 | reference test (raft_test.go) | here |
 |---|---|
-| TestCandidateSelfVoteAfterLostElection (+PreVote) | test_candidate_self_vote_after_lost_election |
+| TestCandidateSelfVoteAfterLostElection / TestCandidateSelfVoteAfterLostElectionPreVote | test_candidate_self_vote_after_lost_election |
+| TestNodeWithSmallerTermCanCompleteElection | test_node_with_smaller_term_can_complete_election |
 | TestCandidateDeliversPreCandidateSelfVoteAfterBecomingCandidate | test_precandidate_self_vote_after_becoming_candidate |
 | TestLeaderMsgAppSelfAckAfterTermChange | test_leader_selfack_after_term_change |
-| TestLeaderElectionOverwriteNewerLogs (+PreVote) | test_leader_election_overwrite_newer_logs |
+| TestLeaderElectionOverwriteNewerLogs / TestLeaderElectionOverwriteNewerLogsPreVote | test_leader_election_overwrite_newer_logs |
 | TestTransferNonMember | test_transfer_non_member |
-| TestConfChangeCheckBeforeCampaign / V2 | test_conf_change_check_before_campaign |
+| TestConfChangeCheckBeforeCampaign / TestConfChangeV2CheckBeforeCampaign | test_conf_change_check_before_campaign[False/True] |
 | TestPastElectionTimeout | (behavior: tests/test_paper.py test_election_timeout_randomized) |
 | TestPromotable | test_promotable_table |
 | TestStateTransition | (the kernel has no become* API to misuse; transitions covered by goldens + tests/test_vote_states.py) |
-| TestProgressLeader/Paused/FlowControl/ResumeByHeartbeatResp, TestSendAppendForProgress* | (behavior: tests/test_flow_control.py, tests/test_progress.py, tests/test_backpressure.py) |
-| TestReadOnlyOptionSafe/Lease | (behavior: tests/test_readindex.py) |
+| TestProgressLeader, TestProgressPaused, TestProgressFlowControl, TestProgressResumeByHeartbeatResp | (behavior: tests/test_flow_control.py, tests/test_progress.py, tests/test_backpressure.py) |
+| TestSendAppendForProgressProbe, TestSendAppendForProgressReplicate, TestSendAppendForProgressSnapshot | (behavior: tests/test_flow_control.py pause/resume per state, tests/test_snapshot.py) |
+| TestReadOnlyOptionSafe / TestReadOnlyOptionLease | (behavior: tests/test_readindex.py, incl. test_lease_based_read) |
 | TestProvideSnap/TestIgnoreProvidingSnap | (behavior: tests/test_snapshot.py snapshot send/defer paths) |
 | TestRaftNodes | (membership listing: tests/test_confchange_scenarios.py peer_ids asserts) |
 """
@@ -23,6 +25,7 @@ import numpy as np
 import pytest
 
 from raft_tpu import confchange as ccm
+from raft_tpu.testing.network import SyncNetwork
 from raft_tpu.api.rawnode import Message
 from raft_tpu.types import EntryType, MessageType as MT, StateType as ST
 from tests.test_paper import make_batch, set_lane
@@ -188,6 +191,51 @@ def test_conf_change_check_before_campaign(v2):
         b.advance(1)
     b.campaign(1)
     assert int(b.view.state[1]) in (int(ST.CANDIDATE), int(ST.LEADER))
+
+
+def test_node_with_smaller_term_can_complete_election():
+    """raft_test.go TestNodeWithSmallerTermCanCompleteElection
+    (/root/reference/raft_test.go:4012) — a pre-vote node partitioned away
+    while the majority elects twice stays at its small term as a
+    pre-candidate; after the partition heals (and the latest leader dies)
+    the cluster still completes an election even though the laggard's term
+    is far behind."""
+    b = make_batch(3, pre_vote=True)
+    for lane in range(3):  # the reference's becomeFollower(1, None) seeding
+        set_lane(b, lane, term=jnp.int32(1))
+    net = SyncNetwork(b)
+
+    def hup(nid):
+        b.campaign(nid - 1)
+        net.send([])
+
+    # isolate node 3; node 1 wins term 2
+    net.cut(1, 3)
+    net.cut(2, 3)
+    hup(1)
+    assert int(b.view.state[0]) == int(ST.LEADER)
+    assert int(b.view.state[1]) == int(ST.FOLLOWER)
+    # node 3 can only pre-campaign: stuck pre-candidate, term unchanged
+    hup(3)
+    assert int(b.view.state[2]) == int(ST.PRE_CANDIDATE)
+    # node 2 campaigns and wins the next term
+    hup(2)
+    assert int(b.view.term[0]) == 3
+    assert int(b.view.term[1]) == 3
+    assert int(b.view.term[2]) == 1
+    assert int(b.view.state[0]) == int(ST.FOLLOWER)
+    assert int(b.view.state[1]) == int(ST.LEADER)
+    assert int(b.view.state[2]) == int(ST.PRE_CANDIDATE)
+
+    # heal the partition, then isolate the current leader (crash emulation)
+    net.recover()
+    net.cut(2, 1)
+    net.cut(2, 3)
+
+    hup(3)
+    hup(1)
+    states = {int(b.view.state[0]), int(b.view.state[2])}
+    assert int(ST.LEADER) in states, states
 
 
 def test_promotable_table():

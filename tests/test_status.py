@@ -1,5 +1,10 @@
 """Status/BasicStatus introspection parity (reference: status.go:26-106,
-rawnode.go:495-528)."""
+rawnode.go:495-528). The reference's BenchmarkStatus/BenchmarkRawNode
+(rawnode_test.go) micro-benchmarks have no timing port — the batched
+engine's Status is a host-side view over device arrays and the
+Ready/Advance loop is measured by benches/baseline_configs.py config 1 —
+but the allocation-free WithProgress visitor they exercise is covered by
+test_with_progress_visits_sorted_with_types below."""
 
 import json
 
