@@ -31,6 +31,19 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _lean_shape(n_groups, v):
+    """The lean resident window shared by the batch-scale configs
+    (BASELINE.md W/E A/B): steady state commits one entry per group per
+    round under continuous compaction, so HBM traffic — the round's bound —
+    scales with W and E, not with the workload."""
+    from raft_tpu.config import Shape
+
+    return Shape(
+        n_lanes=n_groups * v, max_peers=v, log_window=16,
+        max_msg_entries=2, max_inflight=2, max_read_index=2,
+    )
+
+
 def _emit(name, value, unit, extra):
     print(
         json.dumps(
@@ -106,14 +119,9 @@ def config2_1k_groups_heartbeat(n_groups=1024):
     call), so like config 1 the run rides long multi-round scans: one
     dispatch covers 512 rounds, amortizing the tunnel cost to <1 ms/round
     (the round-3 VERDICT's config-2 ask)."""
-    from raft_tpu.config import Shape
     from raft_tpu.ops.fused import FusedCluster
 
-    shape = Shape(
-        n_lanes=n_groups * 3, max_peers=3, log_window=16,
-        max_msg_entries=2, max_inflight=2, max_read_index=2,
-    )
-    c = FusedCluster(n_groups, 3, seed=3, shape=shape)
+    c = FusedCluster(n_groups, 3, seed=3, shape=_lean_shape(n_groups, 3))
     c.run(40)
     assert len(c.leader_lanes()) == n_groups
     iters, block = 4, 512
@@ -137,13 +145,10 @@ def config3_fanin_100k_x5(n_groups=100_000):
     """100k groups x 5 voters, steady-state replication: every round the
     leader fans out MsgApp to 4 peers and fans in 4 MsgAppResp + self-ack,
     committing one entry — the raft.go:1333-1526 hot pair at scale."""
-    from raft_tpu.config import Shape
     from raft_tpu.ops.fused import FusedCluster
 
     v = 5
-    shape = Shape(n_lanes=n_groups * v, max_peers=v, log_window=32,
-                  max_msg_entries=4, max_inflight=4)
-    c = FusedCluster(n_groups, v, seed=4, shape=shape)
+    c = FusedCluster(n_groups, v, seed=4, shape=_lean_shape(n_groups, v))
     iters, block = 5, 16
     for _ in range(4):  # elections + warm the exact timed program
         c.run(block, auto_propose=True, auto_compact_lag=8)
@@ -181,7 +186,7 @@ def config4_joint_consensus_replace_leader(n_groups=100_000):
     from raft_tpu.ops.fused import FusedCluster
 
     v = 3
-    c = FusedCluster(n_groups, v, seed=5)
+    c = FusedCluster(n_groups, v, seed=5, shape=_lean_shape(n_groups, v))
     iters, block = 5, 16
     for _ in range(3):  # elections + warm the exact timed program
         c.run(block, auto_propose=True, auto_compact_lag=8)

@@ -24,3 +24,25 @@ jax.config.update("jax_platforms", "cpu")
 _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Warn when the FULL suite is collected into one process: XLA:CPU
+    reproducibly aborts once a few hundred distinct programs have been
+    compiled in a single process (see runtests.sh), so the suite must be
+    spread over pytest-xdist workers. `./runtests.sh` does this correctly."""
+    # xdist workers (PYTEST_XDIST_WORKER set) are spawned by a master that
+    # already decided the split; in the master, require enough workers that
+    # no single process crosses the compile-count threshold (runtests.sh
+    # uses 6; below 4 a worker's share of a full-suite run is still risky).
+    workers = getattr(config.option, "numprocesses", None) or 0
+    safe = os.environ.get("PYTEST_XDIST_WORKER") or workers >= 4
+    if len({i.path for i in items}) > 30 and not safe:
+        import warnings
+
+        warnings.warn(
+            "Running the full suite in ONE process will hit a known "
+            "XLA:CPU compile-count crash partway through. Use "
+            "./runtests.sh (pytest-xdist, one file per worker) instead.",
+            stacklevel=1,
+        )
