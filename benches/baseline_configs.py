@@ -234,34 +234,47 @@ def config4_joint_consensus_replace_leader(n_groups=100_000):
 
 def config5_mixed_1m_x7(n_groups=None):
     """Largest-resident x 7 voters: mixed election (randomized timeouts from
-    cold start) + steady replication — BASELINE.json's headline shape."""
+    cold start) + steady replication — BASELINE.json's headline shape, run
+    at the LITERAL 1M x 7 = 7.34M-lane size on TPU via the blocked
+    scheduler (scheduler.BlockedFusedCluster): the W=8/E=1 diet shape that
+    fits the whole carry in HBM, stepped as 64k-group blocks by one
+    compiled kernel (BASELINE.md "1M-group arithmetic")."""
     from raft_tpu.config import Shape
-    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.scheduler import BlockedFusedCluster
 
     v = 7
     platform = jax.devices()[0].platform
     if n_groups is None:
-        n_groups = 131072 if platform == "tpu" else 256
-    shape = Shape(n_lanes=n_groups * v, max_peers=v, log_window=16,
-                  max_msg_entries=2, max_inflight=2)
-    c = FusedCluster(n_groups, v, seed=6, shape=shape)
+        n_groups = 1048576 if platform == "tpu" else 256
+    # largest divisor of n_groups within the block cap, so any explicit
+    # n_groups keeps working (BlockedFusedCluster requires an exact split)
+    cap = 65536 if platform == "tpu" else 128
+    block_groups = next(
+        d for d in range(min(n_groups, cap), 0, -1) if n_groups % d == 0
+    )
+    shape = Shape(n_lanes=block_groups * v, max_peers=v, log_window=8,
+                  max_msg_entries=1, max_inflight=1, max_read_index=2)
+    c = BlockedFusedCluster(
+        n_groups, v, block_groups=block_groups, seed=6, shape=shape
+    )
     # election phase from cold start (the mixed-workload half)
     t0 = time.perf_counter()
     rounds_e = 0
-    while len(c.leader_lanes()) < n_groups and rounds_e < 40 * 16:
+    while c.leader_count() < n_groups and rounds_e < 40 * 16:
         c.run(16)
         rounds_e += 16
     dt_elect = time.perf_counter() - t0
-    n_lead = len(c.leader_lanes())
+    n_lead = c.leader_count()
     iters, block = 5, 16
     c.run(block, auto_propose=True, auto_compact_lag=4)  # warm exact program
-    com0 = int(jnp.sum(c.state.committed))
+    c.block_until_ready()
+    com0 = c.total_committed()
     t0 = time.perf_counter()
     for _ in range(iters):
         c.run(block, auto_propose=True, auto_compact_lag=4)
-    jax.block_until_ready(c.state.term)
+    c.block_until_ready()
     dt = time.perf_counter() - t0
-    commits = int(jnp.sum(c.state.committed)) - com0
+    commits = c.total_committed() - com0
     c.check_no_errors()
     _emit(
         "5_mixed_election_replication_x7",
@@ -270,6 +283,7 @@ def config5_mixed_1m_x7(n_groups=None):
         {
             "groups": n_groups,
             "voters": v,
+            "block_groups": block_groups,
             "leaders": n_lead,
             "election_rounds": rounds_e,
             "election_s": round(dt_elect, 1),

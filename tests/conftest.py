@@ -31,12 +31,19 @@ def pytest_collection_modifyitems(config, items):
     reproducibly aborts once a few hundred distinct programs have been
     compiled in a single process (see runtests.sh), so the suite must be
     spread over pytest-xdist workers. `./runtests.sh` does this correctly."""
-    # xdist workers (PYTEST_XDIST_WORKER set) are spawned by a master that
-    # already decided the split; in the master, require enough workers that
-    # no single process crosses the compile-count threshold (runtests.sh
-    # uses 6; below 4 a worker's share of a full-suite run is still risky).
-    workers = getattr(config.option, "numprocesses", None) or 0
-    safe = os.environ.get("PYTEST_XDIST_WORKER") or workers >= 4
+    # The xdist controller never collects items, so this hook only runs in
+    # workers (PYTEST_XDIST_WORKER/_COUNT set) or in a plain in-process run.
+    # Require enough workers that no single process crosses the
+    # compile-count threshold (runtests.sh uses 6; below 4 a worker's share
+    # of a full-suite run is still risky). Warn from gw0 only to avoid one
+    # warning per worker.
+    worker = os.environ.get("PYTEST_XDIST_WORKER")
+    nworkers = int(os.environ.get("PYTEST_XDIST_WORKER_COUNT") or 0) or (
+        getattr(config.option, "numprocesses", None) or 0
+    )
+    safe = nworkers >= 4
+    if worker not in (None, "gw0"):
+        return
     if len({i.path for i in items}) > 30 and not safe:
         import warnings
 
