@@ -55,6 +55,7 @@ catch-up, mute masks); it is just never the steady-state constraint.
 from __future__ import annotations
 
 import dataclasses
+import os
 from types import SimpleNamespace
 from typing import Any, NamedTuple
 
@@ -211,30 +212,81 @@ def fat_fabric(fab: Fabric) -> Fabric:
     return _cast_fabric(fab, widen=True)
 
 
-def route_fabric(out: Fabric, v: int, mute=None) -> Fabric:
-    """Deliver: inbox[g, j, i] = outbox[g, i, j]. Pure transpose per field;
-    the self slot passes through (it is the lane's own queued ack).
+def _route_transpose_field(x, v):
+    """inbox[g, j, i] = outbox[g, i, j] via an explicit [G,V,V] transpose.
+    Readable, but on TPU the [G,V,V,...] intermediates get tile-padded on
+    their tiny minor dims (V -> 128 lanes), turning every field into a
+    physical retile — profiled at ~73% of the round's device time."""
+    g = x.shape[0] // v
+    y = x.reshape((g, v, v) + x.shape[2:])
+    y = jnp.swapaxes(y, 1, 2)
+    return y.reshape(x.shape)
+
+
+def _route_shift_field(x, v):
+    """Same delivery as _route_transpose_field, computed column-wise as V^2
+    masked lane-shifts on the FLAT [N, ...] views, which keeps every
+    intermediate in the fast lane-major T(1024) tiling (no retile):
+
+    receiver lane l (member r = l % v) reads column i from sender lane
+    (l//v)*v + i = l + (i - r), i.e. outbox column r shifted by r - i.
+    jnp.roll wraps across group boundaries, but a lane only selects the
+    residue case whose shifted read stays inside its own group, so the
+    wrapped values are always masked out."""
+    n = x.shape[0]
+    res = jnp.arange(n, dtype=I32) % v  # receiver's member index
+    cols = []
+    for i in range(v):
+        acc = None
+        for r in range(v):
+            src = x[:, r]
+            if r != i:
+                src = jnp.roll(src, r - i, axis=0)
+            if acc is None:
+                acc = src
+            else:
+                m = res == r
+                m = m.reshape(m.shape + (1,) * (src.ndim - 1))
+                acc = jnp.where(m, src, acc)
+        cols.append(acc)
+    return jnp.stack(cols, axis=1)
+
+
+# route implementation switch: "shift" (default, retile-free) or
+# "transpose" (the original formulation, kept for A/B and as the oracle in
+# tests). Read once per process at trace time.
+_ROUTE_IMPL = os.environ.get("RAFT_TPU_ROUTE", "shift")
+
+
+def route_fabric(out: Fabric, v: int, mute=None, impl: str | None = None) -> Fabric:
+    """Deliver: inbox[g, j, i] = outbox[g, i, j]; the self slot passes
+    through (it is the lane's own queued ack).
 
     mute: optional [N] bool — a muted lane neither sends nor receives (the
     fabric analog of rafttest/network.go:122-144 disconnect)."""
+    impl = impl or _ROUTE_IMPL
+    if impl not in ("shift", "transpose"):
+        raise ValueError(
+            f"route impl {impl!r}: expected 'shift' or 'transpose' "
+            "(RAFT_TPU_ROUTE)"
+        )
+    field = _route_shift_field if impl == "shift" else _route_transpose_field
 
     def t(x):
-        g = x.shape[0] // v
-        y = x.reshape((g, v, v) + x.shape[2:])
-        y = jnp.swapaxes(y, 1, 2)
-        return y.reshape(x.shape)
+        return field(x, v)
+
+    def src_mute_cols(n):
+        # cell [dst, i] came from lane (dst//v)*v + i
+        if impl == "shift":
+            return t(jnp.broadcast_to(mute[:, None], (n, v)))
+        g = n // v
+        return jnp.broadcast_to(mute.reshape(g, 1, v), (g, v, v)).reshape(n, v)
 
     def deliver(chan):
         chan = jax.tree.map(t, chan)
         if mute is None:
             return chan
-        n = mute.shape[0]
-        g = n // v
-        # after transpose, cell [dst, i] came from lane (dst//v)*v + i
-        src_mute = jnp.broadcast_to(
-            mute.reshape(g, 1, v), (g, v, v)
-        ).reshape(n, v)
-        cut = src_mute | mute[:, None]
+        cut = src_mute_cols(mute.shape[0]) | mute[:, None]
         return dataclasses.replace(
             chan, kind=jnp.where(cut, jnp.int32(MT.MSG_NONE), chan.kind)
         )
