@@ -1,0 +1,74 @@
+"""Chip-scale membership-change soak: the reference's
+confchange_v2_replace_leader.txt flow (enter joint, transfer to the newly
+promoted side, leave joint — confchange/confchange.go:51-145,
+raft.go:1888-1970) executed simultaneously in EVERY group of a large
+batch mid-replication on the real chip, commits required to advance
+through every phase.
+
+The flow itself is raft_tpu/testing/confchange_flow.py — the same driver
+tests/test_fused_confchange.py runs at 1024 CPU groups — here at
+SOAK_GROUPS (default 65536) on TPU. Prints one JSON line per phase and a
+summary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+if jax.default_backend() != "cpu":
+    enable_persistent_cache()
+
+from raft_tpu.config import Shape
+from raft_tpu.ops.fused import FusedCluster
+from raft_tpu.testing.confchange_flow import replace_leader_joint_flow
+
+
+def main():
+    g = int(os.environ.get("SOAK_GROUPS", 65536))
+    v = 4  # 3 voters + learner headroom (id 4 starts as learner)
+    shape = Shape(
+        n_lanes=g * v, max_peers=v, log_window=32,
+        max_msg_entries=2, max_inflight=2,
+    )
+    c = FusedCluster(g, v, seed=7, shape=shape, learner_ids=(4,))
+    t_all = time.perf_counter()
+
+    # elect id 1 everywhere
+    hups = {l: True for l in range(0, g * v, v)}
+    c.run(1, ops=c.ops(hup=hups), do_tick=False)
+    c.run(3, auto_propose=True)
+    leaders = c.leader_lanes()
+    assert len(leaders) == g, f"{len(leaders)}/{g} elected"
+
+    marks = [time.perf_counter()]
+
+    def on_phase(name):
+        marks.append(time.perf_counter())
+        print(
+            json.dumps({"phase": name, "s": round(marks[-1] - marks[-2], 1)}),
+            flush=True,
+        )
+
+    com = replace_leader_joint_flow(c, on_phase=on_phase)
+    print(
+        json.dumps(
+            {
+                "confchange_soak": "ok",
+                "groups": g,
+                "voters": v,
+                "commits_per_phase": [b - a for a, b in zip(com, com[1:])],
+                "wall_s": round(time.perf_counter() - t_all, 1),
+                "platform": jax.devices()[0].platform,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -35,58 +35,7 @@ import numpy as np
 
 from raft_tpu.config import Shape
 from raft_tpu.ops.fused import FusedCluster
-from raft_tpu.types import StateType
-
-
-def check_phase(c, com_prev, rng, sample, terms_seen):
-    n, v, g = c.state.id.shape[0], c.v, c.g
-    st = np.asarray(c.state.state)
-    term = np.asarray(c.state.term)
-    com = np.asarray(c.state.committed)
-    last = np.asarray(c.state.last)
-    snap = np.asarray(c.state.snap_index)
-    ap = np.asarray(c.state.applied)
-    ag = np.asarray(c.state.applying)
-    err = np.asarray(c.state.error_bits)
-
-    assert (err == 0).all(), f"error_bits set on {int((err != 0).sum())} lanes"
-    assert (snap <= ap).all() and (ap <= ag).all()
-    assert (ag <= com).all() and (com <= last).all()
-    assert (com >= com_prev).all(), "commit regressed"
-
-    # Election Safety, vectorized AND cross-phase: per group, leaders
-    # sharing a term — including a leader of (group, term) seen at any
-    # EARLIER checkpoint (tests/test_fused_invariants.py election_safety)
-    lead = st == int(StateType.LEADER)
-    lt = np.where(lead, term, -np.arange(n) - 1)  # unique filler for non-leaders
-    lt = lt.reshape(g, v)
-    srt = np.sort(lt, axis=1)
-    dup = (srt[:, 1:] == srt[:, :-1]).any(axis=1)
-    assert not dup.any(), f"two leaders in one term in groups {np.nonzero(dup)[0][:5]}"
-    for lane in np.nonzero(lead)[0]:
-        key = (int(lane) // v, int(term[lane]))
-        prev = terms_seen.setdefault(key, int(lane))
-        assert prev == int(lane), (
-            f"group {key[0]} term {key[1]}: leaders {prev} and {int(lane)}"
-        )
-
-    # Log Matching on sampled groups
-    w = c.state.log_term.shape[-1]
-    logt = np.asarray(c.state.log_term)
-    for gi in rng.choice(g, size=min(sample, g), replace=False):
-        lanes = list(range(gi * v, (gi + 1) * v))
-        for ai in range(v):
-            for bi in range(ai + 1, v):
-                a, b = lanes[ai], lanes[bi]
-                lo = int(max(snap[a], snap[b])) + 1
-                hi = int(min(com[a], com[b]))
-                if hi < lo:
-                    continue
-                idx = np.arange(lo, hi + 1)
-                assert (logt[a, idx & (w - 1)] == logt[b, idx & (w - 1)]).all(), (
-                    f"log mismatch group {gi} lanes {a},{b}"
-                )
-    return com
+from raft_tpu.testing.invariants import check_all
 
 
 def main():
@@ -130,11 +79,11 @@ def main():
         # check UNDER the partition too — compaction during the healed
         # settle could otherwise advance snap past a partition-era
         # divergence before the log-matching window sees it
-        com_prev = check_phase(c, com_prev, rng, sample, terms_seen)
+        com_prev = check_all(c, com_prev, terms_seen, sample=sample, rng=rng)
         # heal and settle so commit can advance everywhere
         c.mute = jnp.zeros((n,), jnp.bool_)
         c.run(rounds, auto_propose=True, auto_compact_lag=8)
-        com_prev = check_phase(c, com_prev, rng, sample, terms_seen)
+        com_prev = check_all(c, com_prev, terms_seen, sample=sample, rng=rng)
         print(
             json.dumps(
                 {

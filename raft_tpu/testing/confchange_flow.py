@@ -1,0 +1,89 @@
+"""The reference's confchange_v2_replace_leader.txt flow as a reusable
+driver over a fused batch: enter joint consensus (promote learner 4,
+remove voter 1), transfer leadership to the newly promoted side, leave
+joint — executed simultaneously in EVERY group, with commits required to
+advance through every phase (confchange/confchange.go:51-145,
+raft.go:1888-1970).
+
+Shared by tests/test_fused_confchange.py (1k groups, CPU) and
+benches/confchange_soak.py (65k groups, TPU) so the protocol lives in one
+place. The batch must be built with v=4, learner_ids=(4,), and id 1
+elected everywhere (lane g*v) before calling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu import confchange as ccm
+
+
+def _assert_config(c, vin: set, vout: set, learners: set):
+    """EVERY lane of EVERY group installed exactly this configuration
+    (ids via the canonical prs_id table)."""
+    ids = np.asarray(c.state.prs_id)
+    live = ids != 0
+    for mask, want, name in (
+        (np.asarray(c.state.voters_in), vin, "voters_in"),
+        (np.asarray(c.state.voters_out), vout, "voters_out"),
+        (np.asarray(c.state.learners), learners, "learners"),
+    ):
+        expect = np.isin(ids, sorted(want)) & live if want else np.zeros_like(live)
+        assert (mask == expect).all(), f"{name} mismatch somewhere in the batch"
+
+
+def replace_leader_joint_flow(c, on_phase=None, transfer_retries=12):
+    """Run the full cycle on cluster `c`; assert configs and liveness at
+    every phase. `on_phase(name)` is called after each phase (hook for
+    timing/printing). Returns the per-phase committed totals."""
+    g, v = c.g, c.v
+    ch = c.conf_changer()
+    com_of = lambda: int(np.asarray(c.state.committed, np.int64).sum())
+    com = [com_of()]
+
+    def done(name):
+        com.append(com_of())
+        assert com[-1] > com[-2], f"{name}: commits stalled"
+        c.check_no_errors()
+        if on_phase:
+            on_phase(name)
+
+    # phase 1: EnterJoint(explicit): promote learner 4, remove voter 1
+    cc = ccm.ConfChangeV2(
+        transition=int(ccm.ConfChangeTransition.JOINT_EXPLICIT),
+        changes=[
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_NODE), 4),
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.REMOVE_NODE), 1),
+        ],
+    )
+    accepted = ch.propose(cc)
+    assert len(accepted) == g, f"only {len(accepted)}/{g} accepted enter-joint"
+    ch.settle(auto_leave=False, auto_propose=True)
+    _assert_config(c, vin={2, 3, 4}, vout={1, 2, 3}, learners=set())
+    done("enter_joint_promote4_remove1")
+
+    # phase 2: transfer leadership 1 -> 2 while in joint
+    leaders = c.leader_lanes()
+    c.run(1, ops=c.ops(transfer_to={int(l): 2 for l in leaders}), do_tick=False)
+    for _ in range(transfer_retries):
+        c.run(2, auto_propose=True)
+        leaders = c.leader_lanes()
+        if len(leaders) == g and all(l % v == 1 for l in leaders):
+            break
+    leaders = c.leader_lanes()
+    assert len(leaders) == g, f"{len(leaders)}/{g} leaders after transfer"
+    assert all(l % v == 1 for l in leaders), "leadership not on id 2"
+    done("transfer_to_2_while_joint")
+
+    # phase 3: the new leaders leave joint
+    c.run(2, auto_propose=True)  # let the new term's empty entry apply
+    accepted = ch.propose(ccm.ConfChangeV2())
+    assert len(accepted) == g, f"only {len(accepted)}/{g} accepted leave-joint"
+    ch.settle(auto_propose=True)
+    _assert_config(c, vin={2, 3, 4}, vout=set(), learners=set())
+    done("leave_joint")
+
+    # phase 4: the batch keeps serving under the new config
+    c.run(8, auto_propose=True)
+    done("serve_under_new_config")
+    return com

@@ -1,0 +1,86 @@
+"""Raft safety-invariant oracles (paper §5) over a fused batch.
+
+Shared by the CPU fault-injection suite (tests/test_fused_invariants.py)
+and the chip-scale soaks (benches/soak.py) so both check the SAME
+properties: cursor ordering, Log Matching, commit monotonicity, and
+Election Safety tracked across checkpoints.
+
+All oracles take the cluster object (needs `.state`, `.g`, `.v`) and
+assert; they are host-side numpy, vectorized where the scale demands it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.types import StateType
+
+
+def cursor_order(c):
+    """snap <= applied <= applying <= committed <= last, every lane."""
+    ap = np.asarray(c.state.applied)
+    ag = np.asarray(c.state.applying)
+    com = np.asarray(c.state.committed)
+    last = np.asarray(c.state.last)
+    snap = np.asarray(c.state.snap_index)
+    assert (snap <= ap).all() and (ap <= ag).all()
+    assert (ag <= com).all() and (com <= last).all()
+
+
+def log_matching(c, sample: int | None = None, rng=None):
+    """Committed entries at the same index carry the same term across the
+    members of a group (within the resident windows). Checks every group,
+    or a random `sample` of groups when given (chip-scale soaks)."""
+    w = c.state.log_term.shape[-1]
+    v = c.v
+    lt = np.asarray(c.state.log_term)
+    com = np.asarray(c.state.committed)
+    snap = np.asarray(c.state.snap_index)
+    if sample is None or sample >= c.g:
+        groups = range(c.g)
+    else:
+        groups = (rng or np.random.default_rng()).choice(
+            c.g, size=sample, replace=False
+        )
+    for gi in groups:
+        lanes = range(gi * v, (gi + 1) * v)
+        for a in lanes:
+            for b in lanes:
+                if b <= a:
+                    continue
+                lo = int(max(snap[a], snap[b])) + 1
+                hi = int(min(com[a], com[b]))
+                if hi < lo:
+                    continue
+                idx = np.arange(lo, hi + 1)
+                assert (lt[a, idx & (w - 1)] == lt[b, idx & (w - 1)]).all(), (
+                    f"log mismatch g{gi} lanes {a},{b}"
+                )
+
+
+def election_safety(c, terms_seen: dict):
+    """At most one leader per (group, term) across the whole run: callers
+    pass the same dict at every checkpoint and the oracle records/asserts
+    incrementally (the paper's Election Safety invariant)."""
+    st = np.asarray(c.state.state)
+    tm = np.asarray(c.state.term)
+    for lane in np.nonzero(st == int(StateType.LEADER))[0]:
+        key = (int(lane) // c.v, int(tm[lane]))
+        prev = terms_seen.setdefault(key, int(lane))
+        assert prev == int(lane), (
+            f"two leaders for group {key[0]} term {key[1]}: {prev}, {int(lane)}"
+        )
+
+
+def check_all(c, com_prev, terms_seen: dict, sample: int | None = None, rng=None):
+    """Composite checkpoint: error_bits clean, cursors ordered, commits
+    monotone, Election Safety, Log Matching. Returns the new committed
+    vector to thread into the next checkpoint."""
+    err = np.asarray(c.state.error_bits)
+    assert (err == 0).all(), f"error_bits set on {int((err != 0).sum())} lanes"
+    cursor_order(c)
+    com = np.asarray(c.state.committed).astype(np.int64)
+    assert (com >= com_prev).all(), "commit regressed"
+    election_safety(c, terms_seen)
+    log_matching(c, sample=sample, rng=rng)
+    return com

@@ -56,65 +56,29 @@ def config_of(c, lane):
 
 def test_replace_leader_joint_1k_groups():
     """Replace the leader via joint consensus in all 1024 groups of a batch
-    that keeps replicating throughout (the bench-config-4 workload shape)."""
+    that keeps replicating throughout (the bench-config-4 workload shape).
+    The flow itself lives in raft_tpu/testing/confchange_flow.py, shared
+    with the 65k-group chip soak (benches/confchange_soak.py)."""
+    from raft_tpu.testing.confchange_flow import replace_leader_joint_flow
+
     G = 1024
     c = make_batch(G)
     elect_id1(c)
-    ch = c.conf_changer()
 
-    com = [committed_total(c)]
+    seen = []
+    com = replace_leader_joint_flow(c, on_phase=seen.append)
 
-    # phase 1: EnterJoint(explicit): promote learner 4, remove voter 1
-    cc = ccm.ConfChangeV2(
-        transition=int(ccm.ConfChangeTransition.JOINT_EXPLICIT),
-        changes=[
-            ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_NODE), 4),
-            ccm.ConfChangeSingle(int(ccm.ConfChangeType.REMOVE_NODE), 1),
-        ],
-    )
-    accepted = ch.propose(cc)
-    assert len(accepted) == G, f"only {len(accepted)} groups accepted the cc"
-    ch.settle(auto_leave=False, auto_propose=True)
-    com.append(committed_total(c))
-
-    vin, vout, lrn = config_of(c, 0)
-    assert vin == {2, 3, 4} and vout == {1, 2, 3} and lrn == set()
-    # every lane of every group installed the same joint config
-    assert bool(np.asarray(c.state.voters_out).any(axis=1).all())
-
-    # phase 2: transfer leadership 1 -> 2 while in joint
-    leaders = c.leader_lanes()
-    c.run(1, ops=c.ops(transfer_to={int(l): 2 for l in leaders}), do_tick=False)
-    for _ in range(8):
-        c.run(2, auto_propose=True)
-        leaders = c.leader_lanes()
-        if len(leaders) == G and all(l % c.v == 1 for l in leaders):
-            break
-    leaders = c.leader_lanes()
-    assert len(leaders) == G
-    assert all(l % c.v == 1 for l in leaders), "leadership not on id 2"
-    com.append(committed_total(c))
-
-    # phase 3: the new leaders leave joint
-    c.run(2, auto_propose=True)  # let the new term's empty entry apply
-    accepted = ch.propose(ccm.ConfChangeV2())
-    assert len(accepted) == G, f"only {len(accepted)} groups accepted leave"
-    ch.settle(auto_propose=True)
-    com.append(committed_total(c))
-
+    # the driver asserted liveness each phase; spot-check the configs at
+    # sample lanes here (the driver checks the batch-wide invariants)
     vin, vout, lrn = config_of(c, 1)
     assert vin == {2, 3, 4} and vout == set() and lrn == set()
-    # the removed member is untracked everywhere in the group
-    assert not bool(np.asarray(c.state.voters_in[:, 0]).any())
-
-    # commits advanced in every phase: replication never stalled
-    assert com[1] > com[0] and com[2] > com[1] and com[3] > com[2], com
-
-    # the batch keeps serving under the new config
-    before = committed_total(c)
-    c.run(4, auto_propose=True)
-    assert committed_total(c) > before
-    c.check_no_errors()
+    assert seen == [
+        "enter_joint_promote4_remove1",
+        "transfer_to_2_while_joint",
+        "leave_joint",
+        "serve_under_new_config",
+    ]
+    assert len(com) == 5 and all(b > a for a, b in zip(com, com[1:]))
 
 
 def test_learner_promotion_simple():

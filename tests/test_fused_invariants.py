@@ -7,38 +7,8 @@ import numpy as np
 import pytest
 
 from raft_tpu.ops.fused import FusedCluster
+from raft_tpu.testing.invariants import cursor_order, election_safety, log_matching
 from raft_tpu.types import StateType
-
-
-def log_matching(c):
-    """Committed entries at the same index have the same term across the
-    members of every group (within the resident windows)."""
-    w = c.state.log_term.shape[-1]
-    lt = np.asarray(c.state.log_term)
-    com = np.asarray(c.state.committed)
-    snap = np.asarray(c.state.snap_index)
-    for g in range(c.g):
-        lanes = range(g * c.v, (g + 1) * c.v)
-        for a in lanes:
-            for b in lanes:
-                if b <= a:
-                    continue
-                lo = max(snap[a], snap[b]) + 1
-                hi = min(com[a], com[b])
-                for idx in range(lo, hi + 1):
-                    assert lt[a, idx & (w - 1)] == lt[b, idx & (w - 1)], (
-                        f"log mismatch g{g} lanes {a},{b} idx {idx}"
-                    )
-
-
-def cursor_order(c):
-    ap = np.asarray(c.state.applied)
-    ag = np.asarray(c.state.applying)
-    com = np.asarray(c.state.committed)
-    last = np.asarray(c.state.last)
-    snap = np.asarray(c.state.snap_index)
-    assert (snap <= ap).all() and (ap <= ag).all()
-    assert (ag <= com).all() and (com <= last).all()
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
@@ -78,22 +48,6 @@ def test_random_partitions_preserve_safety(seed):
         assert (st[sl] == StateType.LEADER).sum() == 1, st[sl]
         com = np.asarray(c.state.committed)[sl]
         assert com.max() - com.min() <= 2, com
-
-
-def election_safety(c, terms_seen):
-    """At most one leader per (group, term), across the whole run (the
-    paper's Election Safety invariant tracked incrementally)."""
-    st = np.asarray(c.state.state)
-    tm = np.asarray(c.state.term)
-    for lane in range(st.shape[0]):
-        if st[lane] == StateType.LEADER:
-            g = lane // c.v
-            key = (g, int(tm[lane]))
-            prev = terms_seen.get(key)
-            assert prev in (None, lane), (
-                f"two leaders for group {g} term {tm[lane]}: {prev}, {lane}"
-            )
-            terms_seen[key] = lane
 
 
 @pytest.mark.parametrize("seed", list(range(4)))
