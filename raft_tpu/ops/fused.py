@@ -258,6 +258,10 @@ def _route_shift_field(x, v):
 # original formulation, fewer kernels — wins at tiny N where everything is
 # kernel-count bound; also the oracle in tests) below that. Read once per
 # process at trace time; n is static under jit so the choice compiles in.
+# Caveat: "shift" emits V^2 roll+select kernels per fabric field (~25
+# fields), so kernel count and compile time grow quadratically in the
+# voter count — benched and wins at v<=7; if larger v is ever supported,
+# fold v into this heuristic (big v + small n should stay "transpose").
 _ROUTE_IMPL = os.environ.get("RAFT_TPU_ROUTE", "auto")
 _AUTO_SHIFT_MIN_LANES = 256
 

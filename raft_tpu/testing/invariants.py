@@ -61,7 +61,15 @@ def log_matching(c, sample: int | None = None, rng=None):
 def election_safety(c, terms_seen: dict):
     """At most one leader per (group, term) across the whole run: callers
     pass the same dict at every checkpoint and the oracle records/asserts
-    incrementally (the paper's Election Safety invariant)."""
+    incrementally (the paper's Election Safety invariant).
+
+    Granularity caveat: leadership is sampled only at checkpoints — a
+    transient second leader for the same (group, term) that appears and
+    steps down BETWEEN two check_all calls is invisible to this oracle.
+    The continuous check is in-kernel: the vote-tally/become-leader paths
+    set `state.error_bits` on any double-grant, and check_all asserts
+    those bits are zero, so the soaks' safety claim rests on error_bits
+    with this oracle as a coarser cross-check."""
     st = np.asarray(c.state.state)
     tm = np.asarray(c.state.term)
     for lane in np.nonzero(st == int(StateType.LEADER))[0]:

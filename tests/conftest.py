@@ -38,8 +38,11 @@ def pytest_collection_modifyitems(config, items):
     # of a full-suite run is still risky). Warn from gw0 only to avoid one
     # warning per worker.
     worker = os.environ.get("PYTEST_XDIST_WORKER")
+    # numprocesses may still be 'auto'/'logical' if read before xdist
+    # resolves it (plugin-ordering dependent) — treat non-int as unknown.
+    _np = getattr(config.option, "numprocesses", None)
     nworkers = int(os.environ.get("PYTEST_XDIST_WORKER_COUNT") or 0) or (
-        getattr(config.option, "numprocesses", None) or 0
+        _np if isinstance(_np, int) else 0
     )
     safe = nworkers >= 4
     if worker not in (None, "gw0"):
