@@ -223,6 +223,23 @@ def _cluster_round_impl(
     # synchronous durability: everything appended this round is persisted
     # before any message emitted this round is delivered (doc.go:79-86)
     state = dataclasses.replace(state, stabled=state.last)
+    # ...including a snapshot restored this round: the async model's
+    # MSG_STORAGE_APPEND_RESP snapshot ack (step.py:701-709) collapses to
+    # the round boundary, clearing pending_snap_* — without this a restored
+    # follower would stay unpromotable (step.py promotable) forever. The
+    # fused engine's apply-phase `applied_snap` block is the same rule.
+    has_ps = state.pending_snap_index != 0
+    state = dataclasses.replace(
+        state,
+        applied=jnp.where(
+            has_ps, jnp.maximum(state.applied, state.pending_snap_index), state.applied
+        ),
+        applying=jnp.where(
+            has_ps, jnp.maximum(state.applying, state.pending_snap_index), state.applying
+        ),
+        pending_snap_index=jnp.where(has_ps, 0, state.pending_snap_index),
+        pending_snap_term=jnp.where(has_ps, 0, state.pending_snap_term),
+    )
     # auto-apply committed entries (the trivial test state machine)
     applied_bytes = _bytes_between(state, state.applied, state.committed)
     state = lg.applied_to(state, state.committed)
@@ -285,6 +302,7 @@ class Cluster:
         shape: Shape | None = None,
         seed: int = 1,
         group_ids=None,
+        inbox_slack: int = 0,
         **cfg_overrides,
     ):
         """group_ids: optional [G][V] table of distinct member ids per group
@@ -332,8 +350,11 @@ class Cluster:
         # messages + self-ack + reply per step, and the batch-released
         # ReadIndex prefix can add up to R-1 extra MsgReadIndexResp to the
         # SAME requester in one step (step.py drain slots) — size for the
-        # burst so route() never silently drops read responses
-        self.m_in = 2 * self.shape.v + 2 + (self.shape.max_read_index - 1)
+        # burst so route() never silently drops read responses.
+        # inbox_slack: extra slots for host-injected local messages that
+        # share the inbox with routed traffic (e.g. the lockstep harness
+        # injects beat/prop/read/snap-status alongside a full fan-in).
+        self.m_in = 2 * self.shape.v + 2 + (self.shape.max_read_index - 1) + inbox_slack
         # pending inbox is host-mutable so tests can inject local messages
         self._pending = jax.tree.map(
             lambda x: np.array(x), empty_batch((n, self.m_in), self.shape.max_msg_entries)

@@ -460,12 +460,15 @@ class LocalOps(NamedTuple):
     # appends the typed entry, applies the proposal gating, and tracks
     # pendingConfIndex (raft.go:1259-1301). See ops/fused_confchange.py.
     prop_cc: Any  # [N] i32
+    # host-fired MsgBeat (raft.go:1228-1230) — a heartbeat broadcast outside
+    # the tick cadence, e.g. for tickless lockstep drives (testing/lockstep)
+    beat: Any  # [N] bool
 
 
 def no_ops(n: int) -> LocalOps:
     z = jnp.zeros((n,), I32)
     zb = jnp.zeros((n,), BOOL)
-    return LocalOps(zb, z, z, z, z, zb, z)
+    return LocalOps(zb, z, z, z, z, zb, z, zb)
 
 
 def make_local_ops(n: int, **kw) -> LocalOps:
@@ -474,7 +477,7 @@ def make_local_ops(n: int, **kw) -> LocalOps:
     import numpy as np
 
     base = {
-        f: np.zeros((n,), np.bool_ if f in ("hup", "forget") else np.int32)
+        f: np.zeros((n,), np.bool_ if f in ("hup", "forget", "beat") else np.int32)
         for f in LocalOps._fields
     }
     for k, val in kw.items():
@@ -887,7 +890,16 @@ def fused_round(
     )
     want_send(need_app)
 
-    # ReadIndex acks via heartbeat ctx (raft.go:1548-1561, read_only.go)
+    # ReadIndex acks via heartbeat ctx (raft.go:1548-1561,
+    # read_only.go:68-112): a quorum ack for a ctx releases the whole FIFO
+    # *prefix* up to and including that request — quorum confirmation of
+    # leadership at a later enqueue point covers every earlier pending
+    # read. Mirrors the serial MsgHeartbeatResp block (step.py:1144-1239)
+    # with the fused model's requester == self simplification. (The
+    # original fused rule here released slots individually, which could
+    # strand an earlier read whose acks were lost and, because freed low
+    # slots are reused, emit ReadStates out of enqueue order — both caught
+    # by the lockstep differential, testing/lockstep.py.)
     r_ax = state.ro_ctx.shape[1]
     hit = (
         hr_cell[:, None, :]
@@ -899,21 +911,32 @@ def fused_round(
     ro_res = qr.joint_vote(
         ro_votes, state.voters_in[:, None, :], state.voters_out[:, None, :]
     )
-    release = (state.ro_ctx != 0) & (ro_res == VoteResult.VOTE_WON) & hit.any(axis=2)
-    # all released slots emit ReadStates this round (requester = self in the
-    # fused model); pack into the rs ring
-    rel_rank = jnp.cumsum(release.astype(I32), axis=1) - 1
+    live_ro = state.ro_ctx != 0
+    won = live_ro & (ro_res == VoteResult.VOTE_WON) & hit.any(axis=2)
+    won_seq = jnp.max(jnp.where(won, state.ro_seq, -1), axis=1)  # [N]
+    release = live_ro & (state.ro_seq <= won_seq[:, None])
+    # pack released slots into the rs ring in FIFO (ro_seq) order — slot
+    # order diverges from enqueue order once freed low slots are reused
+    sq = state.ro_seq
+    rel_rank = jnp.sum(
+        release[:, None, :] & (sq[:, None, :] < sq[:, :, None]), axis=-1
+    )
     dst_slot = state.rs_count[:, None] + rel_rank
     put = release & (dst_slot < r_ax)
+    # only slots whose ReadState actually packed clear; an rs-ring overflow
+    # keeps the (highest-seq, so still FIFO-contiguous) tail pending for a
+    # later quorum hit instead of silently dropping confirmed reads —
+    # mirrors the serial ok_rs gating (step.py)
     state = dataclasses.replace(
         state,
         rs_ctx=ohm.scatter_set(state.rs_ctx, jnp.clip(dst_slot, 0, r_ax - 1), state.ro_ctx, put),
         rs_index=ohm.scatter_set(state.rs_index, jnp.clip(dst_slot, 0, r_ax - 1), state.ro_index, put),
         rs_count=jnp.minimum(state.rs_count + jnp.sum(put.astype(I32), axis=1), r_ax),
-        ro_ctx=_w(release, 0, state.ro_ctx),
-        ro_from=_w(release, 0, state.ro_from),
-        ro_index=_w(release, 0, state.ro_index),
-        ro_acks=jnp.where(release[:, :, None], False, acks),
+        ro_ctx=_w(put, 0, state.ro_ctx),
+        ro_from=_w(put, 0, state.ro_from),
+        ro_index=_w(put, 0, state.ro_index),
+        ro_seq=_w(put, 0, state.ro_seq),
+        ro_acks=jnp.where(put[:, :, None], False, acks),
     )
 
     # Msg(Pre)VoteResp cells -> poll (raft.go:1041-1049, 1647-1666)
@@ -972,9 +995,21 @@ def fused_round(
         pr_recent_active=_w(cq[:, None] & ~is_self, False, state.pr_recent_active),
     )
 
-    # heartbeats (MsgBeat, raft.go:1228-1230)
+    # heartbeats (MsgBeat, raft.go:1228-1230) — carry the newest pending
+    # ReadIndex ctx so acks lost to a partition re-confirm on the next
+    # beat (read_only.go lastPendingRequestCtx; mirrors the serial
+    # MSG_BEAT block, step.py:856-868)
     is_leader = state.state == StateType.LEADER
-    state = stepmod.bcast_heartbeat(state, fire_beat & is_leader, out)
+    beat_live = state.ro_ctx != 0
+    beat_newest = jnp.argmax(
+        jnp.where(beat_live, state.ro_seq, -1), axis=1
+    ).astype(I32)
+    beat_ctx = jnp.where(
+        beat_live.any(axis=1), ohm.gather(state.ro_ctx, beat_newest), 0
+    )
+    state = stepmod.bcast_heartbeat(
+        state, (fire_beat | ops.beat) & is_leader, out, ctx=beat_ctx
+    )
 
     # proposals (raft.go:1244-1302; conf-change entries excluded by scope)
     prop_n = jnp.where(auto_propose, jnp.maximum(ops.prop_n, is_leader.astype(I32)), ops.prop_n)
@@ -1029,8 +1064,14 @@ def fused_round(
     )
     want_send(cc_appended[:, None] & all_peers)
 
-    # transfer-leadership request (raft.go:1587-1618), injected at the leader
+    # transfer-leadership request (raft.go:1587-1618), injected at the
+    # leader. Refused for untracked or learner transferees (raft.go:
+    # 1592-1596 — the serial gate at step.py:1296-1306; the learner and
+    # trackedness checks here were caught by the lockstep differential).
     tt = ops.transfer_to
+    t_slot = jnp.clip(tt - 1, 0, v - 1)
+    t_tracked = ohm.gather(state.prs_id, t_slot) != 0
+    t_learner = ohm.gather(state.learners, t_slot)
     t_ok = (
         is_leader
         & (tt != 0)
@@ -1038,8 +1079,9 @@ def fused_round(
         & (tt != state.id)
         & (tt >= 1)
         & (tt <= v)
+        & t_tracked
+        & ~t_learner
     )
-    t_slot = jnp.clip(tt - 1, 0, v - 1)
     t_cell = ohm.onehot(t_slot, v) & t_ok[:, None]
     state = dataclasses.replace(
         state,
@@ -1073,6 +1115,10 @@ def fused_round(
         ro_from=_w(put_r, state.id[:, None], state.ro_from),
         ro_index=_w(put_r, state.committed[:, None], state.ro_index),
         ro_acks=_w(put_r[:, :, None], is_self[:, None, :], state.ro_acks),
+        # enqueue sequence — the FIFO order the prefix-release rule and the
+        # beat ctx pick rely on (serial counterpart: step.py:976-986)
+        ro_seq=_w(put_r, state.ro_next_seq[:, None], state.ro_seq),
+        ro_next_seq=state.ro_next_seq + can_enq.astype(I32),
     )
     state = stepmod.bcast_heartbeat(state, can_enq, out, ctx=ops.read_ctx)
     # immediate release -> rs ring
@@ -1087,11 +1133,15 @@ def fused_round(
         ),
     )
 
-    # forget leader (raft.go:1700-1708)
+    # forget leader (raft.go:1700-1708; refused under lease-based reads,
+    # matching the serial gate at step.py:1397-1403)
     state = dataclasses.replace(
         state,
         lead=_w(
-            ops.forget & (state.state == StateType.FOLLOWER) & (state.lead != 0),
+            ops.forget
+            & (state.state == StateType.FOLLOWER)
+            & (state.lead != 0)
+            & ~state.cfg.read_only_lease_based,
             0,
             state.lead,
         ),

@@ -130,10 +130,14 @@ def install_config(
         lead_transferee=jnp.where(step_down | tr_gone, 0, state.lead_transferee),
     )
     # keep the carry diet invariant: a state installed mid-run must present
-    # the same dtypes the fused scan carries (state.STATE_SLIM)
+    # the same dtypes the caller's engine carries — the fused scan's slim
+    # STATE_SLIM dtypes, or plain i32 when installing into the serial
+    # conformance engine (testing/lockstep.py drives both through here)
     from raft_tpu.state import slim_state
 
-    return slim_state(state)
+    if state.log_type.dtype == jnp.int8:
+        return slim_state(state)
+    return state
 
 
 class FusedConfChanger:

@@ -957,8 +957,28 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     )
     serve = ri & (single | committed_in_term)
     immediate = serve & (single | state.cfg.read_only_lease_based)
+    # a locally-requested immediate read appends its ReadState directly
+    # (raft.go:1305-1310 + responseToReadIndexReq local branch,
+    # raft.go:2085-2091); only remote requesters get a MsgReadIndexResp
+    imm_self = immediate & (msg.frm == state.id)
+    rs_ax = state.rs_ctx.shape[1]
+    imm_put = (
+        imm_self[:, None]
+        & (jnp.arange(rs_ax, dtype=I32)[None, :] == state.rs_count[:, None])
+        & (state.rs_count[:, None] < rs_ax)
+    )
+    state = dataclasses.replace(
+        state,
+        rs_ctx=_w(imm_put, msg.context[:, None], state.rs_ctx),
+        rs_index=_w(imm_put, state.committed[:, None], state.rs_index),
+        rs_count=_w(
+            imm_self & (state.rs_count < rs_ax),
+            state.rs_count + 1,
+            state.rs_count,
+        ),
+    )
     out.put_reply(
-        immediate,
+        immediate & (msg.frm != state.id),
         type=MT.MSG_READ_INDEX_RESP,
         to=msg.frm,
         frm=state.id,
@@ -1164,13 +1184,21 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     is_won_slot = (
         jnp.arange(state.ro_ctx.shape[1], dtype=I32)[None, :] == won_r[:, None]
     ) & won_any[:, None]
-    self_rel = in_prefix & (state.ro_from == state.id[:, None]) & ~is_won_slot
+    # SELF-requested releases (the won slot included) append straight to the
+    # ReadState ring — the reference's responseToReadIndexReq local branch
+    # (raft.go:2085-2091) never emits a message for them. Routing the won
+    # self slot as a MsgReadIndexResp instead would let a term bump in the
+    # one-round delivery window silently eat a confirmed read (found by the
+    # lockstep differential, testing/lockstep.py).
+    self_rel = in_prefix & (state.ro_from == state.id[:, None])
     remote_rel = in_prefix & (state.ro_from != state.id[:, None]) & ~is_won_slot
-    # the quorum-acked request itself responds exactly as before (reply slot)
+    # the quorum-acked request responds via the reply slot only when its
+    # requester is remote (raft.go:1553-1561)
+    won_from = ohm.gather(state.ro_from, won_r)
     out.put_reply(
-        won_any,
+        won_any & (won_from != state.id),
         type=MT.MSG_READ_INDEX_RESP,
-        to=ohm.gather(state.ro_from, won_r),
+        to=won_from,
         frm=state.id,
         term=state.term,
         index=ohm.gather(state.ro_index, won_r),
@@ -1231,7 +1259,11 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
         ),
         rs_count=state.rs_count + jnp.sum(ok_rs.astype(I32), axis=1),
     )
-    release = is_won_slot | ok_rs | remote_rel
+    # a SELF-requested won slot only clears when its ReadState actually
+    # packed (ok_rs) — with the ring full it stays pending for a later
+    # quorum hit instead of silently vanishing (a remote won slot always
+    # clears: its response message has no ring bound)
+    release = (is_won_slot & (won_from != state.id)[:, None]) | ok_rs | remote_rel
     state = dataclasses.replace(
         state,
         ro_ctx=_w(release, 0, state.ro_ctx),

@@ -1,0 +1,1006 @@
+"""Serial<->fused lockstep differential harness.
+
+Drives the serial conformance engine (cluster.Cluster — the one validated
+bit-identically against the reference's 27 datadriven goldens) and the fused
+throughput engine (ops/fused.FusedCluster — the one behind every headline
+number) through IDENTICAL host-driven traffic, asserting the observable raft
+state equal after every round. This is the golden-grade assurance bridge for
+the fused path: any place the fused whole-round kernel disagrees with the
+conformance oracle under composed feature traffic shows up as a first-round
+divergence with a reproducing seed.
+
+Covered compositions (tests/test_lockstep.py): driven elections (incl.
+PreVote), steady replication with payload bytes, snapshots + in-kernel
+auto-compaction, joint conf changes (replace-leader rebalances, learner
+round-trips), ReadIndex under load, leadership transfers, partitions/heals
+with snapshot catch-up, and a live window-aligned index rebase.
+
+Round discipline (the shared convention of both engines): messages emitted
+in round r deliver in round r+1 after the emitter's sync persist
+(cluster.py module docstring; reference doc.go:75-91). Host ops inject at
+the same round on both sides, ordered like the fused phase order:
+snapshot-status resolution, hup, proposals, conf-change proposals,
+transfers, reads (ops/fused.py fused_round).
+
+Why do_tick=False: under tick-driven traffic a CONTESTED election makes the
+two engines diverge legitimately — the serial scan processes a same-term
+vote-grant before a higher-term vote request sitting later in the same
+inbox (the grant wins an election whose leader then steps down, leaving a
+term-1 entry in its log), while the fused phase order applies the round's
+maximum term first and the stale grant dies. Both behaviors are
+reference-conformant: raft tolerates arbitrary network reordering, and the
+reference's tick()/Step() are independent calls with no defined interleave
+(raft.go:823-862). Lockstep therefore requires a shared intra-round
+ordering, which ticks cannot provide; elections here are host-driven hups
+(one per group at a time), which both engines order identically. The
+in-kernel tick paths keep their own coverage: goldens + raft_test ports on
+the serial engine, scenario/invariant suites on the fused one.
+
+The same freedom explains the one serial-side emulation this harness does:
+the fused fabric resolves snapshot-transfer outcomes in-kernel one round
+after MsgSnap is sent (ops/fused.py "Transport feedback"), while the serial
+engine models the application's ReportSnapshot via MsgSnapStatus
+(step.py MsgSnapStatus; reference raft.go:1562-1579). The harness plays
+that application role for the serial side with the same one-round timing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import Cluster
+from raft_tpu.config import Shape
+from raft_tpu.ops import log as lg
+from raft_tpu.ops.fused import FusedCluster
+from raft_tpu.ops.fused_confchange import FusedConfChanger, install_config
+from raft_tpu.types import EntryType, MessageType as MT, ProgressState, StateType
+
+I32 = jnp.int32
+
+
+@partial(jax.jit, static_argnames=("lag",))
+def _compact_mirror(state, *, lag: int):
+    """The serial-side mirror of the fused in-kernel auto-compaction block
+    (ops/fused.py fused_round auto_compact_lag): refresh the available
+    snapshot at `applied`, then compact keeping `lag` entries."""
+    state = dataclasses.replace(
+        state,
+        avail_snap_index=state.applied,
+        avail_snap_term=lg.term_at(state, state.applied),
+    )
+    target = jnp.maximum(state.snap_index, state.applied - jnp.int32(lag))
+    return lg.compact(state, target, lg.term_at(state, target))
+
+
+class _SerialConfView:
+    """Duck-typed cluster view for FusedConfChanger.apply_ready: exposes
+    .state (proxied to the serial Cluster) and .v. propose()/settle() are
+    never called through this view — the harness injects proposals itself
+    so both engines see them in the same round."""
+
+    def __init__(self, sc: Cluster):
+        self._sc = sc
+        self.v = sc.v
+
+    @property
+    def state(self):
+        return self._sc.state
+
+    @state.setter
+    def state(self, st):
+        self._sc.state = st
+
+
+class LockstepPair:
+    """One serial Cluster + one FusedCluster in lockstep.
+
+    All client/fault operations are expressed once and dispatched to both
+    engines through their own surfaces; `round()` advances both one round
+    and `assert_same()` compares the full observable state.
+    """
+
+    # [N] columns compared exactly every round.
+    STRICT = (
+        "term", "vote", "state", "lead", "lead_transferee", "is_learner",
+        "pending_conf_index", "uncommitted_size",
+        "last", "stabled", "committed", "applying", "applied",
+        "snap_index", "snap_term",
+        "pending_snap_index", "pending_snap_term",
+        "avail_snap_index", "avail_snap_term", "snap_unavailable",
+        "prs_id", "voters_in", "voters_out", "learners", "learners_next",
+        "auto_leave", "votes",
+        "pr_match", "pr_next", "pr_state", "pr_pending_snapshot",
+        "pr_recent_active", "pr_msg_app_flow_paused",
+        "ro_ctx", "ro_from", "ro_index", "ro_acks", "ro_seq", "ro_next_seq",
+        "pri_ctx", "pri_from",
+        "error_bits",
+    )
+    # Window-masked log columns (valid slots only: snap_index < idx <= last).
+    LOG = ("log_term", "log_type", "log_bytes")
+
+    def __init__(
+        self,
+        g: int,
+        v: int,
+        seed: int = 1,
+        shape: Shape | None = None,
+        compact_lag: int | None = None,
+        **cfg,
+    ):
+        self.g, self.v = g, v
+        n = g * v
+        self.shape = shape or Shape(n_lanes=n, max_peers=v)
+        # Proposal forwarding would let a serial follower forward a MsgProp
+        # that raced a same-round step-down, where the fused LocalOps.prop_n
+        # is leader-gated and drops it — the reference's own flag
+        # (raft.go:257-265) pins both engines to the drop behavior.
+        cfg.setdefault("disable_proposal_forwarding", True)
+        # slack for the harness's local injections (beat + prop + read +
+        # transfer + per-peer snap-status) riding alongside a full fan-in
+        self.sc = Cluster(
+            g, v, shape=self.shape, seed=seed, inbox_slack=4 + v, **cfg
+        )
+        self.fc = FusedCluster(g, v, seed=seed, shape=self.shape, **cfg)
+        self.compact_lag = compact_lag
+        self.mute = np.zeros((n,), bool)
+        self.rounds = 0
+        # conf-change drivers: one per engine, fed identical _pending books
+        self._fcc = FusedConfChanger(self.fc)
+        self._scc = FusedConfChanger(_SerialConfView(self.sc))
+        # host-drained read results, per engine: lane -> [(ctx, index)]
+        self.reads = ({}, {})
+
+    # -- op dispatch -------------------------------------------------------
+
+    def set_mute(self, lanes, on: bool = True):
+        lanes = [int(x) for x in np.atleast_1d(np.asarray(lanes, dtype=np.int64))]
+        self.mute[lanes] = on
+        self.fc.set_mute(lanes, on)
+
+    def leader_lanes(self):
+        return self.fc.leader_lanes()
+
+    def _censor_pending(self):
+        """Serial-side partition semantics, identical to the fused
+        route_fabric mute contract: a muted lane neither sends nor receives,
+        but self-addressed messages (the after-append self-acks) pass
+        (ops/fused.py route_fabric: self_ channel bypasses the cut)."""
+        if not self.mute.any():
+            return
+        p = self.sc._pending
+        n, m = p.type.shape
+        v = self.v
+        live = p.type != int(MT.MSG_NONE)
+        lane = np.arange(n)[:, None]
+        own = (lane % v) + 1
+        src_lane = (lane // v) * v + np.clip(p.frm - 1, 0, v - 1)
+        is_self = p.frm == own
+        cut = live & ~is_self & (self.mute[lane] | self.mute[src_lane])
+        p.type[cut] = int(MT.MSG_NONE)
+
+    def _emulate_snap_status(self):
+        """Play the application's ReportSnapshot for the serial engine with
+        the fused engine's timing: every (leader, peer-in-StateSnapshot)
+        pair resolves one round after the MsgSnap send — failure iff either
+        end is muted, success otherwise (ops/fused.py "Transport feedback";
+        reference raft.go:1562-1579).
+
+        Delivery position is handled by _order_pending (class 1): the fused
+        kernel resolves in-flight snapshots at the top of fan-in, so the
+        status must precede this round's heartbeat/ack traffic."""
+        st = self.sc.state
+        roles = np.asarray(st.state)
+        prst = np.asarray(st.pr_state)
+        ids = np.asarray(st.id)
+        v = self.v
+        for lane in np.nonzero(roles == int(StateType.LEADER))[0]:
+            for j in np.nonzero(prst[lane] == int(ProgressState.SNAPSHOT))[0]:
+                peer_lane = (lane // v) * v + int(j)
+                reject = bool(self.mute[lane] or self.mute[peer_lane])
+                self.sc.inject(
+                    int(lane),
+                    type=MT.MSG_SNAP_STATUS,
+                    to=int(ids[lane]),
+                    frm=int(j) + 1,
+                    reject=reject,
+                )
+
+    def _order_pending(self):
+        """Sort each serial inbox into the fused round's PHASE order — the
+        harness's delivery-order convention (raft tolerates any network
+        reordering, so this is a freedom, not a cheat):
+
+          0. term-bumping messages (term > receiver's, minus the PreVote
+             keep-term exceptions) — the fused term ladder applies the
+             round's maximum term before anything else, so a same-round
+             stale grant/ack must already see the bumped term serially;
+          1. MsgSnapStatus (the harness's ReportSnapshot emulation) — the
+             fused kernel resolves in-flight snapshots at the top of
+             fan-in;
+          2. same-term accept acks (MsgAppResp, not reject) by descending
+             index — commit advances complete before any reject- or
+             heartbeat-response-triggered resend snapshots the commit
+             field, matching the fused engine's end-of-round coalesced
+             send;
+          3. everything else, in original (src-lane, slot) order —
+             host-injected ops stay behind routed traffic, like the fused
+             op phases sit behind fan-in.
+        """
+        p = self.sc._pending
+        term = np.asarray(self.sc.state.term, dtype=np.int64)
+        n, m = p.type.shape
+        types = p.type
+        live = types != int(MT.MSG_NONE)
+        keep = (types == int(MT.MSG_PRE_VOTE)) | (
+            (types == int(MT.MSG_PRE_VOTE_RESP)) & ~p.reject
+        )
+        cls = np.full((n, m), 3, np.int64)
+        cls[live & (p.term > term[:, None]) & ~keep] = 0
+        cls[live & (types == int(MT.MSG_SNAP_STATUS))] = 1
+        cls[
+            live
+            & (p.term == term[:, None])
+            & (types == int(MT.MSG_APP_RESP))
+            & ~p.reject
+        ] = 2
+        cls[~live] = 4
+        # order within classes: 0 by term desc, 2 by index desc, else slot
+        slot = np.broadcast_to(np.arange(m)[None, :], (n, m))
+        sub = np.where(
+            cls == 0, -p.term, np.where(cls == 2, -p.index, slot)
+        )
+        order = np.lexsort((slot, sub, cls), axis=1)
+        if (order == slot).all():
+            return
+        for f in dataclasses.fields(p):
+            arr = getattr(p, f.name)
+            idx = order
+            while idx.ndim < arr.ndim:
+                idx = idx[..., None]
+            arr[:] = np.take_along_axis(
+                arr, np.broadcast_to(idx, arr.shape), axis=1
+            )
+
+    def round(
+        self,
+        hup=(),
+        beat=(),
+        prop: dict | None = None,
+        cc=None,
+        cc_groups=None,
+        transfer: dict | None = None,
+        read: dict | None = None,
+        forget=(),
+    ):
+        """One lockstep round. prop: {lane: (n_entries, bytes_each)};
+        transfer: {leader_lane: target_id}; read: {leader_lane: ctx};
+        beat: leader lanes to heartbeat (host-fired MsgBeat — the tickless
+        drive's replacement for the heartbeat cadence, which also unpauses
+        probed followers and re-confirms pending reads);
+        cc: a confchange.ConfChange/ConfChangeV2 proposed at the leaders of
+        cc_groups (default: all groups with a leader)."""
+        ids = np.asarray(self.sc.state.id)
+        # serial-side censor + app-role injections, in fused phase order
+        self._censor_pending()
+        self._emulate_snap_status()
+        for lane in hup:
+            self.sc.inject(int(lane), type=MT.MSG_HUP, to=int(ids[lane]))
+        for lane in beat:
+            self.sc.inject(int(lane), type=MT.MSG_BEAT, to=int(ids[lane]))
+        prop = prop or {}
+        for lane, (k, nbytes) in prop.items():
+            self.sc.inject(
+                int(lane),
+                type=MT.MSG_PROP,
+                to=int(ids[lane]),
+                frm=int(ids[lane]),
+                ent_terms=[0] * k,
+                ent_sizes=[nbytes] * k,
+            )
+        cc_lanes = {}
+        if cc is not None:
+            cc2 = cc.as_v2()
+            kind = 2 if cc2.leave_joint() else 1
+            groups = (
+                set(int(x) for x in cc_groups)
+                if cc_groups is not None
+                else set(range(self.g))
+            )
+            cc_lanes = {
+                int(l): kind
+                for l in self.leader_lanes()
+                if l // self.v in groups
+            }
+            for lane in cc_lanes:
+                self.sc.inject(
+                    lane,
+                    type=MT.MSG_PROP,
+                    to=int(ids[lane]),
+                    frm=int(ids[lane]),
+                    ent_terms=[0],
+                    ent_types=[int(EntryType.ENTRY_CONF_CHANGE_V2)],
+                    ent_sizes=[0],
+                    context=1 if kind == 2 else 0,
+                )
+        transfer = transfer or {}
+        for lane, target in transfer.items():
+            self.sc.inject(
+                int(lane),
+                type=MT.MSG_TRANSFER_LEADER,
+                to=int(ids[lane]),
+                frm=int(target),
+            )
+        read = read or {}
+        for lane, ctx in read.items():
+            self.sc.inject(
+                int(lane),
+                type=MT.MSG_READ_INDEX,
+                to=int(ids[lane]),
+                frm=int(ids[lane]),
+                context=int(ctx),
+            )
+
+        ops = self.fc.ops(
+            hup={int(l): True for l in hup},
+            beat={int(l): True for l in beat},
+            prop_n={int(l): k for l, (k, _) in prop.items()},
+            prop_bytes={int(l): b for l, (_, b) in prop.items()},
+            prop_cc=cc_lanes,
+            transfer_to={int(l): int(t) for l, t in transfer.items()},
+            read_ctx={int(l): int(c) for l, c in read.items()},
+            forget={int(l): True for l in forget},
+        )
+        for lane in forget:
+            self.sc.inject(int(lane), type=MT.MSG_FORGET_LEADER, to=int(ids[lane]))
+
+        self._order_pending()
+        pci_before = np.asarray(self.fc.state.pending_conf_index).copy()
+        self.fc.run(
+            1, ops=ops, do_tick=False, auto_compact_lag=self.compact_lag
+        )
+        self.sc.run(1)
+        if self.compact_lag is not None:
+            self.sc.state = _compact_mirror(self.sc.state, lag=self.compact_lag)
+        self.rounds += 1
+
+        if cc is not None and cc_lanes:
+            self._book_cc(cc2, cc_lanes, pci_before)
+        self._apply_cc()
+        self._drain_reads()
+
+    def _book_cc(self, cc2, cc_lanes, pci_before):
+        """Record accepted conf-change proposals in BOTH changers' pending
+        books (FusedConfChanger.propose's acceptance rule, without the
+        run() it would issue)."""
+        pci = np.asarray(self.fc.state.pending_conf_index)
+        for lane in cc_lanes:
+            grp = lane // self.v
+            idx = int(pci[lane])
+            if idx > int(pci_before[lane]):
+                lanes = set(range(grp * self.v, (grp + 1) * self.v))
+                self._fcc._pending[grp] = (cc2, idx, set(lanes))
+                self._scc._pending[grp] = (cc2, idx, set(lanes))
+
+    def _apply_cc(self):
+        """Poll + install pending conf changes on both engines (the
+        switchToConfig host work, fused_confchange.apply_ready)."""
+        done_f = self._fcc.apply_ready()
+        done_s = self._scc.apply_ready()
+        assert done_f == done_s, f"install skew: fused {done_f} serial {done_s}"
+        # automatic LeaveJoint is proposed by the caller via cc ops (the
+        # harness drives it explicitly so both engines see it in the same
+        # round)
+        return done_f
+
+    def joint_groups_wanting_leave(self):
+        al = np.asarray(self.fc.state.auto_leave)
+        joint = np.asarray(self.fc.state.voters_out).any(axis=1)
+        return [
+            g
+            for g in range(self.g)
+            if al[g * self.v]
+            and joint[g * self.v]
+            and g not in self._fcc._pending
+        ]
+
+    def _drain_reads(self):
+        """Consume released ReadIndex results host-side on both engines.
+        The serial engine releases via a routed MSG_READ_INDEX_RESP (one
+        round later than the fused in-kernel rs_ write), so per-round ring
+        equality is not expected — the cumulative drained sequences are
+        compared at quiesce points (assert_reads)."""
+        for which, c in ((0, self.fc), (1, self.sc)):
+            cnt = np.asarray(c.state.rs_count)
+            if not cnt.any():
+                continue
+            ctx = np.asarray(c.state.rs_ctx)
+            idx = np.asarray(c.state.rs_index)
+            book = self.reads[which]
+            for lane in np.nonzero(cnt > 0)[0]:
+                book.setdefault(int(lane), []).extend(
+                    (int(ctx[lane, k]), int(idx[lane, k]))
+                    for k in range(int(cnt[lane]))
+                )
+            z = jnp.zeros_like(c.state.rs_ctx)
+            c.state = dataclasses.replace(
+                c.state,
+                rs_ctx=z,
+                rs_index=z,
+                rs_count=jnp.zeros_like(c.state.rs_count),
+            )
+
+    def rebase(self, groups, delta: int | None = None) -> dict:
+        """Live index rebase on both engines: the fused side shifts state +
+        in-flight fabric (FusedCluster.rebase_groups); the serial side
+        shifts state + the routed pending inbox by the same per-lane deltas
+        (the host-side mirror of ops/fused.py rebase_fabric)."""
+        out = self.fc.rebase_groups(groups, delta=delta)
+        if not out:
+            return out
+        n = self.g * self.v
+        deltas = np.zeros((n,), np.int32)
+        mask = np.zeros((n,), bool)
+        for grp, d in out.items():
+            sl = slice(grp * self.v, (grp + 1) * self.v)
+            deltas[sl] = d
+            mask[sl] = True
+        self.sc.state = lg.rebase_indexes(
+            self.sc.state, jnp.asarray(mask), jnp.asarray(deltas)
+        )
+        p = self.sc._pending
+        live = p.type != int(MT.MSG_NONE)
+        d = deltas[:, None] * live  # delivery never crosses groups
+        p.index[:] = np.maximum(p.index - d, 0)
+        p.commit[:] = np.maximum(p.commit - d, 0)
+        p.reject_hint[:] = np.maximum(p.reject_hint - d, 0)
+        p.snap_index[:] = np.where(
+            live & (p.snap_index > 0), np.maximum(p.snap_index - d, 0), p.snap_index
+        )
+        # the drained-read books are host-side mirrors of the index space —
+        # the caller-owns-mirrors clause of ops/log.py rebase_indexes (a
+        # serial release in flight across the rebase would otherwise land
+        # in the new epoch while the fused ring drained in the old one)
+        for book in self.reads:
+            for lane, entries in book.items():
+                if mask[lane]:
+                    d = int(deltas[lane])
+                    book[lane] = [
+                        (c, max(i - d, 0)) for (c, i) in entries
+                    ]
+        return out
+
+    # -- comparison --------------------------------------------------------
+
+    def _col(self, c, name):
+        x = np.asarray(getattr(c.state, name))
+        if x.dtype == np.bool_:
+            return x
+        return x.astype(np.int64)
+
+    def assert_same(self, where=""):
+        sc, fc = self.sc, self.fc
+        for name in self.STRICT:
+            a, b = self._col(sc, name), self._col(fc, name)
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"{name} diverged @ {where} (serial vs fused)"
+            )
+        # window-masked log compare
+        w = self.shape.w
+        snap = self._col(sc, "snap_index")
+        last = self._col(sc, "last")
+        idx = np.arange(w)[None, :]
+        # slot s holds index i iff i & (w-1) == s for some snap < i <= last;
+        # reconstruct the valid mask per slot
+        base = (snap[:, None] + 1 + ((idx - (snap[:, None] + 1)) % w))
+        valid = base <= last[:, None]
+        slot = base % w
+        for name in self.LOG:
+            a, b = self._col(sc, name), self._col(fc, name)
+            av = np.where(valid, np.take_along_axis(a, slot, axis=1), 0)
+            bv = np.where(valid, np.take_along_axis(b, slot, axis=1), 0)
+            np.testing.assert_array_equal(
+                av, bv, err_msg=f"{name} (windowed) diverged @ {where}"
+            )
+        err = self._col(sc, "error_bits")
+        assert (err == 0).all(), f"error_bits set @ {where}"
+
+    def assert_reads(self, where=""):
+        """At a quiesce point (>=1 op-free round since the last read), the
+        cumulative released-read logs of both engines must agree."""
+        assert self.reads[0] == self.reads[1], (
+            f"released reads diverged @ {where}:\n"
+            f"fused : {self.reads[0]}\nserial: {self.reads[1]}"
+        )
+
+
+class ComposedDriver:
+    """Seeded random scheduler composing every feature over a LockstepPair:
+    elections, replication, beats, ReadIndex, transfers, partitions/heals
+    (with snapshot catch-up through the auto-compacted window), joint +
+    simple conf changes with auto- and manual leave, ForgetLeader, and live
+    index rebases — asserting serial == fused after every round.
+
+    Scheduling constraints (all are network-ordering freedoms the harness
+    must pin down, not protocol rules — see the module docstring):
+      - one candidacy per group at a time, and only while the group has no
+        unmuted leader: simultaneous candidacies make the outcome depend on
+        intra-round message order, where the engines legitimately differ;
+      - transfers only in groups with no MsgAppResp traffic in flight (no
+        proposal in the last 2 rounds), for the same reason;
+      - no mutes in a group with a leadership transfer pending: a censored
+        MsgTimeoutNow would leave lead_transferee latched forever in the
+        tickless drive (the reference clears it on election timeout,
+        raft.go:843-853, which ticks own);
+      - reads only at leaders already committed-in-term: the serial engine
+        implements the reference's pendingReadIndexMessages postpone
+        (raft.go:1313-1317), the fused host API drops-for-retry instead
+        (deliberate deviation, documented at ops/fused.py read block).
+    """
+
+    def __init__(
+        self,
+        pair: LockstepPair,
+        seed: int,
+        p_mute: float = 0.04,
+        p_prop: float = 0.5,
+        p_read: float = 0.2,
+        p_beat: float = 0.5,
+        p_transfer: float = 0.03,
+        p_cc: float = 0.05,
+        p_forget: float = 0.01,
+        p_hup: float = 0.6,
+        allow_leader_demote: bool = False,
+    ):
+        from raft_tpu import confchange as ccm
+
+        self.ccm = ccm
+        self.pair = pair
+        self.rng = np.random.default_rng(seed)
+        self.p = dict(
+            mute=p_mute, prop=p_prop, read=p_read, beat=p_beat,
+            transfer=p_transfer, cc=p_cc, forget=p_forget, hup=p_hup,
+        )
+        self.allow_leader_demote = allow_leader_demote
+        self.next_ctx = 1
+        self.round_no = 0
+        self.heal_at: dict[int, int] = {}  # lane -> round to unmute
+        g = pair.g
+        self.hup_cool = np.zeros((g,), np.int64)
+        self.last_prop = np.full((g,), -10, np.int64)
+        # last round ANY driver action (prop/cc/beat/read/transfer/hup/heal)
+        # touched the group — heartbeat-response generators (beat, read)
+        # keep a >=3-round distance from it so their responses never share
+        # a round with a commit-advancing ack wave (including the
+        # append-in-flight window the _ack_in_flight projection can't see)
+        self.last_action = np.full((g,), -10, np.int64)
+        # rounds a group's leader sat gate-closed with commits unmoved —
+        # breaks the rare stuck state (leader unaware a healed follower
+        # needs a probe) with one forced beat into a quiescent group
+        self.stuck = np.zeros((g,), np.int64)
+        self.last_com = np.zeros((g,), np.int64)
+        # rebase schedule: two fast-forwards + their later real rebases
+        self.rebase_plan: list[tuple[int, tuple, int | None]] = []
+        self.commits_start = int(
+            np.asarray(pair.fc.state.committed, dtype=np.int64).sum()
+        )
+
+    def plan_rebases(self, total_rounds: int):
+        w = self.pair.shape.w
+        if total_rounds < 120:
+            return
+        r1 = int(self.rng.integers(40, total_rounds // 2))
+        grps = tuple(
+            int(x)
+            for x in self.rng.choice(self.pair.g, size=2, replace=False)
+        )
+        self.rebase_plan = [
+            (r1, grps, -2 * w),
+            (min(r1 + 60, total_rounds - 20), grps, None),
+        ]
+
+    # -- host-side views ---------------------------------------------------
+
+    def _term_at_committed_ok(self, st):
+        """[N] bool: term(committed) == term, computed host-side (the
+        committed-in-term gate of raft.go:1313-1317)."""
+        w = self.pair.shape.w
+        lt = np.asarray(st.log_term, dtype=np.int64)
+        com = np.asarray(st.committed, dtype=np.int64)
+        snap = np.asarray(st.snap_index, dtype=np.int64)
+        snap_t = np.asarray(st.snap_term, dtype=np.int64)
+        term = np.asarray(st.term, dtype=np.int64)
+        lanes = np.arange(lt.shape[0])
+        in_win = com > snap
+        t_com = np.where(
+            in_win, lt[lanes, com & (w - 1)], np.where(com == snap, snap_t, 0)
+        )
+        return t_com == term
+
+    @staticmethod
+    def _quorum_median(vals, mask):
+        picked = sorted((int(vals[j]) for j in np.nonzero(mask)[0]), reverse=True)
+        if not picked:
+            return 1 << 60  # empty config commits anything (quorum/majority.go)
+        return picked[len(picked) // 2]
+
+    def _ack_in_flight(self, lane: int) -> bool:
+        """True if an ack that would ADVANCE this leader's commit index is
+        (or may be) in flight. Host-computable exactly because both ends
+        are visible: a same-term unmuted voter whose own `last` exceeds the
+        leader's match for it has an ack traveling; project every such ack
+        onto the match vector and ask whether the joint-quorum median moves
+        past committed. Used to schedule around the one observable
+        difference between the engines' send models: a serial send
+        triggered by an inbox slot processed BEFORE the commit-advancing
+        ack snapshots the pre-advance commit, while the fused coalesced
+        fan-out snapshots the post-advance one — both reference-conformant
+        message contents."""
+        pair = self.pair
+        st = pair.fc.state
+        v = pair.v
+        grp = lane // v
+        ids_arr = np.asarray(st.id)
+        terms = np.asarray(st.term, dtype=np.int64)
+        last_arr = np.asarray(st.last, dtype=np.int64)
+        mt = np.asarray(st.pr_match, dtype=np.int64)[lane].copy()
+        self_slot = int(ids_arr[lane]) - 1
+        for j in range(v):
+            peer = grp * v + j
+            if j == self_slot:
+                mt[j] = last_arr[lane]
+            elif not pair.mute[peer] and terms[peer] == terms[lane]:
+                mt[j] = max(mt[j], min(int(last_arr[peer]), int(last_arr[lane])))
+        vin = np.asarray(st.voters_in)[lane]
+        vout = np.asarray(st.voters_out)[lane]
+        med = self._quorum_median(mt, vin)
+        if vout.any():
+            med = min(med, self._quorum_median(mt, vout))
+        return med > int(np.asarray(st.committed, dtype=np.int64)[lane])
+
+    def step(self):
+        pair, rng, p = self.pair, self.rng, self.p
+        g, v = pair.g, pair.v
+        st = pair.fc.state
+        roles = np.asarray(st.state)
+        lead_tr = np.asarray(st.lead_transferee)
+        learner = np.asarray(st.is_learner)
+        mute = pair.mute
+        cit = self._term_at_committed_ok(st)
+        is_leader = roles == int(StateType.LEADER)
+        is_cand = (roles == int(StateType.CANDIDATE)) | (
+            roles == int(StateType.PRE_CANDIDATE)
+        )
+
+        # Heals due this round — deferred while an append broadcast from a
+        # recent proposal may still be in flight: the healed lane would
+        # receive it with a too-far prev, and its rejection-driven probe
+        # send would race the proposal's own commit-advancing acks (the
+        # serial/fused send-content freedom again). Overdue heals suppress
+        # new proposals in their group below, so the deferral is bounded.
+        due = [
+            l
+            for l, r in self.heal_at.items()
+            if r <= self.round_no
+            and self.round_no - self.last_prop[l // v] >= 2
+        ]
+        if due:
+            pair.set_mute(due, False)
+            for l in due:
+                del self.heal_at[l]
+                self.last_action[l // v] = self.round_no
+            mute = pair.mute
+        heal_overdue = {
+            l // v for l, r in self.heal_at.items() if r <= self.round_no
+        }
+
+        ops: dict = dict(
+            hup=[], beat=[], prop={}, transfer={}, read={}, forget=[]
+        )
+        cc = None
+        cc_groups = None
+
+        transfer_pending = {
+            grp
+            for grp in range(g)
+            if any(
+                lead_tr[l] != 0 and not mute[l]
+                for l in range(grp * v, (grp + 1) * v)
+            )
+        }
+
+        # new partition events
+        if rng.random() < p["mute"]:
+            lane = int(rng.integers(0, g * v))
+            grp = lane // v
+            if not mute[lane] and grp not in transfer_pending:
+                pair.set_mute([lane], True)
+                self.heal_at[lane] = self.round_no + int(rng.integers(6, 24))
+                mute = pair.mute
+
+        unmuted_leaders = [
+            int(l) for l in np.nonzero(is_leader & ~mute)[0]
+        ]
+        lead_of = {}
+        for lane in unmuted_leaders:
+            lead_of.setdefault(lane // v, lane)
+        # "fresh" leaders hold the max term of their group — a stale
+        # (deposed-but-unreached) leader must not anchor transfers or conf
+        # changes: its entries die on truncation, so the host-side books
+        # would wait on an index later satisfied by unrelated entries
+        terms = np.asarray(st.term, dtype=np.int64)
+        fresh = {
+            grp: lane
+            for grp, lane in lead_of.items()
+            if terms[lane] == terms[grp * v : (grp + 1) * v].max()
+        }
+
+        # elections: leaderless (from the unmuted side) groups re-campaign
+        for grp in range(g):
+            if grp in lead_of or self.hup_cool[grp] > self.round_no:
+                continue
+            lanes = np.arange(grp * v, (grp + 1) * v)
+            if (is_cand[lanes] & ~mute[lanes]).any():
+                continue  # one candidacy at a time
+            elig = [
+                int(l)
+                for l in lanes
+                if not mute[l] and not learner[l] and not is_leader[l]
+            ]
+            if elig and rng.random() < p["hup"]:
+                ops["hup"].append(int(rng.choice(elig)))
+                self.hup_cool[grp] = self.round_no + 5
+                self.last_action[grp] = self.round_no
+
+        # Scheduling around message-CONTENT freedom: the serial engine
+        # emits from mid-scan state (an append triggered by an early inbox
+        # slot predates the round's later proposal append or commit
+        # advance), the fused engine from end-of-round state (one coalesced
+        # fan-out). Both contents are reference-conformant, so the harness
+        # must not create rounds where the difference is observable:
+        #   - at most ONE client action per leader per round (a prop's acks
+        #     arriving next round must not meet a beat's heartbeat
+        #     responses, whose need_app send would snapshot a pre-advance
+        #     commit on the serial side);
+        #   - new entries (props, conf changes) and reads only at leaders
+        #     whose unmuted members are caught up in REPLICATE (a catch-up
+        #     append racing the proposal would carry fewer entries
+        #     serially) with committed == last (no commit advance can be
+        #     in flight);
+        #   - beats only at committed == last (straggler catch-up acks
+        #     never advance commit, so probing/unpausing beats stay safe).
+        pr_match = np.asarray(st.pr_match, dtype=np.int64)
+        pr_state_arr = np.asarray(st.pr_state)
+        last_arr = np.asarray(st.last, dtype=np.int64)
+        com_arr = np.asarray(st.committed, dtype=np.int64)
+        ids_arr = np.asarray(st.id)
+
+        def caught_up(lane):
+            grp = lane // v
+            self_slot = int(ids_arr[lane]) - 1
+            for j in range(v):
+                if j == self_slot or mute[grp * v + j]:
+                    continue
+                if pr_match[lane, j] < last_arr[lane]:
+                    return False
+                if pr_state_arr[lane, j] != int(ProgressState.REPLICATE):
+                    return False
+            return True
+
+        busy: set[int] = set()
+        # steady traffic at every unmuted leader (stale ones included —
+        # their appends die on the term ladder identically in both engines)
+        for lane in unmuted_leaders:
+            grp = lane // v
+            roll = rng.random()
+            safe = not self._ack_in_flight(lane)
+            spaced = self.round_no - self.last_action[grp] >= 3
+            # stuck-group bookkeeping + forced-beat fallback
+            if safe or com_arr[lane] != self.last_com[grp]:
+                self.stuck[grp] = 0
+            else:
+                self.stuck[grp] += 1
+            self.last_com[grp] = com_arr[lane]
+            if self.stuck[grp] >= 10 and spaced:
+                ops["beat"].append(lane)
+                busy.add(lane)
+                self.stuck[grp] = 0
+                self.last_action[grp] = self.round_no
+                continue
+            if roll < p["prop"]:
+                if safe and caught_up(lane) and grp not in heal_overdue:
+                    k = int(rng.integers(1, 3))
+                    nbytes = int(rng.choice([0, 8, 32]))
+                    ops["prop"][lane] = (k, nbytes)
+                    self.last_prop[grp] = self.round_no
+                    self.last_action[grp] = self.round_no
+                    busy.add(lane)
+            elif roll < p["prop"] + p["beat"] * (1 - p["prop"]):
+                if safe and spaced:
+                    ops["beat"].append(lane)
+                    self.last_action[grp] = self.round_no
+                    busy.add(lane)
+            elif roll < p["prop"] + (p["beat"] + p["read"]) * (1 - p["prop"]):
+                if safe and spaced and cit[lane] and caught_up(lane):
+                    ops["read"][lane] = self.next_ctx
+                    self.next_ctx += 1
+                    self.last_action[grp] = self.round_no
+                    busy.add(lane)
+
+        # leadership transfer, only in ack-quiet groups
+        for grp, lane in fresh.items():
+            if (
+                rng.random() < p["transfer"]
+                and lane not in busy
+                and grp not in transfer_pending
+                and self.round_no - self.last_prop[grp] > 2
+                and lead_tr[lane] == 0
+                and not self._ack_in_flight(lane)
+            ):
+                others = [
+                    j + 1
+                    for j in range(v)
+                    if j + 1 != int(np.asarray(st.id)[lane])
+                    and not mute[grp * v + j]
+                ]
+                if others:
+                    ops["transfer"][lane] = int(rng.choice(others))
+                    self.last_action[grp] = self.round_no
+
+        # conf changes: one pending change per group (the reference's own
+        # pendingConfIndex gate); drive auto-leaves every round
+        need_leave = pair.joint_groups_wanting_leave()
+        auto_leave_now = [
+            grp
+            for grp in need_leave
+            if grp in fresh
+            and fresh[grp] not in busy
+            and not self._ack_in_flight(fresh[grp])
+            and caught_up(fresh[grp])
+        ]
+        if auto_leave_now:
+            cc = self.ccm.ConfChangeV2()
+            cc_groups = auto_leave_now
+            for grp in auto_leave_now:
+                self.last_action[grp] = self.round_no
+                self.last_prop[grp] = self.round_no
+        elif rng.random() < p["cc"]:
+            cands = [
+                grp
+                for grp in fresh
+                if grp not in pair._fcc._pending
+                and grp not in transfer_pending
+                and fresh[grp] not in busy
+                and fresh[grp] not in ops["transfer"]
+                and not self._ack_in_flight(fresh[grp])
+                and caught_up(fresh[grp])
+            ]
+            if cands:
+                grp = int(rng.choice(cands))
+                lanes = np.arange(grp * v, (grp + 1) * v)
+                lrn_ids = [int(l % v) + 1 for l in lanes if learner[l]]
+                lead_id = int(np.asarray(st.id)[fresh[grp]])
+                joint = bool(np.asarray(st.voters_out)[lanes[0]].any())
+                if joint:
+                    # explicit joint left manually
+                    cc = self.ccm.ConfChangeV2()
+                elif lrn_ids:
+                    cc = self.ccm.ConfChangeV2(
+                        changes=(
+                            self.ccm.ConfChangeSingle(
+                                int(self.ccm.ConfChangeType.ADD_NODE),
+                                int(rng.choice(lrn_ids)),
+                            ),
+                        )
+                    )
+                else:
+                    demotable = [
+                        i + 1
+                        for i in range(v)
+                        if (i + 1 != lead_id or self.allow_leader_demote)
+                    ]
+                    if demotable:
+                        tr = int(
+                            rng.choice(
+                                [
+                                    int(self.ccm.ConfChangeTransition.JOINT_IMPLICIT),
+                                    int(self.ccm.ConfChangeTransition.JOINT_EXPLICIT),
+                                ]
+                            )
+                        )
+                        cc = self.ccm.ConfChangeV2(
+                            transition=tr,
+                            changes=(
+                                self.ccm.ConfChangeSingle(
+                                    int(self.ccm.ConfChangeType.ADD_LEARNER_NODE),
+                                    int(rng.choice(demotable)),
+                                ),
+                            ),
+                        )
+                if cc is not None:
+                    cc_groups = [grp]
+                    self.last_action[grp] = self.round_no
+                    self.last_prop[grp] = self.round_no
+
+        # occasional ForgetLeader at an unmuted follower
+        if rng.random() < p["forget"]:
+            fl = [
+                int(l)
+                for l in np.nonzero(
+                    (roles == int(StateType.FOLLOWER)) & ~mute
+                )[0]
+            ]
+            if fl:
+                ops["forget"].append(int(rng.choice(fl)))
+
+        pair.round(cc=cc, cc_groups=cc_groups, **ops)
+        self.round_no += 1
+
+        # scheduled rebases
+        for when, grps, delta in list(self.rebase_plan):
+            if when == self.round_no:
+                pair.rebase(list(grps), delta=delta)
+
+    def run(self, rounds: int, check_every: int = 1):
+        self.plan_rebases(rounds)
+        for r in range(rounds):
+            self.step()
+            if r % check_every == 0:
+                self.pair.assert_same(f"composed round {r}")
+        self.finish(rounds)
+
+    def finish(self, rounds: int):
+        """Heal everything, settle, and run the end-of-run verdicts."""
+        pair = self.pair
+        # drain in-flight append broadcasts before healing (the heal-vs-
+        # recent-proposal hazard, see the heal deferral in step())
+        for r in range(3):
+            pair.round()
+            self.round_no += 1
+            pair.assert_same(f"preheal {r}")
+        if self.heal_at:
+            pair.set_mute(list(self.heal_at), False)
+            self.heal_at.clear()
+        for r in range(30):
+            st = pair.fc.state
+            roles = np.asarray(st.state)
+            lanes = [
+                int(l)
+                for l in pair.leader_lanes()
+                if not pair.mute[l] and not self._ack_in_flight(int(l))
+            ]
+            hup = []
+            for grp in range(pair.g):
+                gl = np.arange(grp * pair.v, (grp + 1) * pair.v)
+                if not (roles[gl] == int(StateType.LEADER)).any() and not (
+                    (roles[gl] == int(StateType.CANDIDATE))
+                    | (roles[gl] == int(StateType.PRE_CANDIDATE))
+                ).any():
+                    elig = [
+                        int(l)
+                        for l in gl
+                        if not np.asarray(st.is_learner)[l]
+                    ]
+                    if elig:
+                        hup.append(elig[self.round_no % len(elig)])
+            pair.round(beat=lanes if r % 2 == 0 else (), hup=hup)
+            self.round_no += 1
+            pair.assert_same(f"settle {r}")
+        # quiesce: no ops at all until the serial network drains
+        for r in range(10):
+            pair.round()
+            pair.assert_same(f"quiesce {r}")
+            if not pair.sc.has_pending():
+                break
+        pair.assert_same("final")
+        pair.assert_reads("final")
+        pair.fc.check_no_errors()
+        pair.sc.check_no_errors(allow_drops=True)
+        commits = int(
+            np.asarray(pair.fc.state.committed, dtype=np.int64).sum()
+        )
+        assert commits > self.commits_start, "no progress over the whole run"

@@ -1,0 +1,161 @@
+"""Serial<->fused lockstep differential — scripted phases + composed seeds.
+
+The harness (raft_tpu/testing/lockstep.py) drives the serial conformance
+engine and the fused throughput engine through identical host-driven
+traffic and asserts the full observable state equal after EVERY round;
+tests/test_lockstep_more.py carries further seeds and config variants.
+This is the fused engine's golden-grade assurance (VERDICT r4 item 1): the
+oracle standard being matched is the reference's datadriven suite,
+/root/reference/interaction_test.go:26-38, which pins the serial engine;
+this differential extends that pinning to the fused kernel under composed
+feature traffic. Any failure reproduces from its seed.
+
+Divergences this differential caught while being built (all fixed):
+  - fused ReadIndex released slots individually instead of the whole FIFO
+    prefix (read_only.go:68-112), never maintained ro_seq, and could emit
+    ReadStates out of enqueue order once freed low slots were reused;
+  - fused tick-heartbeats carried no pending-read ctx
+    (lastPendingRequestCtx, raft.go:698-703);
+  - fused ForgetLeader ignored the lease-based-reads refusal
+    (raft.go:1700-1708);
+  - the serial engine routed a SELF-requested read release as a
+    MsgReadIndexResp to itself, so a term bump in the one-round delivery
+    window could eat a confirmed read — the reference appends the
+    ReadState directly (raft.go:2085-2091);
+  - the serial sync Cluster never cleared pending_snap_* (the async
+    model's storage ack collapsed to nothing instead of to the round
+    boundary), leaving restored followers permanently unpromotable;
+  - fused_confchange.install_config force-slimmed the serial engine's
+    carry dtypes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from raft_tpu import confchange as ccm
+from raft_tpu.testing.lockstep import ComposedDriver, LockstepPair
+
+
+def test_scripted_phases():
+    """Deterministic 7-phase composition: elections, replication+compaction,
+    reads, transfers, partition->snapshot catch-up, joint conf change round
+    trip, live two-way rebase."""
+    g, v = 4, 3
+    pair = LockstepPair(g, v, seed=3, compact_lag=8)
+
+    # elections
+    pair.round(hup=[grp * v for grp in range(g)])
+    for r in range(4):
+        pair.round()
+        pair.assert_same(f"election {r}")
+    assert len(pair.leader_lanes()) == g
+
+    # replication with payload bytes (auto-compaction runs every round)
+    for blk in range(10):
+        pair.round(prop={int(l): (2, 16) for l in pair.leader_lanes()})
+        pair.round()
+        pair.round()
+        pair.assert_same(f"repl {blk}")
+    assert (np.asarray(pair.fc.state.snap_index) > 0).all()
+
+    # reads under steady state
+    for blk in range(3):
+        pair.round(read={int(l): 100 + blk for l in pair.leader_lanes()})
+        for _ in range(4):
+            pair.round()
+        pair.assert_same(f"read {blk}")
+    pair.assert_reads("reads")
+
+    # transfer leadership in every group
+    tr = {}
+    for lane in pair.leader_lanes():
+        lid = int(np.asarray(pair.fc.state.id)[lane])
+        tr[int(lane)] = [i for i in range(1, v + 1) if i != lid][0]
+    pair.round(transfer=tr)
+    for r in range(6):
+        pair.round()
+        pair.assert_same(f"transfer {r}")
+    assert len(pair.leader_lanes()) == g
+
+    # partition one follower per group past the window -> snapshot catch-up
+    mutes = []
+    for grp in range(g):
+        lds = set(int(x) for x in pair.leader_lanes())
+        mutes.append(
+            [l for l in range(grp * v, (grp + 1) * v) if l not in lds][0]
+        )
+    pair.set_mute(mutes, True)
+    for blk in range(12):
+        pair.round(prop={int(l): (2, 8) for l in pair.leader_lanes()})
+        pair.round()
+        pair.assert_same(f"partitioned {blk}")
+    snap = np.asarray(pair.fc.state.snap_index)
+    com = np.asarray(pair.fc.state.committed)
+    assert all(snap[m] < com[int(pair.leader_lanes()[0])] for m in mutes)
+    pair.set_mute(mutes, False)
+    for r in range(14):
+        pair.round(
+            beat=[int(l) for l in pair.leader_lanes()] if r % 2 == 0 else ()
+        )
+        pair.assert_same(f"heal {r}")
+    com = np.asarray(pair.fc.state.committed)
+    lead_com = int(com[pair.leader_lanes()[0]])
+    assert all(com[m] == lead_com for m in mutes)
+
+    # joint conf change: demote member 3 (auto-leave), promote back
+    cc = ccm.ConfChangeV2(
+        transition=int(ccm.ConfChangeTransition.JOINT_IMPLICIT),
+        changes=(
+            ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_LEARNER_NODE), 3),
+        ),
+    )
+    pair.round(cc=cc)
+    for r in range(8):
+        need = pair.joint_groups_wanting_leave()
+        if need:
+            pair.round(cc=ccm.ConfChangeV2(), cc_groups=need)
+        else:
+            pair.round()
+        pair.assert_same(f"cc settle {r}")
+    lrn = np.asarray(pair.fc.state.learners)
+    assert all(lrn[grp * v, 2] for grp in range(g))
+    assert not np.asarray(pair.fc.state.voters_out).any()
+    pair.round(
+        cc=ccm.ConfChangeV2(
+            changes=(
+                ccm.ConfChangeSingle(int(ccm.ConfChangeType.ADD_NODE), 3),
+            ),
+        )
+    )
+    for r in range(8):
+        pair.round()
+        pair.assert_same(f"cc promote {r}")
+    assert not np.asarray(pair.fc.state.learners).any()
+
+    # live rebase: fast-forward two groups by 2 windows, then rebase back
+    pair.round(prop={int(l): (2, 8) for l in pair.leader_lanes()})
+    assert pair.rebase([0, 1], delta=-128) == {0: -128, 1: -128}
+    for r in range(6):
+        pair.round(prop={int(l): (1, 4) for l in pair.leader_lanes()})
+        pair.assert_same(f"ffwd {r}")
+    assert pair.rebase([0, 1], delta=None) == {0: 128, 1: 128}
+    for r in range(6):
+        pair.round(prop={int(l): (1, 4) for l in pair.leader_lanes()})
+        pair.assert_same(f"rebase {r}")
+    pair.round()
+    pair.round()
+    pair.assert_same("final")
+    pair.assert_reads("final")
+    pair.fc.check_no_errors()
+    pair.sc.check_no_errors()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_composed(seed):
+    """Randomized composed traffic, 500 rounds + settle, state compared
+    after every round (more seeds in test_lockstep_more.py)."""
+    pair = LockstepPair(4, 3, seed=seed, compact_lag=8)
+    drv = ComposedDriver(pair, seed=seed)
+    drv.run(500)

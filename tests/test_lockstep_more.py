@@ -1,0 +1,45 @@
+"""Serial<->fused lockstep differential — further composed seeds and
+config variants (see tests/test_lockstep.py for the harness contract)."""
+
+from __future__ import annotations
+
+import pytest
+
+from raft_tpu.testing.lockstep import ComposedDriver, LockstepPair
+
+
+@pytest.mark.parametrize("seed", [4, 5, 6, 7, 8, 9])
+def test_composed(seed):
+    pair = LockstepPair(4, 3, seed=seed, compact_lag=8)
+    drv = ComposedDriver(pair, seed=seed)
+    drv.run(500)
+
+
+@pytest.mark.parametrize("seed", [10, 11])
+def test_composed_five_voters(seed):
+    """Wider quorums: 5-voter groups exercise the joint-quorum math and the
+    V=5 routing paths under the same composed traffic."""
+    pair = LockstepPair(3, 5, seed=seed, compact_lag=8)
+    drv = ComposedDriver(pair, seed=seed)
+    drv.run(300)
+
+
+@pytest.mark.parametrize("seed", [20, 21])
+def test_composed_prevote(seed):
+    """PreVote elections: driven hups go through the PRE_CANDIDATE round
+    trip on both engines."""
+    pair = LockstepPair(4, 3, seed=seed, compact_lag=8, pre_vote=True)
+    drv = ComposedDriver(pair, seed=seed)
+    drv.run(300)
+
+
+@pytest.mark.parametrize("seed", [30])
+def test_composed_step_down_on_removal(seed):
+    """StepDownOnRemoval + leader demotes allowed: conf changes can demote
+    the leader itself, which must step down via the installed config
+    (raft.go:1930-1936) identically on both engines."""
+    pair = LockstepPair(
+        4, 3, seed=seed, compact_lag=8, step_down_on_removal=True
+    )
+    drv = ComposedDriver(pair, seed=seed, allow_leader_demote=True)
+    drv.run(300)
