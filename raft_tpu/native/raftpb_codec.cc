@@ -440,4 +440,154 @@ int64_t msg_unmarshal(const uint8_t* in, int64_t len, uint64_t* scalars,
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// Columnar frame codec — the fused cross-host bridge's fast path
+// (runtime/bridge.py FusedBridgeEndpoint): a whole frame of messages is
+// marshaled/unmarshaled in ONE native call from SoA columns, so per-message
+// throughput is not bound by Python object + ctypes overhead. The wire
+// layout is exactly pack_frame's (u32le count, then per message u32le
+// length + byte-exact gogoproto), so frames interoperate with the
+// per-message path and with Go peers.
+//
+// Schema restrictions (fabric-sourced traffic): context is an int ticket
+// (0 = absent, 8-byte big-endian on the wire), snapshots are metadata +
+// ConfState only (no data bytes), and there are no nested responses.
+
+// scalars: count*kNumScalars; ctx: count; n_ents: count (entry columns
+// consumed in order, 3 scalars + one len each, payloads concatenated in
+// ent_data); snap_meta: count*3 (read when scalars[kHasSnap]); snap_counts:
+// count*4; snap_ids consumed in order. Returns bytes written or -needed.
+int64_t frame_marshal(int32_t count, const uint64_t* scalars,
+                      const int64_t* ctx, const int32_t* n_ents,
+                      const uint64_t* ent_scalars, const int64_t* ent_lens,
+                      const uint8_t* ent_data, const uint64_t* snap_meta,
+                      const int32_t* snap_counts, const uint64_t* snap_ids,
+                      uint8_t* out, int64_t out_cap) {
+  std::vector<uint8_t> frame;
+  frame.reserve(64 * static_cast<size_t>(count) + 8);
+  auto put_u32le = [&frame](uint32_t v) {
+    frame.push_back(v & 0xff);
+    frame.push_back((v >> 8) & 0xff);
+    frame.push_back((v >> 16) & 0xff);
+    frame.push_back((v >> 24) & 0xff);
+  };
+  put_u32le(static_cast<uint32_t>(count));
+  std::vector<uint8_t> one(512);
+  const uint64_t* es = ent_scalars;
+  const int64_t* el = ent_lens;
+  const uint8_t* ed = ent_data;
+  const uint64_t* sids = snap_ids;
+  for (int32_t i = 0; i < count; i++) {
+    uint8_t ctx_b[8];
+    const uint8_t* ctx_p = nullptr;
+    int64_t ctx_len = -1;
+    if (ctx[i] != 0) {
+      uint64_t c = static_cast<uint64_t>(ctx[i]);
+      for (int b = 0; b < 8; b++) ctx_b[b] = (c >> (8 * (7 - b))) & 0xff;
+      ctx_p = ctx_b;
+      ctx_len = 8;
+    }
+    int64_t ent_bytes = 0;
+    for (int32_t k = 0; k < n_ents[i]; k++)
+      if (el[k] > 0) ent_bytes += el[k];
+    const int32_t* sc = snap_counts + i * 4;
+    int64_t n;
+    for (;;) {
+      n = msg_marshal(scalars + i * kNumScalars, ctx_p, ctx_len, n_ents[i],
+                      es, el, ed, snap_meta + i * 3, nullptr, -1, sc, sids,
+                      0, nullptr, one.data(),
+                      static_cast<int64_t>(one.size()));
+      if (n >= 0) break;
+      one.resize(static_cast<size_t>(-n));
+    }
+    put_u32le(static_cast<uint32_t>(n));
+    frame.insert(frame.end(), one.data(), one.data() + n);
+    es += 3 * n_ents[i];
+    el += n_ents[i];
+    ed += ent_bytes;
+    if (scalars[i * kNumScalars + kHasSnap])
+      sids += sc[0] + sc[1] + sc[2] + sc[3];
+  }
+  int64_t total = static_cast<int64_t>(frame.size());
+  if (total > out_cap) return -total;
+  std::memcpy(out, frame.data(), frame.size());
+  return total;
+}
+
+// Columnar unmarshal of a pack_frame frame. Outputs mirror frame_marshal's
+// inputs; snapshot ConfState ids are parsed but not returned (the fabric
+// cell holds index/term only — scratch sized by the caller via
+// max_snap_ids). A context that is not an 8-byte engine ticket surfaces as
+// ctx = -1; the per-message path (msg_unmarshal -> Python) preserves such
+// foreign byte contexts verbatim for callers that need them (the serial
+// bridge / RawNode interning boundary) — the columnar fast path carries
+// int tickets only. Returns the message count, or a negative error code.
+int64_t frame_unmarshal(const uint8_t* in, int64_t len, int32_t max_msgs,
+                        int32_t max_total_ents, int64_t ent_data_cap,
+                        int32_t max_snap_ids, uint64_t* scalars, int64_t* ctx,
+                        int32_t* n_ents, uint64_t* ent_scalars,
+                        int64_t* ent_lens, uint8_t* ent_data,
+                        uint64_t* snap_meta, int32_t* snap_counts) {
+  if (len < 4) return -20;
+  uint32_t count = static_cast<uint32_t>(in[0]) |
+                   static_cast<uint32_t>(in[1]) << 8 |
+                   static_cast<uint32_t>(in[2]) << 16 |
+                   static_cast<uint32_t>(in[3]) << 24;
+  // unsigned compare: a u32 count >= 2^31 must not wrap negative and slip
+  // past the buffer bound (network-facing decode path)
+  if (max_msgs < 0 || count > static_cast<uint32_t>(max_msgs)) return -21;
+  int64_t off = 4;
+  int32_t ents_used = 0;
+  int64_t ent_data_off = 0;
+  std::vector<uint8_t> ctx_buf(64);
+  std::vector<uint8_t> snap_data_buf(16);
+  std::vector<uint64_t> snap_id_buf(max_snap_ids > 0 ? max_snap_ids : 1);
+  std::vector<uint64_t> resp_buf(kNumScalars);
+  for (uint32_t i = 0; i < count; i++) {
+    if (off + 4 > len) return -22;
+    uint32_t ln = static_cast<uint32_t>(in[off]) |
+                  static_cast<uint32_t>(in[off + 1]) << 8 |
+                  static_cast<uint32_t>(in[off + 2]) << 16 |
+                  static_cast<uint32_t>(in[off + 3]) << 24;
+    off += 4;
+    if (off + ln > len) return -23;
+    int64_t ctx_len = -1;
+    int32_t ne = 0, nresp = 0;
+    int64_t snap_dl = -1;
+    uint64_t sm[3] = {0, 0, 0};
+    int64_t rc = msg_unmarshal(
+        in + off, ln, scalars + i * kNumScalars, ctx_buf.data(),
+        static_cast<int64_t>(ctx_buf.size()), &ctx_len, &ne,
+        max_total_ents - ents_used, ent_scalars + 3 * ents_used,
+        ent_lens + ents_used, ent_data + ent_data_off,
+        ent_data_cap - ent_data_off, sm, snap_data_buf.data(),
+        static_cast<int64_t>(snap_data_buf.size()), &snap_dl,
+        snap_counts + i * 4, snap_id_buf.data(),
+        static_cast<int32_t>(snap_id_buf.size()), &nresp, 0,
+        resp_buf.data());
+    if (rc != 0) return rc;
+    if (nresp != 0) return -24;
+    n_ents[i] = ne;
+    for (int32_t k = 0; k < ne; k++) {
+      int64_t dl = ent_lens[ents_used + k];
+      if (dl > 0) ent_data_off += dl;
+    }
+    ents_used += ne;
+    snap_meta[i * 3] = sm[0];
+    snap_meta[i * 3 + 1] = sm[1];
+    snap_meta[i * 3 + 2] = sm[2];
+    if (ctx_len < 0)
+      ctx[i] = 0;
+    else if (ctx_len == 8) {
+      uint64_t c = 0;
+      for (int b = 0; b < 8; b++) c = c << 8 | ctx_buf[b];
+      ctx[i] = static_cast<int64_t>(c);
+    } else
+      ctx[i] = -1;
+    off += ln;
+  }
+  if (off != len) return -25;
+  return static_cast<int64_t>(count);
+}
+
 }  // extern "C"

@@ -1460,6 +1460,53 @@ class FusedCluster:
         self.fab = slim_fabric(rebase_fabric(fat_fabric(self.fab), dj))
         return out
 
+    @classmethod
+    def restore_from_wal(
+        cls,
+        n_groups: int,
+        n_voters: int,
+        delta: dict,
+        seed: int = 1,
+        shape=None,
+        log_bytes=None,
+        **cfg,
+    ) -> "FusedCluster":
+        """Rebuild a running block from one WAL delta (runtime/wal.py
+        WalStream.FIELDS) — the crash-restart path of the fused engine.
+
+        The reference restart contract (doc.go:46-67, raft.go:432-477):
+        come back with the persisted HardState + log + snapshot origin +
+        applied ConfState; everything volatile (role, lead, votes,
+        progress, read queues, the in-flight fabric) resets to follower
+        defaults, which a fresh init already is. Entry payload SIZES are
+        not streamed (the payload store owns bytes — WalStream.FIELDS
+        note); pass `log_bytes` ([N, W] array) to restore them, else the
+        size column restores as zeros and byte-based limits restart from a
+        clean slate.
+        """
+        import dataclasses as dc
+
+        import numpy as np
+
+        from raft_tpu.runtime.wal import WalStream
+        from raft_tpu.state import slim_state
+
+        c = cls(n_groups, n_voters, seed=seed, shape=shape, **cfg)
+        st = c.state
+        upd = {}
+        for f in WalStream.FIELDS:  # the stream schema IS the restore set
+            cur = getattr(st, f)
+            upd[f] = jnp.asarray(np.asarray(delta[f]), dtype=cur.dtype)
+        # durability covered everything streamed; applying rejoins applied
+        upd["stabled"] = upd["last"]
+        upd["applying"] = upd["applied"]
+        if log_bytes is not None:
+            upd["log_bytes"] = jnp.asarray(
+                np.asarray(log_bytes), dtype=st.log_bytes.dtype
+            )
+        c.state = slim_state(dc.replace(st, **upd))
+        return c
+
     # -- inspection -------------------------------------------------------
 
     def leader_lanes(self):

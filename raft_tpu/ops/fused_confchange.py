@@ -132,10 +132,12 @@ def install_config(
     # keep the carry diet invariant: a state installed mid-run must present
     # the same dtypes the caller's engine carries — the fused scan's slim
     # STATE_SLIM dtypes, or plain i32 when installing into the serial
-    # conformance engine (testing/lockstep.py drives both through here)
-    from raft_tpu.state import slim_state
+    # conformance engine (testing/lockstep.py drives both through here).
+    # The convention is detected from the input against the authoritative
+    # slim table, not a hardcoded dtype.
+    from raft_tpu.state import STATE_SLIM, slim_state
 
-    if state.log_type.dtype == jnp.int8:
+    if state.log_type.dtype == STATE_SLIM["log_type"]:
         return slim_state(state)
     return state
 

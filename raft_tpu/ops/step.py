@@ -959,7 +959,10 @@ def _step_leader(state: RaftState, mask, msg: MsgBatch, out: Outbox) -> RaftStat
     immediate = serve & (single | state.cfg.read_only_lease_based)
     # a locally-requested immediate read appends its ReadState directly
     # (raft.go:1305-1310 + responseToReadIndexReq local branch,
-    # raft.go:2085-2091); only remote requesters get a MsgReadIndexResp
+    # raft.go:2085-2091); only remote requesters get a MsgReadIndexResp.
+    # With the rs ring full the request itself is dropped — the static-
+    # bound analog of the full-table rule above (clients retry); unlike
+    # the quorum path there is no ro slot to keep it pending in.
     imm_self = immediate & (msg.frm == state.id)
     rs_ax = state.rs_ctx.shape[1]
     imm_put = (

@@ -153,6 +153,115 @@ def unpack_frame(data: bytes) -> list[Message]:
     return out
 
 
+# --------------------------------------------------------------------------
+# columnar frame codec — one native call per frame (the fused bridge's fast
+# path; see native/raftpb_codec.cc frame_marshal/frame_unmarshal). `cols` is
+# a dict of numpy arrays:
+#   scalars  [K, 11] u64   (msg_marshal slot order; [10] = has_snapshot)
+#   ctx      [K]     i64   int ticket, 0 = absent (-1 on unpack = foreign)
+#   n_ents   [K]     i32
+#   ent_scalars [sum, 3] u64  (type, term, index)
+#   ent_lens [sum]   i64   (-1 = nil data)
+#   ent_data bytes blob (concatenated payloads)
+#   snap_meta [K, 3] u64   (index, term, auto_leave; read when has_snapshot)
+#   snap_counts [K, 4] i32
+#   snap_ids [sum]  u64
+
+
+def _frame_lib():
+    lib = _lib()
+    if not getattr(lib, "_frame_bound", False):
+        lib.frame_marshal.restype = ctypes.c_int64
+        lib.frame_unmarshal.restype = ctypes.c_int64
+        lib._frame_bound = True
+    return lib
+
+
+def pack_frame_cols(cols) -> bytes:
+    lib = _frame_lib()
+    k = int(cols["scalars"].shape[0])
+    scalars = _u64(cols["scalars"]).reshape(-1)
+    ctx = np.ascontiguousarray(cols["ctx"], dtype=np.int64)
+    n_ents = np.ascontiguousarray(cols["n_ents"], dtype=np.int32)
+    ent_scalars = _u64(cols.get("ent_scalars", np.zeros((0, 3)))).reshape(-1)
+    ent_lens = np.ascontiguousarray(
+        cols.get("ent_lens", np.zeros(0)), dtype=np.int64
+    )
+    ent_data = bytes(cols.get("ent_data", b""))
+    snap_meta = _u64(cols.get("snap_meta", np.zeros((k, 3)))).reshape(-1)
+    snap_counts = np.ascontiguousarray(
+        cols.get("snap_counts", np.zeros((k, 4))), dtype=np.int32
+    ).reshape(-1)
+    snap_ids = _u64(cols.get("snap_ids", np.zeros(1)))
+    if snap_ids.size == 0:
+        snap_ids = _u64([0])
+    if ent_scalars.size == 0:
+        ent_scalars = _u64([0])
+    if ent_lens.size == 0:
+        ent_lens = np.zeros(1, np.int64)
+    cap = 4 + k * 300 + 2 * len(ent_data) + 64
+    while True:
+        out = np.zeros(cap, np.uint8)
+        n = lib.frame_marshal(
+            ctypes.c_int32(k),
+            scalars.ctypes.data_as(ctypes.c_void_p),
+            ctx.ctypes.data_as(ctypes.c_void_p),
+            n_ents.ctypes.data_as(ctypes.c_void_p),
+            ent_scalars.ctypes.data_as(ctypes.c_void_p),
+            ent_lens.ctypes.data_as(ctypes.c_void_p),
+            ent_data,
+            snap_meta.ctypes.data_as(ctypes.c_void_p),
+            snap_counts.ctypes.data_as(ctypes.c_void_p),
+            snap_ids.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(cap),
+        )
+        if n >= 0:
+            return out[:n].tobytes()
+        cap = int(-n)
+
+
+def unpack_frame_cols(data: bytes) -> dict:
+    lib = _frame_lib()
+    max_msgs = len(data) // 6 + 8
+    max_ents = len(data) // 2 + 8
+    scalars = np.zeros((max_msgs, _N_SCALARS), np.uint64)
+    ctx = np.zeros(max_msgs, np.int64)
+    n_ents = np.zeros(max_msgs, np.int32)
+    ent_scalars = np.zeros((max_ents, 3), np.uint64)
+    ent_lens = np.zeros(max_ents, np.int64)
+    ent_data = np.zeros(max(1, len(data)), np.uint8)
+    snap_meta = np.zeros((max_msgs, 3), np.uint64)
+    snap_counts = np.zeros((max_msgs, 4), np.int32)
+    rc = lib.frame_unmarshal(
+        data, ctypes.c_int64(len(data)),
+        ctypes.c_int32(max_msgs), ctypes.c_int32(max_ents),
+        ctypes.c_int64(ent_data.size), ctypes.c_int32(len(data) // 2 + 16),
+        scalars.ctypes.data_as(ctypes.c_void_p),
+        ctx.ctypes.data_as(ctypes.c_void_p),
+        n_ents.ctypes.data_as(ctypes.c_void_p),
+        ent_scalars.ctypes.data_as(ctypes.c_void_p),
+        ent_lens.ctypes.data_as(ctypes.c_void_p),
+        ent_data.ctypes.data_as(ctypes.c_void_p),
+        snap_meta.ctypes.data_as(ctypes.c_void_p),
+        snap_counts.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc < 0:
+        raise ValueError(f"frame_unmarshal failed: {rc}")
+    k = int(rc)
+    tot = int(n_ents[:k].sum())
+    return dict(
+        scalars=scalars[:k],
+        ctx=ctx[:k],
+        n_ents=n_ents[:k],
+        ent_scalars=ent_scalars[:tot],
+        ent_lens=ent_lens[:tot],
+        ent_data=ent_data,
+        snap_meta=snap_meta[:k],
+        snap_counts=snap_counts[:k],
+    )
+
+
 def unmarshal_message(data: bytes, max_entries: int | None = None,
                       max_responses: int | None = None) -> Message:
     lib = _lib()

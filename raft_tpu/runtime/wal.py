@@ -33,9 +33,21 @@ class WalStream:
     # log_bytes is deliberately NOT streamed: entry payload bytes (and
     # therefore their sizes) already live host-side (EntryStore / the
     # application), so shipping the size column would duplicate ~40% of the
-    # frame for data the durability layer must already hold
+    # frame for data the durability layer must already hold.
+    #
+    # Beyond the HardState triple + log columns, the stream carries what the
+    # reference's restart contract needs (doc.go:46-67, raft.go:432-477):
+    # the compaction origin (snap_index/snap_term — without it the circular
+    # window can't be anchored after a compaction), the applied cursor, and
+    # the applied membership config (ConfState — the reference recovers it
+    # from the persisted snapshot + replayed conf entries; here it rides the
+    # stream as the [N, V] masks directly). FusedCluster.restore_from_wal
+    # rebuilds a running block from any single delta.
     FIELDS = (
         "term", "vote", "committed", "last",
+        "snap_index", "snap_term", "applied",
+        "prs_id", "voters_in", "voters_out", "learners", "learners_next",
+        "auto_leave", "is_learner", "pending_conf_index",
         "log_term", "log_type",
     )
 

@@ -1,0 +1,114 @@
+"""Crash-restart of a fused block FROM THE WAL STREAM — closing the loop
+runtime/wal.py opens (VERDICT r4 item 5; reference restart contract:
+doc.go:46-67, raft.go:432-477).
+
+A FusedCluster streams per-block deltas (HardState + cursors + snapshot
+origin + ConfState masks + log columns); the block is killed mid-run and
+`FusedCluster.restore_from_wal` rebuilds it from a single delta. The
+restored block must (a) present exactly the streamed persistent state with
+volatile state reset to followers, (b) re-elect and keep committing, and
+(c) never contradict the pre-crash committed prefix (log matching across
+the crash, checked against the uninterrupted twin).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from raft_tpu.ops.fused import FusedCluster
+from raft_tpu.runtime.wal import WalStream
+from raft_tpu.types import StateType
+
+G, V = 8, 3
+N = G * V
+
+
+def _run_with_wal(blocks=6, rounds=8, seed=5):
+    sink: dict[int, dict] = {}
+    wal = WalStream(sink=lambda bid, delta: sink.__setitem__(bid, delta))
+    c = FusedCluster(G, V, seed=seed)
+    for _ in range(blocks):
+        c.run(rounds, auto_propose=True, auto_compact_lag=8, wal=wal)
+    return c, wal, sink
+
+
+def test_restore_presents_streamed_state():
+    c, wal, sink = _run_with_wal()
+    wal.flush()
+    assert len(sink) == 6
+    last = sink[max(sink)]
+    # the flushed tail delta is the live state's persistent image
+    for f in WalStream.FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(c.state, f)), last[f], err_msg=f
+        )
+
+    b = FusedCluster.restore_from_wal(G, V, last, seed=99)
+    for f in WalStream.FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(b.state, f)), last[f], err_msg=f
+        )
+    # volatile state reset: everyone restarts a follower with no leader,
+    # stabled rejoins last, applying rejoins applied
+    assert (np.asarray(b.state.state) == int(StateType.FOLLOWER)).all()
+    assert (np.asarray(b.state.lead) == 0).all()
+    np.testing.assert_array_equal(
+        np.asarray(b.state.stabled), np.asarray(b.state.last)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(b.state.applying), np.asarray(b.state.applied)
+    )
+    b.check_no_errors()
+
+
+def test_restored_block_rejoins_and_commits():
+    """Kill mid-run WITHOUT flushing: the in-flight tail block is lost (the
+    one-block WAL lag is the deal AsyncStorageWrites makes), restore from
+    the last RESOLVED delta, and the block must re-elect and commit past
+    the restore point with invariants intact."""
+    c, wal, sink = _run_with_wal()
+    # no flush: the pending tail delta is lost with the "crash"
+    assert len(sink) == 5
+    last = sink[max(sink)]
+    twin_final_com = np.asarray(c.state.committed, dtype=np.int64)
+
+    b = FusedCluster.restore_from_wal(G, V, last, seed=99)
+    com0 = np.asarray(b.state.committed, dtype=np.int64)
+    # the restored commit point trails the twin by at most the lost tail
+    assert (com0 <= twin_final_com).all()
+
+    b.run(160, auto_propose=True, auto_compact_lag=8)
+    assert len(b.leader_lanes()) == G, "restored groups failed to re-elect"
+    com1 = np.asarray(b.state.committed, dtype=np.int64)
+    assert (com1 > com0).all(), "restored groups stopped committing"
+    b.check_no_errors()
+
+    # log matching across the crash: every index committed at the restore
+    # point still carries the delta's term in the restored run's window
+    w = b.shape.w
+    lt = np.asarray(b.state.log_term, dtype=np.int64)
+    snap = np.asarray(b.state.snap_index, dtype=np.int64)
+    old_lt = np.asarray(last["log_term"], dtype=np.int64)
+    old_snap = last["snap_index"].astype(np.int64)
+    for lane in range(N):
+        lo = int(max(snap[lane], old_snap[lane])) + 1
+        hi = int(com0[lane])
+        for idx in range(lo, hi + 1):
+            assert lt[lane, idx & (w - 1)] == old_lt[lane, idx & (w - 1)], (
+                f"lane {lane} idx {idx} rewrote a committed entry"
+            )
+
+
+def test_restore_with_payload_sizes():
+    """The log_bytes hook restores the size column from the payload store's
+    knowledge (sizes are deliberately not streamed)."""
+    c, wal, sink = _run_with_wal(blocks=3)
+    wal.flush()
+    last = sink[max(sink)]
+    sizes = np.asarray(c.state.log_bytes)
+    b = FusedCluster.restore_from_wal(G, V, last, seed=7, log_bytes=sizes)
+    np.testing.assert_array_equal(np.asarray(b.state.log_bytes), sizes)
+    b.run(40, auto_propose=True)
+    assert len(b.leader_lanes()) == G
+    b.check_no_errors()
