@@ -252,6 +252,107 @@ def _route_shift_field(x, v):
     return jnp.stack(cols, axis=1)
 
 
+class StraddleSpec(NamedTuple):
+    """Static description of a group-straddling shard layout (all fields
+    hashable so the spec can ride jit static args). A group's v contiguous
+    global lanes may cross ONE shard boundary (lanes_per_shard >= v), so a
+    halo of v-1 neighbor lanes in each direction covers every cross-shard
+    read."""
+
+    axis_name: str
+    lanes_per_shard: int
+    n_shards: int
+
+
+def _straddle_res(spec: StraddleSpec, v: int):
+    """[L] receiver member index (global lane % v) for this shard. Depends
+    on the shard offset because lanes_per_shard need not align to v."""
+    offset = jax.lax.axis_index(spec.axis_name) * spec.lanes_per_shard
+    return (offset + jnp.arange(spec.lanes_per_shard, dtype=I32)) % v
+
+
+def _route_straddle_field(x, v, spec: StraddleSpec, res):
+    """Cross-shard analog of _route_shift_field, run INSIDE shard_map over
+    spec.axis_name: delivery is still inbox[l, i] = outbox[l + i - r, r]
+    (r = global lane % v), but the read may land on a neighbor shard. Since
+    |i - r| < v <= lanes_per_shard, one halo exchange — each shard fetches
+    its neighbors' v-1 boundary lanes via two `lax.ppermute`s (a
+    nearest-neighbor hop, the cheapest ICI pattern on a torus) — makes
+    every shifted read a STATIC slice of the extended [L + 2(v-1)] array.
+    No retile, no all_to_all, no per-message compute (SURVEY §5.8).
+
+    Wrap garbage at the global ends is unreachable: lane l only selects the
+    residue case r = l % v, whose read l + i - r stays inside l's own
+    v-aligned global group, never below lane 0 or above lane N-1."""
+    L = x.shape[0]
+    h = v - 1
+    if h == 0:
+        return x  # single-voter groups: the only column is the self column
+    fwd = [(i, (i + 1) % spec.n_shards) for i in range(spec.n_shards)]
+    bwd = [(i, (i - 1) % spec.n_shards) for i in range(spec.n_shards)]
+    prev_tail = jax.lax.ppermute(x[L - h :], spec.axis_name, fwd)
+    next_head = jax.lax.ppermute(x[:h], spec.axis_name, bwd)
+    xe = jnp.concatenate([prev_tail, x, next_head], axis=0)  # [L + 2h, V, ...]
+    cols = []
+    for i in range(v):
+        acc = None
+        for r in range(v):
+            src = jax.lax.slice_in_dim(xe, h + i - r, h + i - r + L, axis=0)
+            src = src[:, r]
+            if acc is None:
+                acc = src
+            else:
+                m = res == r
+                m = m.reshape(m.shape + (1,) * (src.ndim - 1))
+                acc = jnp.where(m, src, acc)
+        cols.append(acc)
+    return jnp.stack(cols, axis=1)
+
+
+def straddle_peer_mute(mute, v: int, spec: StraddleSpec):
+    """[L, V] peer-mute matrix for a straddling shard: cell [l, i] is the
+    mute bit of global lane group(l)*v + i (the aligned-case
+    mute.reshape(g, 1, v) broadcast, computed through the halo router)."""
+    res = _straddle_res(spec, v)
+    return _route_straddle_field(
+        jnp.broadcast_to(mute[:, None], (mute.shape[0], v)), v, spec, res
+    )
+
+
+def route_fabric_straddle(
+    out: Fabric, v: int, mute, spec: StraddleSpec, peer_mute=None
+) -> Fabric:
+    """route_fabric for group-straddling shard layouts (inside shard_map):
+    identical delivery contract — inbox[l, i] = outbox[sender lane, r],
+    self slot passes through — with cross-boundary reads riding the halo
+    exchange. peer_mute: optional precomputed straddle_peer_mute (it is
+    loop-invariant across a scan of rounds)."""
+    res = _straddle_res(spec, v)
+
+    def t(x):
+        return _route_straddle_field(x, v, spec, res)
+
+    if mute is not None and peer_mute is None:
+        peer_mute = straddle_peer_mute(mute, v, spec)
+
+    def deliver(chan):
+        chan = jax.tree.map(t, chan)
+        if mute is None:
+            return chan
+        cut = peer_mute | mute[:, None]
+        return dataclasses.replace(
+            chan, kind=jnp.where(cut, jnp.int32(MT.MSG_NONE), chan.kind)
+        )
+
+    return Fabric(
+        rep=deliver(out.rep),
+        hb=deliver(out.hb),
+        vote=deliver(out.vote),
+        vresp=deliver(out.vresp),
+        self_=out.self_,
+    )
+
+
 # route implementation switch: "auto" (default) picks "shift" (retile-free
 # masked rolls — 7-9x faster at scale, where the transpose's [G,V,V]
 # retiles dominate) for batches of >=256 lanes and "transpose" (the
@@ -499,12 +600,17 @@ def fused_round(
     ops: LocalOps,
     mute=None,
     *,
+    peer_mute=None,
     do_tick: bool = True,
     auto_propose: bool = False,
     auto_compact_lag: int | None = None,
 ) -> tuple[RaftState, Fabric]:
     """One complete synchronous round for every lane. Returns the next state
-    and the outbox fabric (route with route_fabric before the next round)."""
+    and the outbox fabric (route with route_fabric before the next round).
+
+    peer_mute: optional [N, V] mute bits of each lane's group members;
+    defaults to the aligned reshape of `mute` — REQUIRED on straddling
+    shards (straddle_peer_mute), where lanes are not group-aligned."""
     n, v = state.prs_id.shape
     e = inb.rep.ent_term.shape[-1]
     out = ChannelOutbox(state, e)
@@ -766,8 +872,11 @@ def fused_round(
     # success (keep it: BecomeProbe resumes at pending+1). Both: probe+pause.
     in_snap = is_leader[:, None] & (state.pr_state == ProgressState.SNAPSHOT)
     if mute is not None:
-        g = n // v
-        peer_mute = jnp.broadcast_to(mute.reshape(g, 1, v), (g, v, v)).reshape(n, v)
+        if peer_mute is None:
+            g = n // v
+            peer_mute = jnp.broadcast_to(
+                mute.reshape(g, 1, v), (g, v, v)
+            ).reshape(n, v)
         snap_fail = in_snap & (mute[:, None] | peer_mute)
         state = dataclasses.replace(
             state,
@@ -1267,6 +1376,7 @@ def fused_rounds(
     auto_propose: bool = False,
     auto_compact_lag: int | None = None,
     ops_first_round_only: bool = True,
+    straddle: StraddleSpec | None = None,
 ):
     """n_rounds fused rounds in one dispatch. `ops` applies to the first
     round only (one-shot injections) unless ops_first_round_only=False.
@@ -1274,11 +1384,18 @@ def fused_rounds(
     The scan carry rides in the slim storage dtypes (state.STATE_SLIM /
     FABRIC_SLIM): each round widens to int32, computes, and narrows back, so
     HBM holds the dieted layout while the ALU path is unchanged. XLA fuses
-    the casts into the adjacent ops."""
+    the casts into the adjacent ops.
+
+    straddle: when set (inside shard_map over spec.axis_name), delivery
+    rides the cross-shard halo router (route_fabric_straddle) so a group's
+    voters may span a shard boundary."""
     from raft_tpu.state import fat_state, slim_state
 
     state = slim_state(state)
     fab = slim_fabric(fab)
+    peer_mute = None
+    if straddle is not None and mute is not None:
+        peer_mute = straddle_peer_mute(mute, v, straddle)
 
     def body(carry, i):
         st, f = carry
@@ -1291,12 +1408,18 @@ def fused_rounds(
                 ),
                 ops,
             )
-        inb = route_fabric(fat_fabric(f), v, mute)
+        if straddle is None:
+            inb = route_fabric(fat_fabric(f), v, mute)
+        else:
+            inb = route_fabric_straddle(
+                fat_fabric(f), v, mute, straddle, peer_mute
+            )
         st, f = fused_round(
             fat_state(st),
             inb,
             o,
             mute,
+            peer_mute=peer_mute,
             do_tick=do_tick,
             auto_propose=auto_propose,
             auto_compact_lag=auto_compact_lag,
@@ -1318,6 +1441,7 @@ _fused_rounds_jit = jax.jit(
         "auto_propose",
         "auto_compact_lag",
         "ops_first_round_only",
+        "straddle",
     ),
 )
 

@@ -318,19 +318,41 @@ class ShardedCluster(Cluster):
 class ShardedFusedCluster:
     """The fused round kernel under shard_map over a device mesh.
 
-    Groups are distributed over the mesh's "groups" axis; the fused round
-    body — including its transpose-routing — touches only lanes of one
-    group, so the per-shard program has NO collectives at all and scales
-    linearly over ICI (the dropped-counter psum of the serial path does not
-    exist here: the fabric never drops).
+    Groups are distributed over the mesh's "groups" axis. By default every
+    group is shard-resident and the per-shard program has NO collectives at
+    all, scaling linearly over ICI (the dropped-counter psum of the serial
+    path does not exist here: the fabric never drops). With `straddle=True`
+    a group's voters may span a shard boundary: delivery rides the halo
+    router (ops/fused.py route_fabric_straddle) — two nearest-neighbor
+    `ppermute`s of v-1 boundary lanes per fabric field per round, the
+    fused analog of the serial route_cross_shard (SURVEY §5.8).
     """
 
-    def __init__(self, n_groups: int, n_voters: int, devices=None, seed: int = 1, **cfg):
-        from raft_tpu.ops.fused import FusedCluster, no_ops
+    def __init__(
+        self, n_groups: int, n_voters: int, devices=None, seed: int = 1,
+        straddle: bool = False, **cfg,
+    ):
+        from raft_tpu.ops.fused import FusedCluster, StraddleSpec, no_ops
 
         devices = devices if devices is not None else jax.devices()
-        if n_groups % len(devices):
-            raise ValueError("n_groups must divide evenly over devices")
+        n_lanes = n_groups * n_voters
+        self.straddle = straddle
+        self._spec = None
+        if straddle:
+            if n_lanes % len(devices):
+                raise ValueError("lanes must divide evenly over devices")
+            per = n_lanes // len(devices)
+            if per < n_voters:
+                raise ValueError(
+                    "lanes_per_shard < n_voters: a group would span more "
+                    "than two shards (halo covers one boundary)"
+                )
+            self._spec = StraddleSpec("groups", per, len(devices))
+        elif n_groups % len(devices):
+            raise ValueError(
+                "n_groups must divide evenly over devices "
+                "(or pass straddle=True)"
+            )
         self.inner = FusedCluster(n_groups, n_voters, seed=seed, **cfg)
         self.g, self.v = n_groups, n_voters
         n = n_groups * n_voters
@@ -361,6 +383,7 @@ class ShardedFusedCluster:
                     v=self.v, n_rounds=rounds, do_tick=do_tick,
                     auto_propose=auto_propose,
                     auto_compact_lag=auto_compact_lag,
+                    straddle=self._spec,
                 ),
                 mesh=self.mesh,
                 in_specs=(

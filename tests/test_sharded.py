@@ -152,6 +152,69 @@ def test_straddling_groups_elect_and_commit(devices):
     assert (com1.min(axis=1) >= com0).all()
 
 
+def test_fused_straddling_groups_elect_and_commit(devices):
+    """Fused-path cross-shard groups (VERDICT r4 item 4): 10 groups x 4
+    voters over 8 shards (5 lanes/shard) — several groups straddle shard
+    boundaries, so the fabric's votes, appends, and acks cross the mesh
+    through the halo router's ppermutes. Every group elects and commits."""
+    import numpy as np
+
+    from raft_tpu.parallel.sharded import ShardedFusedCluster
+
+    sh = ShardedFusedCluster(
+        n_groups=10, n_voters=4, devices=devices, straddle=True
+    )
+    sh.run(60)
+    sh.check_no_errors()
+    assert len(sh.leader_lanes()) == 10
+    com0 = np.asarray(sh.state.committed).copy()
+    sh.run(20, auto_propose=True, auto_compact_lag=8)
+    sh.check_no_errors()
+    com1 = np.asarray(sh.state.committed)
+    assert (com1 - com0 >= 10).all()
+
+
+def test_fused_straddle_matches_unsharded_bitwise(devices):
+    """The halo router computes the same global delivery as the
+    single-device fabric routing, so a straddling sharded run must land in
+    the BIT-IDENTICAL state as an unsharded FusedCluster run — across
+    elections, proposals, a transfer, and a partition (mute) phase."""
+    import numpy as np
+
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.parallel.sharded import ShardedFusedCluster
+
+    g, v = 10, 4
+    ref = FusedCluster(g, v, seed=21)
+    sh = ShardedFusedCluster(
+        n_groups=g, n_voters=v, devices=devices, seed=21, straddle=True
+    )
+
+    def drive(c):
+        c.run(40)
+        c.run(10, auto_propose=True, auto_compact_lag=8)
+        # leadership transfer in group 2 (lanes straddle shards 1|2)
+        c.run(1, ops=c.ops(transfer_to={2 * v: 2}), do_tick=False)
+        c.run(10)
+        # partition group 5's member 0, then heal
+        c.set_mute([5 * v], True)
+        c.run(30, auto_propose=True)
+        c.set_mute([5 * v], False)
+        c.run(20, auto_propose=True)
+
+    drive(ref)
+    drive(sh)
+    for f in (
+        "term", "vote", "lead", "state", "committed", "last", "applied",
+        "log_term", "snap_index", "error_bits",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref.state, f)),
+            np.asarray(getattr(sh.state, f)),
+            err_msg=f,
+        )
+
+
 def test_straddle_matches_aligned_results(devices):
     """With an aligned layout (no straddling), the cross-shard router must
     produce the same behavior as the shard-local router."""
