@@ -9,6 +9,11 @@ uninitialized at conftest time, so XLA_FLAGS and the config update take)."""
 
 import os
 
+# The axon PJRT hook dials the (single, tunneled) real TPU on interpreter
+# start when this var is set; the suite is CPU-only, and six xdist workers
+# would serialize on the chip claim — drop it before any backend init.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

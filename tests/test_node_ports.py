@@ -64,7 +64,7 @@ def test_node_step_routing():
         for _ in range(6):
             if b.basic_status(0)["raft_state"] == "LEADER":
                 break
-            nd.ready(timeout=300)
+            nd.ready(timeout=900)
             nd.advance()
             nd.status()  # barrier: loop processed the advance
         assert b.basic_status(0)["raft_state"] == "LEADER"
@@ -98,13 +98,13 @@ def test_node_propose_reaches_engine():
     try:
         nd = host.node(0)
         nd.campaign()
-        rd = nd.ready(timeout=300)
+        rd = nd.ready(timeout=900)
         nd.advance()
         nd.propose(b"somedata")
         # the proposal appended: surface it via the next Ready's entries
         found = []
         for _ in range(6):
-            rd = nd.ready(timeout=300)
+            rd = nd.ready(timeout=900)
             found.extend(e.data for e in rd.entries)
             nd.advance()
             if b"somedata" in found:
@@ -151,14 +151,14 @@ def test_node_propose_config():
     try:
         nd = host.node(0)
         nd.campaign()
-        rd = nd.ready(timeout=300)
+        rd = nd.ready(timeout=900)
         nd.advance()
         cc = ccm.ConfChange(type=int(ccm.ConfChangeType.ADD_NODE), node_id=2)
         ccdata = ccm.encode(cc)
         nd.propose_conf_change(ccdata)
         found = []
         for _ in range(6):
-            rd = nd.ready(timeout=300)
+            rd = nd.ready(timeout=900)
             found.extend((e.type, e.data) for e in rd.entries)
             nd.advance()
             if (int(EntryType.ENTRY_CONF_CHANGE), ccdata) in found:
@@ -202,7 +202,7 @@ def test_node_propose_add_duplicate_node():
 
         import time
 
-        for _ in range(1200):
+        for _ in range(12000):
             if b.basic_status(0)["raft_state"] == "LEADER":
                 break
             time.sleep(0.05)
@@ -216,7 +216,7 @@ def test_node_propose_add_duplicate_node():
         for data in (cc1, cc1, cc2):  # duplicate add in the middle
             applied_evt.clear()
             nd.propose_conf_change(data)
-            assert applied_evt.wait(timeout=120), "conf change did not apply"
+            assert applied_evt.wait(timeout=600), "conf change did not apply"
         stop.set()
         thr.join(timeout=5)
 
@@ -364,12 +364,12 @@ def test_node_advance_gates_next_ready():
     try:
         nd = host.node(0)
         nd.campaign()
-        rd = nd.ready(timeout=300)
+        rd = nd.ready(timeout=900)
         # without advance, no further Ready surfaces
         with pytest.raises(Exception):
             nd.ready(timeout=0.3)
         nd.advance()
-        rd = nd.ready(timeout=300)  # now the next one arrives
+        rd = nd.ready(timeout=900)  # now the next one arrives
         assert rd is not None
     finally:
         host.stop()
@@ -422,14 +422,14 @@ def test_node_propose_add_learner():
         thr.start()
         import time
 
-        for _ in range(1200):
+        for _ in range(12000):
             if b.basic_status(0)["raft_state"] == "LEADER":
                 break
             time.sleep(0.05)
         nd.propose_conf_change(ccm.encode(ccm.ConfChange(
             type=int(ccm.ConfChangeType.ADD_LEARNER_NODE), node_id=2
         )))
-        assert stop.wait(timeout=120)
+        assert stop.wait(timeout=600)
         thr.join(timeout=5)
         cs = cs_holder["cs"]
         assert cs.voters == (1,) and cs.learners == (2,)
