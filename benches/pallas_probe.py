@@ -1,0 +1,171 @@
+"""Feasibility probe: the ENTIRE fused round as ONE Pallas TPU kernel.
+
+The round-5 profile shows the fused round is HBM-bound at ~3GB/round moved
+— ~12x the one-read+one-write floor of the resident state — because XLA
+partitions the round into ~190 loop fusions that each re-read shared carry
+arrays. A single Pallas kernel over group-aligned lane tiles would read
+each state field into VMEM once, run all phases, and write once: the
+theoretical ~8x.
+
+This probe wraps the EXISTING fused_round + route_fabric (unchanged jnp
+code) in a pallas_call over lane tiles and tries to compile+run it on the
+chip, steady-state-stepping a small cluster and diffing against the plain
+XLA path. It answers ONE question cheaply: can Mosaic lower the round at
+all, and if so what does a VMEM-resident round cost?
+
+Tile invariant: tile_lanes % v == 0 (groups never straddle a tile), so
+in-tile jnp.arange(T) % v equals the global lane % v and the shift-router's
+wrap masking argument holds within a tile.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+if jax.default_backend() != "cpu":
+    enable_persistent_cache()
+
+from raft_tpu.config import Shape
+from raft_tpu.ops import fused
+from raft_tpu.ops.fused import FusedCluster, fat_fabric, slim_fabric, route_fabric
+from raft_tpu.state import fat_state, slim_state
+
+
+def pallas_rounds(state, fab, ops, *, v, tile_lanes, n_rounds,
+                  auto_compact_lag, interpret=False):
+    """n_rounds fused rounds, each as one pallas_call over lane tiles.
+    Slim carry between rounds, like fused_rounds."""
+    state = slim_state(state)
+    fab = slim_fabric(fab)
+
+    flat_s, tree_s = jax.tree.flatten(state)
+    flat_f, tree_f = jax.tree.flatten(fab)
+    flat_o, tree_o = jax.tree.flatten(ops)
+    ls, lf, lo = len(flat_s), len(flat_f), len(flat_o)
+    n = state.term.shape[0]
+    assert n % tile_lanes == 0 and tile_lanes % v == 0
+    grid = (n // tile_lanes,)
+
+    def spec_of(x):
+        bs = (tile_lanes,) + x.shape[1:]
+        nd = x.ndim
+        return pl.BlockSpec(bs, lambda i, nd=nd: (i,) + (0,) * (nd - 1))
+
+    in_specs = [spec_of(x) for x in flat_s + flat_f + flat_o]
+    out_specs = [spec_of(x) for x in flat_s + flat_f]
+    out_shape = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in flat_s + flat_f]
+
+    def kernel(*refs):
+        ins, outs = refs[: ls + lf + lo], refs[ls + lf + lo :]
+        vals = [r[...] for r in ins]
+        st = jax.tree.unflatten(tree_s, vals[:ls])
+        fb = jax.tree.unflatten(tree_f, vals[ls : ls + lf])
+        op = jax.tree.unflatten(tree_o, vals[ls + lf :])
+        inb = route_fabric(fat_fabric(fb), v, None)
+        st2, fb2 = fused.fused_round(
+            fat_state(st), inb, op, None,
+            do_tick=True, auto_propose=True,
+            auto_compact_lag=auto_compact_lag,
+        )
+        for r, x in zip(outs, jax.tree.leaves(slim_state(st2))
+                        + jax.tree.leaves(slim_fabric(fb2))):
+            r[...] = x
+
+    call = pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=interpret,
+    )
+
+    @jax.jit
+    def run(flat_s, flat_f, flat_o):
+        def body(carry, _):
+            fs, ff = carry
+            out = call(*fs, *ff, *flat_o)
+            return (list(out[:ls]), list(out[ls:])), None
+        (fs, ff), _ = jax.lax.scan(body, (flat_s, flat_f), length=n_rounds)
+        return fs, ff
+
+    fs, ff = run(flat_s, flat_f, flat_o)
+    return (jax.tree.unflatten(tree_s, fs), jax.tree.unflatten(tree_f, ff))
+
+
+def main():
+    groups = int(os.environ.get("PP_GROUPS", 4096))
+    v = int(os.environ.get("PP_VOTERS", 3))
+    w = int(os.environ.get("BENCH_WINDOW", 16))
+    e = int(os.environ.get("BENCH_ENTRIES", 2))
+    tile = int(os.environ.get("PP_TILE", 1024 * v))
+    block = int(os.environ.get("PP_BLOCK", 32))
+    interpret = bool(int(os.environ.get("PP_INTERPRET", "0")))
+
+    shape = Shape(n_lanes=groups * v, max_peers=v, log_window=w,
+                  max_msg_entries=e, max_inflight=min(8, e), max_read_index=2)
+    c = FusedCluster(groups, v, seed=42, shape=shape)
+    lag = min(8, w // 2)
+    # steady state via the known-good XLA path
+    c.run(64, auto_propose=True, auto_compact_lag=lag)
+    jax.block_until_ready(c.state.term)
+    print(f"steady: leaders={len(c.leader_lanes())}/{groups}")
+
+    ops = fused.no_ops(shape.n)
+    # reference: one more XLA block
+    ref_s, ref_f = fused._fused_rounds_jit(
+        c.state, c.fab, ops, None, v=v, n_rounds=block, do_tick=True,
+        auto_propose=True, auto_compact_lag=lag, ops_first_round_only=False, straddle=None)
+    jax.block_until_ready(ref_s.term)
+
+    t0 = time.perf_counter()
+    got_s, got_f = pallas_rounds(
+        c.state, c.fab, ops, v=v, tile_lanes=tile, n_rounds=block,
+        auto_compact_lag=lag, interpret=interpret)
+    jax.block_until_ready(got_s.term)
+    compile_s = time.perf_counter() - t0
+    print(f"pallas compiled+ran {block} rounds in {compile_s:.1f}s")
+
+    # bit-identity check
+    import numpy as np
+    bad = []
+    for name in ("term", "vote", "lead", "state", "committed", "last",
+                 "log_term", "error_bits"):
+        a = np.asarray(getattr(ref_s, name))
+        b = np.asarray(getattr(got_s, name))
+        if not (a == b).all():
+            bad.append(name)
+    print("MISMATCH:" if bad else "BIT-IDENTICAL:", bad or "all checked fields")
+
+    # timing (RTT-cancelling)
+    def timed(fn):
+        t0 = time.perf_counter(); fn(1); t1 = time.perf_counter()
+        fn(4); t2 = time.perf_counter()
+        return ((t2 - t1) - (t1 - t0)) / 3
+    def run_pallas(k):
+        s, f = c.state, c.fab
+        for _ in range(k):
+            s, f = pallas_rounds(s, f, ops, v=v, tile_lanes=tile,
+                                 n_rounds=block, auto_compact_lag=lag,
+                                 interpret=interpret)
+        jax.block_until_ready(s.term)
+    def run_xla(k):
+        s, f = c.state, c.fab
+        for _ in range(k):
+            s, f = fused._fused_rounds_jit(
+                s, f, ops, None, v=v, n_rounds=block, do_tick=True,
+                auto_propose=True, auto_compact_lag=lag,
+                ops_first_round_only=False, straddle=None)
+        jax.block_until_ready(s.term)
+    tp = timed(run_pallas) / block * 1e3
+    tx = timed(run_xla) / block * 1e3
+    print(f"pallas: {tp:.3f} ms/round   xla: {tx:.3f} ms/round   "
+          f"({groups} groups x {v}, tile {tile})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Aggregate a jax.profiler xplane capture into per-HLO-category device time.
+
+Usage: python -m benches.profile_analyze [xplane.pb path | profile dir]
+
+Walks the device plane's "XLA Ops" line and groups event durations by the
+op's hlo_category stat (falling back to a name prefix), printing a table of
+total device-time share — the tool that found round 4's 73%-retile
+bottleneck, now committed so every round can re-measure what binds.
+
+Requires PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python when the installed
+protobuf runtime rejects TF's generated descriptors (set automatically
+below, before the TF import).
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import sys
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def find_xplane(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                            recursive=True))
+    if not hits:
+        raise SystemExit(f"no .xplane.pb under {path}")
+    return hits[-1]
+
+
+def load(path: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+def analyze(path: str, top: int = 25):
+    xs = load(find_xplane(path))
+    dev = next((p for p in xs.planes if "TPU" in p.name or "device:" in p.name
+                and p.lines), None)
+    planes = [p for p in xs.planes if p.lines and "CPU" not in p.name
+              and "host" not in p.name]
+    if dev is None or not dev.lines:
+        dev = planes[0]
+    meta = dev.event_metadata
+    stat_meta = dev.stat_metadata
+
+    def stat_name(sid):
+        return stat_meta[sid].name if sid in stat_meta else str(sid)
+
+    by_cat = collections.Counter()
+    by_op = collections.Counter()
+    op_count = collections.Counter()
+    total_ps = 0
+    n_events = 0
+    for line in dev.lines:
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            m = meta[ev.metadata_id]
+            dur = ev.duration_ps
+            cat = None
+            for st in list(ev.stats) + list(m.stats):
+                if stat_name(st.metadata_id) == "hlo_category":
+                    cat = st.str_value or st.ref_value
+                    if isinstance(cat, int):
+                        cat = stat_name(cat)
+                    break
+            if not cat:
+                cat = m.name.split(".")[0].split("-")[0]
+            by_cat[cat] += dur
+            key = m.name.split(".")[0]
+            by_op[key] += dur
+            op_count[key] += 1
+            total_ps += dur
+            n_events += 1
+
+    tot_ms = total_ps / 1e9
+    print(f"device XLA-op events: {n_events}, total device time: "
+          f"{tot_ms:.2f} ms")
+    print("\n-- by hlo_category --")
+    for cat, ps in by_cat.most_common(top):
+        print(f"{ps/1e9:9.2f} ms  {100*ps/total_ps:5.1f}%  {cat}")
+    print("\n-- top ops (name prefix) --")
+    for op, ps in by_op.most_common(top):
+        print(f"{ps/1e9:9.2f} ms  {100*ps/total_ps:5.1f}%  x{op_count[op]:<6d} {op}")
+
+
+if __name__ == "__main__":
+    analyze(sys.argv[1] if len(sys.argv) > 1 else "/tmp/raft_prof")

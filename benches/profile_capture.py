@@ -1,0 +1,72 @@
+"""Capture an XLA device profile of the steady-state fused round.
+
+Runs one 64k-group x 3-voter block (bench.py's north-star block shape) to
+steady state (all leaders elected, committing every round), then traces a
+window of `PROF_ROUNDS` rounds into PROF_DIR (default /tmp/raft_prof).
+
+Analyze the resulting .xplane.pb with benches/profile_analyze.py.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+if jax.default_backend() != "cpu":
+    enable_persistent_cache()
+
+
+def main():
+    from raft_tpu.config import Shape
+    from raft_tpu.ops.fused import FusedCluster
+
+    groups = int(os.environ.get("PROF_GROUPS", 65536))
+    voters = int(os.environ.get("PROF_VOTERS", 3))
+    w = int(os.environ.get("BENCH_WINDOW", 16))
+    e = int(os.environ.get("BENCH_ENTRIES", 2))
+    block = int(os.environ.get("PROF_BLOCK", 32))
+    out = os.environ.get("PROF_DIR", "/tmp/raft_prof")
+
+    shape = Shape(
+        n_lanes=groups * voters,
+        max_peers=voters,
+        log_window=w,
+        max_msg_entries=e,
+        max_inflight=min(8, e),
+        max_read_index=2,
+    )
+    c = FusedCluster(groups, voters, seed=42, shape=shape)
+    lag = min(8, w // 2)
+
+    def sync():
+        jax.block_until_ready(c.state.term)
+
+    # warm up: elections + compile + reach steady state (same block size as
+    # the traced window so exactly one program compiles)
+    t0 = time.perf_counter()
+    for _ in range(max(1, 64 // block)):
+        c.run(block, auto_propose=True, auto_compact_lag=lag)
+    sync()
+    print(f"warmup 64 rounds: {time.perf_counter() - t0:.1f}s "
+          f"leaders={len(c.leader_lanes())}/{groups}")
+
+    # timed, untraced reference window
+    t0 = time.perf_counter()
+    c.run(block, auto_propose=True, auto_compact_lag=lag)
+    sync()
+    dt = time.perf_counter() - t0
+    print(f"untraced {block} rounds: {dt*1e3:.1f} ms "
+          f"({dt/block*1e3:.3f} ms/round)")
+
+    with jax.profiler.trace(out):
+        c.run(block, auto_propose=True, auto_compact_lag=lag)
+        sync()
+    print(f"trace written to {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -366,13 +366,34 @@ def route_fabric_straddle(
 _ROUTE_IMPL = os.environ.get("RAFT_TPU_ROUTE", "auto")
 _AUTO_SHIFT_MIN_LANES = 256
 
+# rounds-per-scan-iteration in fused_rounds (RAFT_TPU_UNROLL): unrolling
+# lets XLA fuse across adjacent rounds' slim<->fat casts and drop per-
+# iteration while-loop overhead, at the cost of a proportionally bigger
+# program (compile time) — A/B'd on chip, see BASELINE.md round 5.
+_SCAN_UNROLL = int(os.environ.get("RAFT_TPU_UNROLL", "1"))
 
-def route_fabric(out: Fabric, v: int, mute=None, impl: str | None = None) -> Fabric:
+
+def aligned_peer_mute(mute, v: int):
+    """[N, V] peer-mute matrix for group-aligned lanes: cell [dst, i] is
+    the mute bit of group member i of dst's group — lane (dst//v)*v + i.
+    Loop-invariant across a scan of rounds; compute once and pass to
+    route_fabric/fused_round (the in-scan fallback recomputed it every
+    round, profiled at ~6% of round time as a [G,V,V] broadcast+retile)."""
+    n = mute.shape[0]
+    g = n // v
+    return jnp.broadcast_to(mute.reshape(g, 1, v), (g, v, v)).reshape(n, v)
+
+
+def route_fabric(
+    out: Fabric, v: int, mute=None, impl: str | None = None, peer_mute=None
+) -> Fabric:
     """Deliver: inbox[g, j, i] = outbox[g, i, j]; the self slot passes
     through (it is the lane's own queued ack).
 
     mute: optional [N] bool — a muted lane neither sends nor receives (the
-    fabric analog of rafttest/network.go:122-144 disconnect)."""
+    fabric analog of rafttest/network.go:122-144 disconnect).
+    peer_mute: optional precomputed aligned_peer_mute(mute, v) — cell
+    [dst, i] is the sender's mute bit, loop-invariant across rounds."""
     impl = impl or _ROUTE_IMPL
     if impl not in ("auto", "shift", "transpose"):
         raise ValueError(
@@ -387,18 +408,14 @@ def route_fabric(out: Fabric, v: int, mute=None, impl: str | None = None) -> Fab
     def t(x):
         return field(x, v)
 
-    def src_mute_cols(n):
-        # cell [dst, i] came from lane (dst//v)*v + i
-        if impl == "shift":
-            return t(jnp.broadcast_to(mute[:, None], (n, v)))
-        g = n // v
-        return jnp.broadcast_to(mute.reshape(g, 1, v), (g, v, v)).reshape(n, v)
-
     def deliver(chan):
         chan = jax.tree.map(t, chan)
         if mute is None:
             return chan
-        cut = src_mute_cols(mute.shape[0]) | mute[:, None]
+        src_mute = (
+            peer_mute if peer_mute is not None else aligned_peer_mute(mute, v)
+        )
+        cut = src_mute | mute[:, None]
         return dataclasses.replace(
             chan, kind=jnp.where(cut, jnp.int32(MT.MSG_NONE), chan.kind)
         )
@@ -739,7 +756,7 @@ def fused_round(
         (inb.rep.kind == MT.MSG_APP) | (inb.rep.kind == MT.MSG_SNAP)
     ) & (inb.rep.term == state.term[:, None])
     any_app = app_cell.any(axis=1)
-    win = jnp.argmax(app_cell, axis=1).astype(I32)  # first hot slot
+    win = ohm.argmax_last(app_cell)  # first hot slot
     mrow = _select_row(inb.rep, win, any_app)
     m_frm = jnp.where(any_app, win + 1, 0)
 
@@ -788,7 +805,7 @@ def fused_round(
         inb.hb.term == state.term[:, None]
     )
     any_hb = hb_cell.any(axis=1)
-    hwin = jnp.argmax(hb_cell, axis=1).astype(I32)
+    hwin = ohm.argmax_last(hb_cell)
     hrow = _select_row(inb.hb, hwin, any_hb)
     h_frm = jnp.where(any_hb, hwin + 1, 0)
     state = stepmod.become_follower(state, any_hb & is_cand, state.term, h_frm)
@@ -828,7 +845,7 @@ def fused_round(
     is_pv_cell = inb.vote.kind == MT.MSG_PRE_VOTE
     real_grantable = grantable & ~is_pv_cell
     any_real = real_grantable.any(axis=1)
-    gwin = jnp.argmax(real_grantable, axis=1).astype(I32)
+    gwin = ohm.argmax_last(real_grantable)
     real_grant_cell = real_grantable & (lanes_v == gwin[:, None]) & any_real[:, None]
     grant_cell = (grantable & is_pv_cell) | real_grant_cell
     resp_kind = jnp.where(
@@ -873,10 +890,7 @@ def fused_round(
     in_snap = is_leader[:, None] & (state.pr_state == ProgressState.SNAPSHOT)
     if mute is not None:
         if peer_mute is None:
-            g = n // v
-            peer_mute = jnp.broadcast_to(
-                mute.reshape(g, 1, v), (g, v, v)
-            ).reshape(n, v)
+            peer_mute = aligned_peer_mute(mute, v)
         snap_fail = in_snap & (mute[:, None] | peer_mute)
         state = dataclasses.replace(
             state,
@@ -1110,9 +1124,7 @@ def fused_round(
     # MSG_BEAT block, step.py:856-868)
     is_leader = state.state == StateType.LEADER
     beat_live = state.ro_ctx != 0
-    beat_newest = jnp.argmax(
-        jnp.where(beat_live, state.ro_seq, -1), axis=1
-    ).astype(I32)
+    beat_newest = ohm.argmax_last(jnp.where(beat_live, state.ro_seq, -1))
     beat_ctx = jnp.where(
         beat_live.any(axis=1), ohm.gather(state.ro_ctx, beat_newest), 0
     )
@@ -1215,7 +1227,7 @@ def fused_round(
     immediate = ri_ok & (single | state.cfg.read_only_lease_based)
     enq = ri_ok & ~immediate
     free = state.ro_ctx == 0
-    first_free = jnp.argmax(free, axis=1).astype(I32)
+    first_free = ohm.argmax_last(free)
     can_enq = enq & free.any(axis=1)
     put_r = ohm.onehot(first_free, r_ax) & can_enq[:, None]
     state = dataclasses.replace(
@@ -1394,8 +1406,14 @@ def fused_rounds(
     state = slim_state(state)
     fab = slim_fabric(fab)
     peer_mute = None
-    if straddle is not None and mute is not None:
-        peer_mute = straddle_peer_mute(mute, v, straddle)
+    if mute is not None:
+        # loop-invariant across the scan: hoist the [N,V] sender-mute matrix
+        # out of the round body (in-scan it recomputes as a [G,V,V]
+        # broadcast+retile every round — profiled at ~6% of round time)
+        if straddle is not None:
+            peer_mute = straddle_peer_mute(mute, v, straddle)
+        else:
+            peer_mute = aligned_peer_mute(mute, v)
 
     def body(carry, i):
         st, f = carry
@@ -1409,7 +1427,7 @@ def fused_rounds(
                 ops,
             )
         if straddle is None:
-            inb = route_fabric(fat_fabric(f), v, mute)
+            inb = route_fabric(fat_fabric(f), v, mute, peer_mute=peer_mute)
         else:
             inb = route_fabric_straddle(
                 fat_fabric(f), v, mute, straddle, peer_mute
@@ -1427,7 +1445,10 @@ def fused_rounds(
         return (slim_state(st), slim_fabric(f)), None
 
     (state, fab), _ = jax.lax.scan(
-        body, (state, fab), jnp.arange(n_rounds, dtype=I32)
+        body,
+        (state, fab),
+        jnp.arange(n_rounds, dtype=I32),
+        unroll=min(_SCAN_UNROLL, n_rounds),
     )
     return state, fab
 

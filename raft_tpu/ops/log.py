@@ -191,9 +191,14 @@ def append(
     write = ok[:, None] & (jnp.arange(e, dtype=I32)[None, :] < n_ents[:, None])
     slot0 = slot_of(state, prev_index + 1)
 
-    def scatter(col, vals):
-        # Contiguous circular scatter of [N, E] vals into [N, W].
-        return oh.scatter_range_set(col, slot0, vals, write)
+    # contiguous circular scatter of [N, E] vals into [N, W]; the three
+    # columns share one set of rolled one-hot masks
+    new_term, new_type, new_bytes = oh.scatter_range_set_multi(
+        [state.log_term, state.log_type, state.log_bytes],
+        slot0,
+        [ent_term, ent_type, ent_bytes],
+        write,
+    )
 
     new_last = jnp.where(ok, prev_index + n_ents, state.last)
     state = _err(
@@ -201,9 +206,9 @@ def append(
     )
     return dataclasses.replace(
         state,
-        log_term=scatter(state.log_term, ent_term),
-        log_type=scatter(state.log_type, ent_type),
-        log_bytes=scatter(state.log_bytes, ent_bytes),
+        log_term=new_term,
+        log_type=new_type,
+        log_bytes=new_bytes,
         last=new_last,
         stabled=jnp.where(ok, jnp.minimum(state.stabled, prev_index), state.stabled),
         applying=jnp.minimum(state.applying, new_last),
@@ -243,18 +248,19 @@ def maybe_append(
     shift = jnp.where(ci > 0, ci - index - 1, 0)  # [N]
     e = ent_term.shape[-1]
 
-    def shifted(col):
-        # contiguous in the source; wrapped reads land only in slots the
-        # n_keep write mask excludes
-        return oh.gather_range(col, shift, e)
+    # contiguous in the source; wrapped reads land only in slots the
+    # n_keep write mask excludes. One shared rolled-mask set for the triple.
+    sh_term, sh_type, sh_bytes = oh.gather_range_multi(
+        [ent_term, ent_type, ent_bytes], shift, e
+    )
 
     n_keep = jnp.where(ok & (ci > 0), n_ents - shift, 0)
     state = append(
         state,
         jnp.where(ci > 0, ci - 1, 0),
-        shifted(ent_term),
-        shifted(ent_type),
-        shifted(ent_bytes),
+        sh_term,
+        sh_type,
+        sh_bytes,
         n_keep,
     )
     state = commit_to(state, jnp.where(ok, jnp.minimum(committed, lastnewi), 0))
@@ -392,7 +398,10 @@ def gather_entries(state: RaftState, lo, count, e: int):
     ) & (idx > state.snap_index[:, None])
     slot0 = slot_of(state, lo)
 
-    def g(col):
-        return jnp.where(valid, oh.gather_range(col, slot0, e), 0)
-
-    return g(state.log_term), g(state.log_type), g(state.log_bytes), valid
+    t, ty, by = (
+        jnp.where(valid, x, 0)
+        for x in oh.gather_range_multi(
+            [state.log_term, state.log_type, state.log_bytes], slot0, e
+        )
+    )
+    return t, ty, by, valid

@@ -20,13 +20,16 @@ fixed sorting network; no dynamic shapes anywhere.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from raft_tpu.ops import onehot as oh
 from raft_tpu.types import VoteResult, VoteState
 
 I32 = jnp.int32
 # Identity element standing in for the reference's MaxUint64 (majority.go:129).
-COMMITTED_INF = jnp.int32(2**31 - 1)
+# np (not jnp) scalar: a module-scope device array would be captured as a
+# closure constant by any Pallas kernel that traces through this module
+COMMITTED_INF = np.int32(2**31 - 1)
 
 
 def quorum_size(mask):

@@ -79,7 +79,7 @@ def find_slot(state: RaftState, ids):
     """Map a raft id [N] to its peer slot [N]; -1 when absent (id 0 is the
     None placeholder and never resolves)."""
     hit = (state.prs_id == ids[:, None]) & (state.prs_id != 0)
-    slot = jnp.argmax(hit, axis=1).astype(I32)
+    slot = ohm.argmax_last(hit)
     return jnp.where(hit.any(axis=1), slot, -1)
 
 
@@ -375,17 +375,17 @@ def maybe_send_append(
     w = state.log_term.shape[-1]
     slot0 = state.pr_next & (w - 1)
 
-    def gather_peer(col):
-        k = jnp.arange(e, dtype=I32)[None, None, :]
-        validk = k < n_send[..., None]
-        return jnp.where(validk, ohm.gather_range(col, slot0, e), 0)
-
-    ent_term = gather_peer(state.log_term)
-    ent_type = gather_peer(state.log_type)
-    ent_bytes = gather_peer(state.log_bytes)
+    k = jnp.arange(e, dtype=I32)[None, None, :]
+    validk = k < n_send[..., None]
+    ent_term, ent_type, ent_bytes = (
+        jnp.where(validk, x, 0)
+        for x in ohm.gather_range_multi(
+            [state.log_term, state.log_type, state.log_bytes], slot0, e
+        )
+    )
     # byte budget: trim to max_size_per_msg, always keeping >= 1 entry
     # (reference util.go:266 limitSize semantics)
-    csum = jnp.cumsum(ent_bytes, axis=-1)
+    csum = ohm.cumsum_last(ent_bytes)
     within = csum <= state.cfg.max_size_per_msg[:, None, None]
     k = jnp.arange(e, dtype=I32)[None, None, :]
     n_fit = jnp.sum(within.astype(I32), axis=-1)
