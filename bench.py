@@ -81,7 +81,9 @@ def run_fused(n_groups, n_voters, n_iters, block, block_groups=None):
     commits = c.total_committed() - com0
     c.check_no_errors()
     assert commits > 0, "benchmark workload stalled: no entries committed"
-    return dt, compile_s, c.leader_count(), commits
+    # device-plane observability pull AFTER the timed region (one tiny
+    # transfer per block; None when RAFT_TPU_METRICS=0)
+    return dt, compile_s, c.leader_count(), commits, c.metrics_snapshot()
 
 
 def run_serial(n_groups, n_voters, n_iters, block):
@@ -114,7 +116,7 @@ def run_serial(n_groups, n_voters, n_iters, block):
     dt = time.perf_counter() - t0
     commits = int(jnp.sum(state.committed)) - com0
     n_leaders = int(jnp.sum(state.state == 2))
-    return dt, compile_s, n_leaders, commits
+    return dt, compile_s, n_leaders, commits, None
 
 
 def main():
@@ -142,7 +144,7 @@ def main():
     with trace(env_trace_dir()):
         if engine == "fused":
             try:
-                dt, compile_s, n_leaders, commits = run_fused(
+                dt, compile_s, n_leaders, commits, met = run_fused(
                     n_groups, n_voters, n_iters, block, block_groups
                 )
             except Exception as e:  # noqa: BLE001 — still print a record
@@ -157,16 +159,49 @@ def main():
                     file=sys.stderr,
                 )
                 fallback, n_groups = True, block_groups
-                dt, compile_s, n_leaders, commits = run_fused(
+                dt, compile_s, n_leaders, commits, met = run_fused(
                     n_groups, n_voters, n_iters, block, block_groups
                 )
         else:
-            dt, compile_s, n_leaders, commits = run_serial(
+            dt, compile_s, n_leaders, commits, met = run_serial(
                 n_groups, n_voters, n_iters, block
             )
 
     groups_ticks_per_sec = n_groups * n_iters * block / dt
     target = 1_000_000.0
+    extra = {
+        "engine": engine,
+        "groups": n_groups,
+        "block_groups": block_groups,
+        "resident_blocks": -(-n_groups // block_groups),
+        "fallback": fallback,
+        "voters": n_voters,
+        "leaders_elected": n_leaders,
+        "commits_per_group_round": round(
+            commits / (n_groups * n_voters * n_iters * block), 3
+        ),
+        "round_ms": round(1000 * dt / (n_iters * block), 3),
+        "block": block,
+        "compile_s": round(compile_s, 1),
+        "platform": platform,
+    }
+    if met is not None:
+        # the device metrics plane's cumulative totals (raft_tpu/metrics/)
+        extra["metrics"] = {k: v for k, v in met["counters"].items() if v}
+        for k in ("elections_started", "elections_won", "leader_changes",
+                  "commits"):
+            extra["metrics"].setdefault(k, met["counters"].get(k, 0))
+        # optional exporters, mirroring what a production driver would hang
+        # off the registry
+        from raft_tpu.metrics.host import JsonlWriter, prometheus_text
+
+        jsonl = os.environ.get("RAFT_TPU_METRICS_JSONL")
+        if jsonl:
+            JsonlWriter(jsonl).write(met, source="bench", engine=engine)
+        prom = os.environ.get("RAFT_TPU_METRICS_PROM")
+        if prom:
+            with open(prom, "w") as f:
+                f.write(prometheus_text(met))
     print(
         json.dumps(
             {
@@ -174,22 +209,7 @@ def main():
                 "value": round(groups_ticks_per_sec, 1),
                 "unit": "groups*ticks/s",
                 "vs_baseline": round(groups_ticks_per_sec / target, 4),
-                "extra": {
-                    "engine": engine,
-                    "groups": n_groups,
-                    "block_groups": block_groups,
-                    "resident_blocks": -(-n_groups // block_groups),
-                    "fallback": fallback,
-                    "voters": n_voters,
-                    "leaders_elected": n_leaders,
-                    "commits_per_group_round": round(
-                        commits / (n_groups * n_voters * n_iters * block), 3
-                    ),
-                    "round_ms": round(1000 * dt / (n_iters * block), 3),
-                    "block": block,
-                    "compile_s": round(compile_s, 1),
-                    "platform": platform,
-                },
+                "extra": extra,
             }
         )
     )

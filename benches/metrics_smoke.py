@@ -1,0 +1,92 @@
+"""Metrics-plane smoke: one short metrics-on run end to end through both
+exporters, failing on an empty or non-finite export.
+
+Run by runtests.sh after the suite (CPU) and usable standalone on TPU:
+
+    python benches/metrics_smoke.py
+
+Checks:
+  - the device plane produced a snapshot with nonzero elections/commits;
+  - every exported value is a finite non-negative integer (no NaN/Inf can
+    survive a counter path — this guards the int histogram/sum math too);
+  - the Prometheus rendering is non-empty and structurally sound;
+  - the JSONL writer emitted a parseable record.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["RAFT_TPU_METRICS"] = "1"
+
+
+def fail(msg: str):
+    print(f"metrics_smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def walk_numbers(obj, path="$"):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            yield from walk_numbers(v, f"{path}.{k}")
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            yield from walk_numbers(v, f"{path}[{i}]")
+    elif isinstance(obj, (int, float)):
+        yield path, obj
+
+
+def main():
+    from raft_tpu.metrics.host import JsonlWriter, prometheus_text
+    from raft_tpu.ops.fused import FusedCluster
+
+    c = FusedCluster(8, 3, seed=4)
+    if c.metrics is None:
+        fail("RAFT_TPU_METRICS=1 but FusedCluster has no metrics state")
+    c.run(40, auto_propose=True)
+    snap = c.metrics_snapshot()
+    if snap is None:
+        fail("metrics_snapshot() returned None with metrics enabled")
+
+    ct = snap["counters"]
+    for must in ("elections_won", "commits", "msgs_app"):
+        if ct.get(must, 0) <= 0:
+            fail(f"counter {must!r} is {ct.get(must)} after an active run")
+    for path, v in walk_numbers(snap):
+        if isinstance(v, float) and not math.isfinite(v):
+            fail(f"non-finite value at {path}: {v}")
+        if v < 0:
+            fail(f"negative value at {path}: {v}")
+
+    text = prometheus_text(snap)
+    if not text.strip():
+        fail("prometheus_text produced empty output")
+    for line in text.strip().split("\n"):
+        if line.startswith("#"):
+            continue
+        name, val = line.rsplit(" ", 1)
+        x = float(val)
+        if not math.isfinite(x) or x < 0:
+            fail(f"bad exported sample: {line!r}")
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "m.jsonl")
+        JsonlWriter(p).write(snap, source="metrics_smoke")
+        with open(p) as f:
+            rec = json.loads(f.readline())
+        if rec["counters"] != ct:
+            fail("JSONL roundtrip altered the counters")
+
+    print(
+        "metrics_smoke: OK "
+        + json.dumps({k: v for k, v in ct.items() if v}, sort_keys=True)
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -43,8 +43,8 @@ def load(path: str):
 
 def analyze(path: str, top: int = 25):
     xs = load(find_xplane(path))
-    dev = next((p for p in xs.planes if "TPU" in p.name or "device:" in p.name
-                and p.lines), None)
+    dev = next((p for p in xs.planes
+                if ("TPU" in p.name or "device:" in p.name) and p.lines), None)
     planes = [p for p in xs.planes if p.lines and "CPU" not in p.name
               and "host" not in p.name]
     if dev is None or not dev.lines:

@@ -332,6 +332,19 @@ def _compiled_kernels(max_entries: int):
     )
 
 
+# outbound message type -> metrics counter family (MSG_SNAP folds into
+# msgs_app like the device plane: a snapshot IS the append path's catch-up)
+_MSG_COUNTER = {
+    int(MT.MSG_APP): "msgs_app",
+    int(MT.MSG_SNAP): "msgs_app",
+    int(MT.MSG_APP_RESP): "msgs_app_resp",
+    int(MT.MSG_HEARTBEAT): "msgs_heartbeat",
+    int(MT.MSG_HEARTBEAT_RESP): "msgs_heartbeat_resp",
+    int(MT.MSG_VOTE): "msgs_vote",
+    int(MT.MSG_VOTE_RESP): "msgs_vote_resp",
+}
+
+
 class RawNodeBatch:
     """N RawNodes resident in one device batch."""
 
@@ -361,6 +374,11 @@ class RawNodeBatch:
         self.trace = None
         self.view = _StateView()
         self.view.refresh(self.state)
+        # host-plane observability counters, same snapshot schema as the
+        # device plane (raft_tpu/metrics/); counted at the Ready surface
+        from raft_tpu.metrics.host import HostCounters
+
+        self.metrics = HostCounters()
         self._msgs: list[list[Message]] = [[] for _ in range(n)]
         self._after_append: list[list[Message]] = [[] for _ in range(n)]
         self._steps_on_advance: list[list[Message]] = [[] for _ in range(n)]
@@ -808,6 +826,7 @@ class RawNodeBatch:
             self._drain(lane, frm)
 
     def campaign(self, lane: int):
+        self.metrics.inc("elections_started")
         self._run_step(lane, Message(type=int(MT.MSG_HUP), to=self.id_of(lane)))
 
     def propose(self, lane: int, data: bytes):
@@ -845,10 +864,13 @@ class RawNodeBatch:
         )
         self._run_step(lane, msg)
         if int(self.view.last[lane]) > old_last:
+            self.metrics.inc("proposals")
             return
         n_fwd = sum(1 for m in self._msgs[lane] if m.type == int(MT.MSG_PROP))
         if n_fwd > n_fwd_before:
+            self.metrics.inc("proposals")
             return
+        self.metrics.inc("proposals_dropped")
         raise ErrProposalDropped()
 
     def transfer_leadership(self, lane: int, transferee: int):
@@ -1029,6 +1051,26 @@ class RawNodeBatch:
             if rd.committed_entries:
                 rd.messages.append(self._storage_apply_msg(lane, rd))
         if not peek:
+            # count at the accept surface so a peeked Ready isn't double
+            # counted; families mirror the device plane's counter names
+            mx = self.metrics
+            for m in rd.messages:
+                fam = _MSG_COUNTER.get(m.type)
+                if fam:
+                    mx.inc(fam)
+            if rd.committed_entries:
+                mx.inc("commits", len(rd.committed_entries))
+            if rd.read_states:
+                mx.inc("read_index_served", len(rd.read_states))
+            if rd.soft_state:
+                prev = self._prev_ss[lane]
+                if (
+                    rd.soft_state.raft_state == int(StateType.LEADER)
+                    and prev.raft_state != int(StateType.LEADER)
+                ):
+                    mx.inc("elections_won")
+                if rd.soft_state.lead not in (0, prev.lead):
+                    mx.inc("leader_changes")
             # acceptReady (reference rawnode.go:404-440)
             if rd.hard_state:
                 self._prev_hs[lane] = rd.hard_state

@@ -1,0 +1,48 @@
+"""Device + host metrics plane for the multi-raft engine.
+
+Two halves, mirroring how etcd wires its `raft_*_total` Prometheus family
+without ever letting telemetry touch the consensus hot path:
+
+- device plane (`metrics/device.py`): a fixed-layout `MetricsState` pytree
+  carried through the fused round. Every per-lane event mask is reduced to
+  a handful of scalars INSIDE the round (one [K]-counter vector, one
+  [B]-bucket commit-latency histogram per block), so the host pulls a tiny
+  array per dispatch instead of [N] columns. The whole plane is
+  compile-time optional: `RAFT_TPU_METRICS=0` passes `metrics=None` and
+  not a single metrics op enters the jaxpr.
+- host plane (`metrics/host.py`): wraparound-aware accumulation of the
+  device's int32 counters into host int64 totals, a snapshot/delta
+  registry, a Prometheus text exporter, and a JSONL time-series writer.
+"""
+
+from raft_tpu.metrics.device import (
+    COUNTERS,
+    HIST_EDGES,
+    MetricsState,
+    init_metrics,
+    metrics_enabled,
+)
+from raft_tpu.metrics.host import (
+    CounterAccumulator,
+    HostCounters,
+    JsonlWriter,
+    MetricsRegistry,
+    empty_snapshot,
+    merge_snapshots,
+    prometheus_text,
+)
+
+__all__ = [
+    "COUNTERS",
+    "HIST_EDGES",
+    "MetricsState",
+    "init_metrics",
+    "metrics_enabled",
+    "CounterAccumulator",
+    "HostCounters",
+    "JsonlWriter",
+    "MetricsRegistry",
+    "empty_snapshot",
+    "merge_snapshots",
+    "prometheus_text",
+]

@@ -1,0 +1,217 @@
+"""Device half of the metrics plane: the `MetricsState` pytree carried
+through the fused round (ops/fused.py).
+
+Design constraints, in order:
+
+1. **Zero cost when off.** Every instrumentation site in fused_round is
+   guarded by `if metrics is not None:` — Python-level, evaluated at trace
+   time — so `RAFT_TPU_METRICS=0` produces a jaxpr with no metrics ops at
+   all (asserted by tests/test_metrics.py).
+2. **Tiny host pulls.** Per-lane event masks reduce to scalars INSIDE the
+   round: the carry holds one [K] counter vector and one [B] histogram per
+   block, not per-lane columns — the EQuARX-style "aggregate on device"
+   rule (PAPERS.md). Only the latency sampler keeps [N] columns, and those
+   never leave the device.
+3. **Overflow is the host's problem.** Counters are int32 and WRAP; the
+   host accumulates wraparound-aware deltas into int64 (host.py
+   CounterAccumulator), exact as long as it pulls at least once per 2^31
+   events per counter — at 17M groups*ticks/s that is minutes, and bench
+   pulls every block.
+
+Counter semantics (all cumulative event counts, summed over lanes):
+
+- elections_started: hup() campaigns actually fired (tick timeout, injected
+  MsgHup, TimeoutNow transfer, or PreVote->Vote promotion that passed the
+  promotable/no-pending-conf-change gate — reference raft.go:941-961).
+- elections_won: candidate lanes whose vote tally reached quorum this
+  round (becomeLeader, raft.go:793).
+- leader_changes: lanes whose known leader id changed to a DIFFERENT
+  nonzero id during the round (the fused analog of etcd's
+  raft_leader_changes_seen_total).
+- commits: total committed-index advance summed over lanes.
+- proposals: entries appended via host/auto proposals (incl. conf-change
+  entries).
+- proposals_dropped: proposal requests refused (non-leader, transfer in
+  progress, full window — the fused ErrProposalDropped analog), plus
+  conf-change proposals refused by the pending/joint gates.
+- msgs_app / msgs_app_resp / msgs_heartbeat / msgs_heartbeat_resp /
+  msgs_vote / msgs_vote_resp: messages EMITTED into the network fabric
+  this round, by family (MsgSnap counts as msgs_app; the self-ack slot is
+  not network traffic and is excluded; TimeoutNow counts as msgs_vote —
+  it rides the vote channel).
+- read_index_served: ReadStates released into the rs ring (quorum-confirmed
+  or immediately-served ReadIndex requests).
+
+The commit-latency histogram samples ONE in-flight proposal per lane: when
+a lane appends a proposal and has no live sample, it records (index,
+round); when `committed` reaches that index the latency in ROUNDS (= ticks
+under do_tick drives) lands in a power-of-two-ish bucket. One sample per
+lane keeps the sampler at two [N] i32 columns while still giving a faithful
+steady-state distribution across a million lanes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+def _dc(cls):
+    fields = [f.name for f in dataclasses.fields(cls)]
+    return jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+
+
+COUNTERS = (
+    "elections_started",
+    "elections_won",
+    "leader_changes",
+    "commits",
+    "proposals",
+    "proposals_dropped",
+    "msgs_app",
+    "msgs_app_resp",
+    "msgs_heartbeat",
+    "msgs_heartbeat_resp",
+    "msgs_vote",
+    "msgs_vote_resp",
+    "read_index_served",
+)
+COUNTER_INDEX = {name: i for i, name in enumerate(COUNTERS)}
+
+# commit-latency bucket upper bounds in rounds (le semantics); the last
+# bucket is the +Inf overflow. Fabric RTT is 1 round, so quorum commit of a
+# healthy group lands at 2-3 — the low edges resolve the steady state, the
+# tail catches elections/partitions stalling a sample.
+HIST_EDGES = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32)
+N_BUCKETS = len(HIST_EDGES) + 1
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class MetricsState:
+    """The metrics carry. counters/hist/lat_sum/round_ctr are per-BLOCK
+    scalars (already lane-reduced); samp_* are the per-lane latency
+    sampler."""
+
+    counters: Any  # [K] i32, K = len(COUNTERS); wraps, see module doc
+    hist: Any  # [B] i32 commit-latency bucket counts
+    lat_sum: Any  # [] i32 sum of sampled latencies (Prometheus _sum)
+    round_ctr: Any  # [] i32 rounds stepped
+    samp_index: Any  # [N] i32 in-flight sampled entry index (0 = none)
+    samp_round: Any  # [N] i32 round_ctr at sample start
+
+
+def init_metrics(n: int) -> MetricsState:
+    return MetricsState(
+        counters=jnp.zeros((len(COUNTERS),), I32),
+        hist=jnp.zeros((N_BUCKETS,), I32),
+        lat_sum=jnp.zeros((), I32),
+        round_ctr=jnp.zeros((), I32),
+        samp_index=jnp.zeros((n,), I32),
+        samp_round=jnp.zeros((n,), I32),
+    )
+
+
+def metrics_enabled() -> bool:
+    """Read RAFT_TPU_METRICS lazily (default ON) so tests can toggle it
+    per-cluster; the value is baked into each cluster at construction."""
+    return os.environ.get("RAFT_TPU_METRICS", "1") not in ("0", "", "off")
+
+
+class EventBag:
+    """Trace-time accumulator fused_round fills as it walks the round: each
+    add() stores a lane-shaped event count; reduce() collapses everything
+    to ONE [K] delta vector at the end of the round (a single fused
+    reduction pass instead of K scattered ones)."""
+
+    def __init__(self):
+        self._events: dict[str, list] = {}
+
+    def add(self, name: str, mask_or_count):
+        if name not in COUNTER_INDEX:
+            raise KeyError(f"unknown counter {name!r}")
+        self._events.setdefault(name, []).append(mask_or_count)
+
+    def reduce(self) -> jnp.ndarray:
+        parts = []
+        for name in COUNTERS:
+            terms = self._events.get(name)
+            if not terms:
+                parts.append(jnp.zeros((), I32))
+                continue
+            total = jnp.zeros((), I32)
+            for t in terms:
+                total = total + jnp.sum(t.astype(I32))
+            parts.append(total)
+        return jnp.stack(parts)
+
+
+def bucket_index(lat):
+    """Histogram bucket for a latency in rounds: the number of edges the
+    value exceeds (le semantics — bucket b counts lat <= HIST_EDGES[b];
+    the last bucket is +Inf). Static compare chain, no searchsorted HLO."""
+    lat = jnp.asarray(lat)
+    idx = jnp.zeros(lat.shape, I32)
+    for e in HIST_EDGES:
+        idx = idx + (lat > e).astype(I32)
+    return idx
+
+
+def observe_commit_latency(metrics: MetricsState, state) -> MetricsState:
+    """End-of-round sampler update: complete samples whose index committed,
+    then arm a new sample on lanes that appended this round and have none
+    in flight. Runs once per fused_round; ~10 elementwise [N] ops."""
+    # round_ctr here is the PRE-increment value; a propose+commit within
+    # the same round measures as 1.
+    now = metrics.round_ctr + 1
+    live = metrics.samp_index > 0
+    done = live & (state.committed >= metrics.samp_index)
+    lat = jnp.where(done, now - metrics.samp_round, 0)
+    oh = (
+        bucket_index(lat)[:, None] == jnp.arange(N_BUCKETS, dtype=I32)[None, :]
+    ) & done[:, None]
+    metrics = dataclasses.replace(
+        metrics,
+        hist=metrics.hist + jnp.sum(oh.astype(I32), axis=0),
+        lat_sum=metrics.lat_sum + jnp.sum(lat),
+        samp_index=jnp.where(done, 0, metrics.samp_index),
+    )
+    return metrics
+
+
+def arm_sample(metrics: MetricsState, appended, last_index) -> MetricsState:
+    """Start a latency sample on lanes that appended and have none live."""
+    arm = appended & (metrics.samp_index == 0)
+    return dataclasses.replace(
+        metrics,
+        samp_index=jnp.where(arm, last_index, metrics.samp_index),
+        samp_round=jnp.where(arm, metrics.round_ctr + 1, metrics.samp_round),
+    )
+
+
+def commit_round(metrics: MetricsState, bag: EventBag) -> MetricsState:
+    """Fold the round's event bag into the carry and advance the round
+    counter."""
+    return dataclasses.replace(
+        metrics,
+        counters=metrics.counters + bag.reduce(),
+        round_ctr=metrics.round_ctr + 1,
+    )
+
+
+def rebase_samples(metrics: MetricsState, mask, delta) -> MetricsState:
+    """Keep the latency sampler coherent across an index-space rebase
+    (FusedCluster.rebase_groups): shift live sampled indexes with their
+    lanes; a sample that would fall to <= 0 is dropped, not mismeasured."""
+    live = (metrics.samp_index > 0) & mask
+    shifted = metrics.samp_index - delta
+    return dataclasses.replace(
+        metrics,
+        samp_index=jnp.where(live, jnp.maximum(shifted, 0), metrics.samp_index),
+    )

@@ -1,0 +1,215 @@
+"""Host half of the metrics plane: accumulate device counter pulls into
+exact int64 totals, merge sources, and export.
+
+Snapshot schema (every producer in the tree speaks it):
+
+    {
+      "counters": {name: int, ...},            # cumulative totals
+      "hist": {
+        "edges": [int, ...],                    # le bucket upper bounds
+        "buckets": [int, ...],                  # per-bucket counts (+Inf last)
+        "sum": int,                             # sum of observed latencies
+        "count": int,                           # == sum(buckets)
+      },
+      "rounds": int,                            # device rounds stepped
+    }
+
+Exporters: `prometheus_text` renders the standard exposition format
+(counter `_total` families + one cumulative-bucket histogram), and
+`JsonlWriter` appends timestamped snapshots as a JSONL time series — the
+shapes Grafana/offline analysis ingest without an adapter.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from raft_tpu.metrics.device import COUNTERS, HIST_EDGES, N_BUCKETS
+
+
+def empty_snapshot() -> dict:
+    return {
+        "counters": {name: 0 for name in COUNTERS},
+        "hist": {
+            "edges": list(HIST_EDGES),
+            "buckets": [0] * N_BUCKETS,
+            "sum": 0,
+            "count": 0,
+        },
+        "rounds": 0,
+    }
+
+
+class CounterAccumulator:
+    """Exact int64 totals from a stream of wrapping-int32 device pulls.
+
+    The device counters wrap at 2^31; the host computes each pull's delta
+    in uint32 arithmetic — `(cur - prev) mod 2^32` — which is the true
+    event count provided fewer than 2^31 events occurred between pulls.
+    lat_sum/round_ctr ride the same rule."""
+
+    def __init__(self):
+        self._prev_counters = np.zeros(len(COUNTERS), np.int64)
+        self._prev_hist = np.zeros(N_BUCKETS, np.int64)
+        self._prev_lat_sum = 0
+        self._prev_rounds = 0
+        self.counters = np.zeros(len(COUNTERS), np.int64)
+        self.hist = np.zeros(N_BUCKETS, np.int64)
+        self.lat_sum = 0
+        self.rounds = 0
+
+    @staticmethod
+    def _delta(cur, prev):
+        return (
+            np.asarray(cur, np.int64).astype(np.uint32)
+            - np.asarray(prev, np.int64).astype(np.uint32)
+        ).astype(np.uint32).astype(np.int64)
+
+    def pull(self, metrics) -> None:
+        """Fold one device MetricsState into the totals."""
+        cur_c = np.asarray(metrics.counters, np.int64)
+        cur_h = np.asarray(metrics.hist, np.int64)
+        cur_s = int(metrics.lat_sum)
+        cur_r = int(metrics.round_ctr)
+        self.counters += self._delta(cur_c, self._prev_counters)
+        self.hist += self._delta(cur_h, self._prev_hist)
+        self.lat_sum += int(self._delta(cur_s, self._prev_lat_sum))
+        self.rounds += int(self._delta(cur_r, self._prev_rounds))
+        self._prev_counters = cur_c
+        self._prev_hist = cur_h
+        self._prev_lat_sum = cur_s
+        self._prev_rounds = cur_r
+
+    def snapshot(self) -> dict:
+        return {
+            "counters": {
+                name: int(self.counters[i]) for i, name in enumerate(COUNTERS)
+            },
+            "hist": {
+                "edges": list(HIST_EDGES),
+                "buckets": [int(x) for x in self.hist],
+                "sum": int(self.lat_sum),
+                "count": int(self.hist.sum()),
+            },
+            "rounds": int(self.rounds),
+        }
+
+
+class HostCounters:
+    """Plain host-side counter bag speaking the snapshot schema — the
+    RawNodeBatch/bridge analog of the device counters (no histogram)."""
+
+    def __init__(self):
+        self.counts: dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1):
+        self.counts[name] = self.counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        return self.counts.get(name, 0)
+
+    def snapshot(self) -> dict:
+        snap = empty_snapshot()
+        for name, v in self.counts.items():
+            snap["counters"][name] = snap["counters"].get(name, 0) + v
+        return snap
+
+
+def merge_snapshots(snaps) -> dict:
+    """Sum snapshots from several sources (blocks, hosts) into one."""
+    out = empty_snapshot()
+    for s in snaps:
+        if s is None:
+            continue
+        for name, v in s.get("counters", {}).items():
+            out["counters"][name] = out["counters"].get(name, 0) + int(v)
+        h = s.get("hist")
+        if h and h.get("buckets"):
+            if list(h["edges"]) != out["hist"]["edges"]:
+                raise ValueError("cannot merge histograms with different edges")
+            out["hist"]["buckets"] = [
+                a + int(b) for a, b in zip(out["hist"]["buckets"], h["buckets"])
+            ]
+            out["hist"]["sum"] += int(h.get("sum", 0))
+            out["hist"]["count"] += int(h.get("count", 0))
+        out["rounds"] = max(out["rounds"], int(s.get("rounds", 0)))
+    return out
+
+
+class MetricsRegistry:
+    """Named snapshot sources -> one merged snapshot + deltas.
+
+    A source is any zero-arg callable returning a snapshot dict (or None
+    while disabled): `FusedCluster.metrics_snapshot`,
+    `HostCounters.snapshot`, a bridge endpoint's combined view, ...
+    `delta()` returns counters accumulated since the previous delta() call
+    — the scrape-interval view a rate() panel wants."""
+
+    def __init__(self):
+        self._sources: dict[str, object] = {}
+        self._last: dict | None = None
+
+    def register(self, name: str, source) -> None:
+        if name in self._sources:
+            raise ValueError(f"metrics source {name!r} already registered")
+        self._sources[name] = source
+
+    def unregister(self, name: str) -> None:
+        self._sources.pop(name, None)
+
+    def snapshot(self) -> dict:
+        return merge_snapshots(src() for src in self._sources.values())
+
+    def delta(self) -> dict:
+        cur = self.snapshot()
+        prev = self._last or empty_snapshot()
+        self._last = cur
+        out = empty_snapshot()
+        for name, v in cur["counters"].items():
+            out["counters"][name] = int(v) - int(prev["counters"].get(name, 0))
+        out["hist"]["buckets"] = [
+            int(a) - int(b)
+            for a, b in zip(cur["hist"]["buckets"], prev["hist"]["buckets"])
+        ]
+        out["hist"]["sum"] = cur["hist"]["sum"] - prev["hist"]["sum"]
+        out["hist"]["count"] = cur["hist"]["count"] - prev["hist"]["count"]
+        out["rounds"] = cur["rounds"] - prev["rounds"]
+        return out
+
+
+def prometheus_text(snap: dict, prefix: str = "raft_tpu") -> str:
+    """Render a snapshot in the Prometheus text exposition format."""
+    lines = []
+    for name, v in sorted(snap["counters"].items()):
+        fam = f"{prefix}_{name}_total"
+        lines.append(f"# TYPE {fam} counter")
+        lines.append(f"{fam} {int(v)}")
+    h = snap.get("hist")
+    if h is not None:
+        fam = f"{prefix}_commit_latency_rounds"
+        lines.append(f"# TYPE {fam} histogram")
+        cum = 0
+        for edge, count in zip(h["edges"], h["buckets"]):
+            cum += int(count)
+            lines.append(f'{fam}_bucket{{le="{edge}"}} {cum}')
+        cum += int(h["buckets"][-1])
+        lines.append(f'{fam}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{fam}_sum {int(h['sum'])}")
+        lines.append(f"{fam}_count {int(h['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+class JsonlWriter:
+    """Append snapshots to a JSONL file, one timestamped record per write —
+    the bench/driver time-series sink (RAFT_TPU_METRICS_JSONL)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, snap: dict, **extra) -> None:
+        rec = {"ts": round(time.time(), 3), **extra, **snap}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")

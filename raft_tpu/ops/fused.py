@@ -62,6 +62,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.metrics import device as metmod
 from raft_tpu.ops import log as lg
 from raft_tpu.ops import onehot as ohm
 from raft_tpu.ops import progress as pg
@@ -370,7 +371,13 @@ _AUTO_SHIFT_MIN_LANES = 256
 # lets XLA fuse across adjacent rounds' slim<->fat casts and drop per-
 # iteration while-loop overhead, at the cost of a proportionally bigger
 # program (compile time) — A/B'd on chip, see BASELINE.md round 5.
-_SCAN_UNROLL = int(os.environ.get("RAFT_TPU_UNROLL", "1"))
+try:
+    _SCAN_UNROLL = max(1, int(os.environ.get("RAFT_TPU_UNROLL", "1")))
+except ValueError:
+    raise ValueError(
+        "RAFT_TPU_UNROLL must be an integer >= 1, got "
+        f"{os.environ.get('RAFT_TPU_UNROLL')!r}"
+    ) from None
 
 
 def aligned_peer_mute(mute, v: int):
@@ -621,9 +628,13 @@ def fused_round(
     do_tick: bool = True,
     auto_propose: bool = False,
     auto_compact_lag: int | None = None,
-) -> tuple[RaftState, Fabric]:
+    metrics: "metmod.MetricsState | None" = None,
+):
     """One complete synchronous round for every lane. Returns the next state
-    and the outbox fabric (route with route_fabric before the next round).
+    and the outbox fabric (route with route_fabric before the next round);
+    with `metrics` set, returns (state, fabric, metrics) instead — every
+    instrumentation site below is behind `if metrics is not None`, so the
+    metrics-off jaxpr is byte-for-byte free of metrics ops.
 
     peer_mute: optional [N, V] mute bits of each lane's group members;
     defaults to the aligned reshape of `mute` — REQUIRED on straddling
@@ -631,6 +642,11 @@ def fused_round(
     n, v = state.prs_id.shape
     e = inb.rep.ent_term.shape[-1]
     out = ChannelOutbox(state, e)
+    bag = None
+    if metrics is not None:
+        bag = metmod.EventBag()
+        lead0 = state.lead
+        committed0 = state.committed
     lanes_v = jnp.arange(v, dtype=I32)[None, :]
     ss = stepmod.self_slot(state)
     is_self = lanes_v == ss[:, None]
@@ -1061,6 +1077,8 @@ def fused_round(
         ro_seq=_w(put, 0, state.ro_seq),
         ro_acks=jnp.where(put[:, :, None], False, acks),
     )
+    if metrics is not None:
+        bag.add("read_index_served", put)
 
     # Msg(Pre)VoteResp cells -> poll (raft.go:1041-1049, 1647-1666)
     my_resp = jnp.where(
@@ -1105,7 +1123,12 @@ def fused_round(
     ctype = jnp.where(ton, jnp.int32(CampaignType.TRANSFER), ctype)
     ctype = jnp.where(pre_won, jnp.int32(CampaignType.ELECTION), ctype)
     # hup() itself guards against leaders/learners/pending conf changes
-    state = stepmod.hup(state, fire_hup | ops.hup | ton | pre_won, ctype, out)
+    state, hup_fired = stepmod.hup(
+        state, fire_hup | ops.hup | ton | pre_won, ctype, out
+    )
+    if metrics is not None:
+        bag.add("elections_started", hup_fired)
+        bag.add("elections_won", real_won)
 
     # CheckQuorum (raft.go:1231-1243)
     is_leader = state.state == StateType.LEADER
@@ -1150,6 +1173,12 @@ def fused_round(
         state, prop, zeros_e, zeros_e, ent_bytes, pn, out
     )
     want_send(appended[:, None] & all_peers)
+    if metrics is not None:
+        bag.add("proposals", jnp.where(appended, pn, 0))
+        # fused ErrProposalDropped: a lane asked to propose but nothing
+        # landed (not leader, transfer in progress, or window full)
+        bag.add("proposals_dropped", (prop_n > 0) & ~appended)
+        metrics = metmod.arm_sample(metrics, appended, state.last)
 
     # conf-change proposal (raft.go:1259-1301): one ENTRY_CONF_CHANGE_V2
     # entry whose content the host holds. Gating per the reference: refuse
@@ -1184,6 +1213,11 @@ def fused_round(
         ),
     )
     want_send(cc_appended[:, None] & all_peers)
+    if metrics is not None:
+        bag.add("proposals", cc_appended)
+        # a refused change appends an empty entry in its place — the CC
+        # content itself was still dropped (raft.go:1284-1296)
+        bag.add("proposals_dropped", want_cc & (refused | ~cc_appended))
 
     # transfer-leadership request (raft.go:1587-1618), injected at the
     # leader. Refused for untracked or learner transferees (raft.go:
@@ -1245,6 +1279,8 @@ def fused_round(
     # immediate release -> rs ring
     imm_slot = jnp.clip(state.rs_count, 0, r_ax - 1)
     imm_put = ohm.onehot(imm_slot, r_ax) & (immediate & (state.rs_count < r_ax))[:, None]
+    if metrics is not None:
+        bag.add("read_index_served", immediate & (state.rs_count < r_ax))
     state = dataclasses.replace(
         state,
         rs_ctx=_w(imm_put, ops.read_ctx[:, None], state.rs_ctx),
@@ -1294,7 +1330,24 @@ def fused_round(
             state.snap_index, state.applied - jnp.int32(auto_compact_lag)
         )
         state = lg.compact(state, target, lg.term_at(state, target))
-    return state, out.fab
+    if metrics is None:
+        return state, out.fab
+    # ---- end-of-round measurement (one fused reduction pass) ----
+    # network messages emitted this round, by family (the self-ack slot is
+    # local bookkeeping, not network traffic — excluded)
+    rk, hk = out.fab.rep.kind, out.fab.hb.kind
+    bag.add("msgs_app", (rk == MT.MSG_APP) | (rk == MT.MSG_SNAP))
+    bag.add("msgs_app_resp", rk == MT.MSG_APP_RESP)
+    bag.add("msgs_heartbeat", hk == MT.MSG_HEARTBEAT)
+    bag.add("msgs_heartbeat_resp", hk == MT.MSG_HEARTBEAT_RESP)
+    bag.add("msgs_vote", out.fab.vote.kind != MT.MSG_NONE)
+    bag.add("msgs_vote_resp", out.fab.vresp.kind != MT.MSG_NONE)
+    # observed-leader churn and commit progress vs the start of the round
+    bag.add("leader_changes", (state.lead != lead0) & (state.lead != 0))
+    bag.add("commits", state.committed - committed0)
+    metrics = metmod.observe_commit_latency(metrics, state)
+    metrics = metmod.commit_round(metrics, bag)
+    return state, out.fab, metrics
 
 
 def _fcbt_nv(state: RaftState, index_nv, term_nv):
@@ -1389,6 +1442,7 @@ def fused_rounds(
     auto_compact_lag: int | None = None,
     ops_first_round_only: bool = True,
     straddle: StraddleSpec | None = None,
+    metrics: "metmod.MetricsState | None" = None,
 ):
     """n_rounds fused rounds in one dispatch. `ops` applies to the first
     round only (one-shot injections) unless ops_first_round_only=False.
@@ -1400,7 +1454,11 @@ def fused_rounds(
 
     straddle: when set (inside shard_map over spec.axis_name), delivery
     rides the cross-shard halo router (route_fabric_straddle) so a group's
-    voters may span a shard boundary."""
+    voters may span a shard boundary.
+
+    metrics: optional metrics carry (raft_tpu/metrics/); when set the
+    return is (state, fab, metrics) and the carry threads through the scan
+    (already-scalar counters — no per-lane state leaves the device)."""
     from raft_tpu.state import fat_state, slim_state
 
     state = slim_state(state)
@@ -1416,7 +1474,7 @@ def fused_rounds(
             peer_mute = aligned_peer_mute(mute, v)
 
     def body(carry, i):
-        st, f = carry
+        st, f, met = carry
         o = ops
         if ops_first_round_only:
             first = i == 0
@@ -1432,7 +1490,7 @@ def fused_rounds(
             inb = route_fabric_straddle(
                 fat_fabric(f), v, mute, straddle, peer_mute
             )
-        st, f = fused_round(
+        res = fused_round(
             fat_state(st),
             inb,
             o,
@@ -1441,16 +1499,23 @@ def fused_rounds(
             do_tick=do_tick,
             auto_propose=auto_propose,
             auto_compact_lag=auto_compact_lag,
+            metrics=met,
         )
-        return (slim_state(st), slim_fabric(f)), None
+        st, f = res[0], res[1]
+        met = res[2] if met is not None else None
+        return (slim_state(st), slim_fabric(f), met), None
 
-    (state, fab), _ = jax.lax.scan(
+    # a None metrics slot is an empty pytree: the scan carry shape is
+    # unchanged when the plane is off
+    (state, fab, metrics), _ = jax.lax.scan(
         body,
-        (state, fab),
+        (state, fab, metrics),
         jnp.arange(n_rounds, dtype=I32),
         unroll=min(_SCAN_UNROLL, n_rounds),
     )
-    return state, fab
+    if metrics is None:
+        return state, fab
+    return state, fab, metrics
 
 
 _fused_rounds_jit = jax.jit(
@@ -1512,6 +1577,14 @@ class FusedCluster:
         )
         self.fab = slim_fabric(empty_fabric(n, n_voters, self.shape.max_msg_entries))
         self.mute = jnp.zeros((n,), BOOL)
+        # metrics plane (raft_tpu/metrics/): RAFT_TPU_METRICS is read at
+        # construction; metrics=None keeps every metrics op out of the jaxpr
+        self.metrics = metmod.init_metrics(n) if metmod.metrics_enabled() else None
+        self._metrics_acc = None
+        if self.metrics is not None:
+            from raft_tpu.metrics.host import CounterAccumulator
+
+            self._metrics_acc = CounterAccumulator()
 
     # -- driving ----------------------------------------------------------
 
@@ -1531,7 +1604,7 @@ class FusedCluster:
         on the fused engine; reference doc.go:172-258)."""
         if ops is None:
             ops = no_ops(self.state.id.shape[0])
-        self.state, self.fab = _fused_rounds_jit(
+        res = _fused_rounds_jit(
             self.state,
             self.fab,
             ops,
@@ -1542,7 +1615,11 @@ class FusedCluster:
             auto_propose=auto_propose,
             auto_compact_lag=auto_compact_lag,
             ops_first_round_only=ops_first_round_only,
+            metrics=self.metrics,
         )
+        self.state, self.fab = res[0], res[1]
+        if self.metrics is not None:
+            self.metrics = res[2]
         if wal is not None:
             wal.push(self.state)
 
@@ -1603,6 +1680,12 @@ class FusedCluster:
             _rebase_indexes_jit(self.state, jnp.asarray(mask), dj)
         )
         self.fab = slim_fabric(rebase_fabric(fat_fabric(self.fab), dj))
+        if self.metrics is not None:
+            # in-flight latency samples hold absolute indexes — shift them
+            # with their lanes (or drop, never mismeasure)
+            self.metrics = metmod.rebase_samples(
+                self.metrics, jnp.asarray(mask), dj
+            )
         return out
 
     @classmethod
@@ -1653,6 +1736,15 @@ class FusedCluster:
         return c
 
     # -- inspection -------------------------------------------------------
+
+    def metrics_snapshot(self) -> dict | None:
+        """Pull the device counters and fold them into the host's exact
+        int64 totals (wraparound-aware; see metrics/host.py). Returns the
+        standard snapshot dict, or None when RAFT_TPU_METRICS=0."""
+        if self.metrics is None:
+            return None
+        self._metrics_acc.pull(self.metrics)
+        return self._metrics_acc.snapshot()
 
     def leader_lanes(self):
         import numpy as np

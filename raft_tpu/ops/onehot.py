@@ -108,21 +108,16 @@ def gather_range_multi(cols, start, e: int):
     rolled = [oh0 if k == 0 else jnp.roll(oh0, k, axis=-1) for k in range(e)]
     outs = []
     for col in cols:
-        if col.dtype == jnp.bool_:
-            outs.append(
-                gather_range_multi([col.astype(I32)], start, e)[0].astype(
-                    jnp.bool_
-                )
-            )
-            continue
+        as_bool = col.dtype == jnp.bool_
+        if as_bool:
+            col = col.astype(I32)
         extra = oh0.ndim - col.ndim
         c = col.reshape(col.shape[:-1] + (1,) * extra + (w,))
-        outs.append(
-            jnp.stack(
-                [jnp.sum(jnp.where(r, c, 0), axis=-1) for r in rolled],
-                axis=-1,
-            )
+        out = jnp.stack(
+            [jnp.sum(jnp.where(r, c, 0), axis=-1) for r in rolled],
+            axis=-1,
         )
+        outs.append(out.astype(jnp.bool_) if as_bool else out)
     return outs
 
 

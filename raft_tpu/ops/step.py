@@ -516,15 +516,18 @@ def campaign(state: RaftState, mask, ctype, out: Outbox) -> RaftState:
     return state
 
 
-def hup(state: RaftState, mask, ctype, out: Outbox) -> RaftState:
-    """reference: raft.go:941-961."""
+def hup(state: RaftState, mask, ctype, out: Outbox):
+    """reference: raft.go:941-961. Returns (state, fired): `fired` is the
+    [N] mask of lanes that actually campaigned after the promotable /
+    pending-conf-change gates — the exact elections_started event the
+    metrics plane counts (raft_tpu/metrics/)."""
     ok = (
         mask
         & (state.state != StateType.LEADER)
         & promotable(state)
         & ~has_unapplied_conf_changes(state)
     )
-    return campaign(state, ok, ctype, out)
+    return campaign(state, ok, ctype, out), ok
 
 
 # --------------------------------------------------------------------------
@@ -734,7 +737,7 @@ def step(state: RaftState, msg: MsgBatch, max_entries: int | None = None) -> Ste
     # MsgTimeoutNow on a follower: transfer campaign, never pre-vote
     # (reference: raft.go:1713-1719)
     ton = active & (mtype == MT.MSG_TIMEOUT_NOW) & (state.state == StateType.FOLLOWER)
-    state = hup(
+    state, _ = hup(
         state,
         hup_m | ton,
         jnp.where(ton, jnp.int32(CampaignType.TRANSFER), ctype),

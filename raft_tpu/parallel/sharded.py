@@ -270,9 +270,12 @@ class ShardedCluster(Cluster):
                     return (st, nxt, drops + d), None
 
                 # shard-local (axis-varying) accumulator for dropped counts
-                zero = jax.lax.pcast(
-                    jnp.zeros((), I32), ("groups",), to="varying"
-                )
+                if hasattr(jax.lax, "pcast"):
+                    zero = jax.lax.pcast(
+                        jnp.zeros((), I32), ("groups",), to="varying"
+                    )
+                else:  # jax < 0.8: experimental shard_map needs no vma cast
+                    zero = jnp.zeros((), I32)
                 (state, inbox, dropped), _ = jax.lax.scan(
                     body, (state, inbox, zero), length=n_rounds,
                 )
@@ -360,6 +363,11 @@ class ShardedFusedCluster:
         self.inner.state = jax.tree.map(shard_lanes, self.inner.state)
         self.inner.fab = jax.tree.map(shard_lanes, self.inner.fab)
         self.inner.mute = jax.device_put(self.inner.mute, self.lane_sharding)
+        if self.inner.metrics is not None:
+            # the latency sampler's [N] columns shard with their lanes; the
+            # lane-reduced counters/hist/scalars replicate (shard_lanes
+            # routes by leading dim)
+            self.inner.metrics = jax.tree.map(shard_lanes, self.inner.metrics)
         self._no_ops = jax.tree.map(shard_lanes, no_ops(n))
         self._shard_lanes = shard_lanes
         self._cache = {}
@@ -375,32 +383,93 @@ class ShardedFusedCluster:
                 lambda x: self._shard_lanes(jnp.asarray(x)), ops
             )
         )
+        met = self.inner.metrics
         key = (rounds, do_tick, auto_propose, auto_compact_lag)
         if key not in self._cache:
-            fn = shard_map(
-                lambda st, f, o, m: fused_rounds(
-                    st, f, o, m,
-                    v=self.v, n_rounds=rounds, do_tick=do_tick,
-                    auto_propose=auto_propose,
-                    auto_compact_lag=auto_compact_lag,
-                    straddle=self._spec,
-                ),
-                mesh=self.mesh,
-                in_specs=(
-                    lane_specs(self.inner.state),
-                    lane_specs(self.inner.fab),
-                    lane_specs(self._no_ops),
-                    P("groups"),
-                ),
-                out_specs=(
-                    lane_specs(self.inner.state),
-                    lane_specs(self.inner.fab),
-                ),
-            )
+            if met is None:
+                fn = shard_map(
+                    lambda st, f, o, m: fused_rounds(
+                        st, f, o, m,
+                        v=self.v, n_rounds=rounds, do_tick=do_tick,
+                        auto_propose=auto_propose,
+                        auto_compact_lag=auto_compact_lag,
+                        straddle=self._spec,
+                    ),
+                    mesh=self.mesh,
+                    in_specs=(
+                        lane_specs(self.inner.state),
+                        lane_specs(self.inner.fab),
+                        lane_specs(self._no_ops),
+                        P("groups"),
+                    ),
+                    out_specs=(
+                        lane_specs(self.inner.state),
+                        lane_specs(self.inner.fab),
+                    ),
+                )
+            else:
+                from raft_tpu.metrics.device import MetricsState
+
+                def stepper(st, f, o, m, mt):
+                    st, f, mt2 = fused_rounds(
+                        st, f, o, m,
+                        v=self.v, n_rounds=rounds, do_tick=do_tick,
+                        auto_propose=auto_propose,
+                        auto_compact_lag=auto_compact_lag,
+                        straddle=self._spec, metrics=mt,
+                    )
+                    # each shard accumulated ONLY its own lanes' events on
+                    # top of the replicated running totals; one psum of the
+                    # scalar deltas per dispatch (not per round) rebuilds
+                    # the replicated global totals — the EQuARX-style
+                    # aggregate-before-export rule (PAPERS.md)
+                    mt2 = dataclasses.replace(
+                        mt2,
+                        counters=mt.counters
+                        + jax.lax.psum(mt2.counters - mt.counters, "groups"),
+                        hist=mt.hist
+                        + jax.lax.psum(mt2.hist - mt.hist, "groups"),
+                        lat_sum=mt.lat_sum
+                        + jax.lax.psum(mt2.lat_sum - mt.lat_sum, "groups"),
+                        # every shard steps the same round count: recompute
+                        # from the replicated input
+                        round_ctr=mt.round_ctr + jnp.int32(rounds),
+                    )
+                    return st, f, mt2
+
+                met_specs = MetricsState(
+                    counters=P(), hist=P(), lat_sum=P(), round_ctr=P(),
+                    samp_index=P("groups"), samp_round=P("groups"),
+                )
+                fn = shard_map(
+                    stepper,
+                    mesh=self.mesh,
+                    in_specs=(
+                        lane_specs(self.inner.state),
+                        lane_specs(self.inner.fab),
+                        lane_specs(self._no_ops),
+                        P("groups"),
+                        met_specs,
+                    ),
+                    out_specs=(
+                        lane_specs(self.inner.state),
+                        lane_specs(self.inner.fab),
+                        met_specs,
+                    ),
+                    check_rep=False,
+                )
             self._cache[key] = jax.jit(fn)
-        self.inner.state, self.inner.fab = self._cache[key](
-            self.inner.state, self.inner.fab, ops, self.inner.mute
-        )
+        if met is None:
+            self.inner.state, self.inner.fab = self._cache[key](
+                self.inner.state, self.inner.fab, ops, self.inner.mute
+            )
+        else:
+            self.inner.state, self.inner.fab, self.inner.metrics = (
+                self._cache[key](
+                    self.inner.state, self.inner.fab, ops,
+                    self.inner.mute, met,
+                )
+            )
 
     def __getattr__(self, name):
         return getattr(self.inner, name)

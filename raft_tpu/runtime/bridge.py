@@ -138,6 +138,16 @@ class HostBridge:
             for lane in range(b.shape.n):
                 b.tick(lane)
 
+    def metrics_snapshot(self) -> dict:
+        """One merged snapshot over every host's Ready-surface counters,
+        plus the bridge's own transport counters (raft_tpu/metrics/)."""
+        from raft_tpu.metrics.host import merge_snapshots
+
+        snap = merge_snapshots(b.metrics.snapshot() for b in self._hosts)
+        snap["counters"]["bridge_delivered"] = self.delivered
+        snap["counters"]["bridge_dropped"] = self.dropped
+        return snap
+
 
 class FusedBridgeEndpoint:
     """One process's side of the cross-host protocol on the FUSED engine:
@@ -522,6 +532,17 @@ class FusedBridgeEndpoint:
 
         return [int(l) for l in np.nonzero(~self.ghost)[0]]
 
+    def metrics_snapshot(self) -> dict | None:
+        """The resident FusedCluster's device-plane snapshot plus this
+        endpoint's transport counters; None while RAFT_TPU_METRICS=0."""
+        snap = self.fc.metrics_snapshot()
+        if snap is None:
+            return None
+        snap["counters"]["bridge_delivered"] = self.delivered
+        snap["counters"]["bridge_dropped"] = self.dropped
+        snap["counters"]["bridge_overwritten"] = self.overwritten
+        return snap
+
 
 class BridgeEndpoint:
     """One PROCESS's side of the cross-host protocol: a RawNodeBatch hosting
@@ -595,3 +616,11 @@ class BridgeEndpoint:
     def tick_all(self):
         for lane in self.local.values():
             self.batch.tick(lane)
+
+    def metrics_snapshot(self) -> dict:
+        """The local batch's Ready-surface counters plus this endpoint's
+        transport counters (raft_tpu/metrics/)."""
+        snap = self.batch.metrics.snapshot()
+        snap["counters"]["bridge_delivered"] = self.delivered
+        snap["counters"]["bridge_dropped"] = self.dropped
+        return snap

@@ -96,6 +96,20 @@ class BlockedFusedCluster:
 
     # -- inspection (aggregate) -------------------------------------------
 
+    @property
+    def metrics_enabled(self) -> bool:
+        return self.blocks[0].metrics is not None
+
+    def metrics_snapshot(self) -> dict | None:
+        """One merged snapshot over all K resident blocks: each block's
+        device counters are already lane-reduced (K tiny pulls, not K*N),
+        the host just sums them (raft_tpu/metrics/)."""
+        if not self.metrics_enabled:
+            return None
+        from raft_tpu.metrics.host import merge_snapshots
+
+        return merge_snapshots(b.metrics_snapshot() for b in self.blocks)
+
     def total_committed(self) -> int:
         return int(sum(int(jnp.sum(b.state.committed)) for b in self.blocks))
 

@@ -25,7 +25,10 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
   # trigger and its autouse fixture disables the persistent compile cache.
   run -n 6 --dist loadfile --max-worker-restart 0 \
     $(ls tests/test_*.py | grep -v test_sharded) \
-    && run tests/test_sharded.py
+    && run tests/test_sharded.py \
+    && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+      python benches/metrics_smoke.py
 else
   run "$@"
 fi
