@@ -25,9 +25,11 @@ import time
 
 import jax
 
-from raft_tpu.utils.compile_cache import enable_persistent_cache
+from raft_tpu.utils.compile_cache import cache_dir_from_env, enable_persistent_cache
 
-if jax.default_backend() != "cpu":
+# RAFT_TPU_COMPILE_CACHE=<dir> opts any backend (CPU included) into the
+# persistent compilation cache; non-CPU backends keep it on by default
+if cache_dir_from_env() or jax.default_backend() != "cpu":
     enable_persistent_cache()
 import jax.numpy as jnp
 
@@ -50,8 +52,15 @@ def run_fused(n_groups, n_voters, n_iters, block, block_groups=None):
         max_inflight=min(8, e),
         max_read_index=2,
     )
+    # round-major dispatch knobs (scheduler.BlockedFusedCluster): chunk > 1
+    # amortizes per-dispatch host overhead between interleave points;
+    # BENCH_PIPELINE_DEPTH bounds enqueued-but-unfinished dispatches
+    round_chunk = int(os.environ.get("BENCH_ROUND_CHUNK", 8))
+    pd = os.environ.get("BENCH_PIPELINE_DEPTH")
+    pipeline_depth = int(pd) if pd else None
     c = BlockedFusedCluster(
-        n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape
+        n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape,
+        round_chunk=round_chunk, pipeline_depth=pipeline_depth,
     )
     lag = min(8, w // 2)  # must leave window headroom or appends stall
 
@@ -81,9 +90,30 @@ def run_fused(n_groups, n_voters, n_iters, block, block_groups=None):
     commits = c.total_committed() - com0
     c.check_no_errors()
     assert commits > 0, "benchmark workload stalled: no entries committed"
-    # device-plane observability pull AFTER the timed region (one tiny
-    # transfer per block; None when RAFT_TPU_METRICS=0)
-    return dt, compile_s, c.leader_count(), commits, c.metrics_snapshot()
+    # HBM-peak/live-buffer probe (outside the timed region): hold the
+    # pre-dispatch carry references across one more round — with donation
+    # on those buffers die in place, so live bytes read strictly lower
+    # than the same dispatch under RAFT_TPU_DONATE=0
+    from raft_tpu.ops.fused import donation_enabled
+    from raft_tpu.utils.profiling import device_memory_stats, live_buffer_bytes
+
+    keep = [(b.state, b.fab, b.metrics) for b in c.blocks]
+    c.run(1, auto_propose=True, auto_compact_lag=lag)
+    c.block_until_ready()
+    probe = {
+        "donate": donation_enabled(),
+        "round_chunk": round_chunk,
+        "pipeline_depth": pipeline_depth,
+        "live_buffer_bytes": live_buffer_bytes(),
+    }
+    del keep
+    mem = device_memory_stats()
+    if mem is not None:
+        probe["peak_bytes_in_use"] = mem.get("peak_bytes_in_use")
+        probe["bytes_in_use"] = mem.get("bytes_in_use")
+    # device-plane observability pull AFTER the timed region (ONE batched
+    # transfer for all K blocks; None when RAFT_TPU_METRICS=0)
+    return dt, compile_s, c.leader_count(), commits, c.metrics_snapshot(), probe
 
 
 def run_serial(n_groups, n_voters, n_iters, block):
@@ -116,7 +146,7 @@ def run_serial(n_groups, n_voters, n_iters, block):
     dt = time.perf_counter() - t0
     commits = int(jnp.sum(state.committed)) - com0
     n_leaders = int(jnp.sum(state.state == 2))
-    return dt, compile_s, n_leaders, commits, None
+    return dt, compile_s, n_leaders, commits, None, None
 
 
 def main():
@@ -144,7 +174,7 @@ def main():
     with trace(env_trace_dir()):
         if engine == "fused":
             try:
-                dt, compile_s, n_leaders, commits, met = run_fused(
+                dt, compile_s, n_leaders, commits, met, probe = run_fused(
                     n_groups, n_voters, n_iters, block, block_groups
                 )
             except Exception as e:  # noqa: BLE001 — still print a record
@@ -159,11 +189,11 @@ def main():
                     file=sys.stderr,
                 )
                 fallback, n_groups = True, block_groups
-                dt, compile_s, n_leaders, commits, met = run_fused(
+                dt, compile_s, n_leaders, commits, met, probe = run_fused(
                     n_groups, n_voters, n_iters, block, block_groups
                 )
         else:
-            dt, compile_s, n_leaders, commits, met = run_serial(
+            dt, compile_s, n_leaders, commits, met, probe = run_serial(
                 n_groups, n_voters, n_iters, block
             )
 
@@ -185,6 +215,8 @@ def main():
         "compile_s": round(compile_s, 1),
         "platform": platform,
     }
+    if probe is not None:
+        extra.update(probe)
     if met is not None:
         # the device metrics plane's cumulative totals (raft_tpu/metrics/)
         extra["metrics"] = {k: v for k, v in met["counters"].items() if v}

@@ -1,0 +1,128 @@
+"""Donation A/B dispatch smoke: fails if donation-on regresses throughput
+or fails to lower live-buffer bytes vs donation-off.
+
+Runs the same BlockedFusedCluster workload twice in fresh subprocesses —
+RAFT_TPU_DONATE=0 then =1 — and asserts, per the PR 2 acceptance bar:
+
+  1. donation-on live_buffer_bytes is STRICTLY lower (the donated carry
+     dies in place; the copying path keeps two carries alive), and
+  2. donation-on groups_ticks_per_sec >= AB_TOL * donation-off
+     (AB_TOL default 0.7 — the CPU rig is a 1-core container with noisy
+     wall clocks; on TPU tighten it via env).
+
+Exit code 0 = pass, 1 = regression. Prints one JSON summary line.
+Env: AB_GROUPS, AB_ROUNDS, AB_ITERS, AB_ROUND_CHUNK, AB_TOL.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def child():
+    import time
+
+    from raft_tpu.config import Shape
+    from raft_tpu.ops.fused import donation_enabled
+    from raft_tpu.scheduler import BlockedFusedCluster
+    from raft_tpu.utils.profiling import live_buffer_bytes
+
+    groups = int(os.environ.get("AB_GROUPS", 64))
+    bg = max(1, groups // 2)  # K=2 resident blocks: the round-major shape
+    voters = 3
+    w, e = 16, 2
+    shape = Shape(
+        n_lanes=bg * voters,
+        max_peers=voters,
+        log_window=w,
+        max_msg_entries=e,
+        max_inflight=2,
+        max_read_index=2,
+    )
+    c = BlockedFusedCluster(
+        groups, voters, block_groups=bg, seed=42, shape=shape,
+        round_chunk=int(os.environ.get("AB_ROUND_CHUNK", 1)),
+    )
+    lag = min(8, w // 2)
+    rounds = int(os.environ.get("AB_ROUNDS", 16))
+    iters = int(os.environ.get("AB_ITERS", 8))
+
+    c.run(rounds, auto_propose=True, auto_compact_lag=lag)
+    c.block_until_ready()  # compile
+    warm = 0
+    while c.leader_count() < groups:
+        c.run(rounds, auto_propose=True, auto_compact_lag=lag)
+        warm += rounds
+        if warm > 40 * 16:
+            raise RuntimeError("A/B warm-up stalled before full election")
+    c.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        c.run(rounds, auto_propose=True, auto_compact_lag=lag)
+    c.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    # live-buffer probe: hold the pre-dispatch carries across one round
+    keep = [(b.state, b.fab, b.metrics) for b in c.blocks]
+    c.run(1, auto_propose=True, auto_compact_lag=lag)
+    c.block_until_ready()
+    live = live_buffer_bytes()
+    del keep
+    c.check_no_errors()
+    print(json.dumps({
+        "donate": donation_enabled(),
+        "groups_ticks_per_sec": groups * rounds * iters / dt,
+        "live_buffer_bytes": live,
+    }))
+
+
+def run_child(donate: str) -> dict:
+    env = dict(os.environ, RAFT_TPU_DONATE=donate)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+def main():
+    tol = float(os.environ.get("AB_TOL", 0.7))
+    off = run_child("0")
+    on = run_child("1")
+    ratio = on["groups_ticks_per_sec"] / off["groups_ticks_per_sec"]
+    mem_ok = on["live_buffer_bytes"] < off["live_buffer_bytes"]
+    perf_ok = ratio >= tol
+    print(json.dumps({
+        "metric": "donation_ab",
+        "ok": mem_ok and perf_ok,
+        "gtps_on": round(on["groups_ticks_per_sec"], 1),
+        "gtps_off": round(off["groups_ticks_per_sec"], 1),
+        "gtps_ratio_on_over_off": round(ratio, 3),
+        "live_on": on["live_buffer_bytes"],
+        "live_off": off["live_buffer_bytes"],
+        "tol": tol,
+    }))
+    if not mem_ok:
+        print(
+            f"FAIL: donation-on live buffers ({on['live_buffer_bytes']}) not "
+            f"strictly below donation-off ({off['live_buffer_bytes']})",
+            file=sys.stderr,
+        )
+    if not perf_ok:
+        print(
+            f"FAIL: donation-on throughput regressed: ratio {ratio:.3f} < "
+            f"tol {tol}", file=sys.stderr,
+        )
+    sys.exit(0 if (mem_ok and perf_ok) else 1)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -116,8 +116,10 @@ def main():
     print(f"steady: leaders={len(c.leader_lanes())}/{groups}")
 
     ops = fused.no_ops(shape.n)
+    # the copying (nodonate) twin throughout: this probe re-reads c.state /
+    # c.fab after dispatching them, which the donating jit would delete
     # reference: one more XLA block
-    ref_s, ref_f = fused._fused_rounds_jit(
+    ref_s, ref_f = fused._fused_rounds_nodonate_jit(
         c.state, c.fab, ops, None, v=v, n_rounds=block, do_tick=True,
         auto_propose=True, auto_compact_lag=lag, ops_first_round_only=False, straddle=None)
     jax.block_until_ready(ref_s.term)
@@ -156,7 +158,7 @@ def main():
     def run_xla(k):
         s, f = c.state, c.fab
         for _ in range(k):
-            s, f = fused._fused_rounds_jit(
+            s, f = fused._fused_rounds_nodonate_jit(
                 s, f, ops, None, v=v, n_rounds=block, do_tick=True,
                 auto_propose=True, auto_compact_lag=lag,
                 ops_first_round_only=False, straddle=None)

@@ -18,9 +18,9 @@ import time
 
 import jax
 
-from raft_tpu.utils.compile_cache import enable_persistent_cache
+from raft_tpu.utils.compile_cache import cache_dir_from_env, enable_persistent_cache
 
-if jax.default_backend() != "cpu":
+if cache_dir_from_env() or jax.default_backend() != "cpu":
     enable_persistent_cache()
 
 
@@ -72,6 +72,18 @@ def main():
     times = [per_round]
     c.check_no_errors()
     leaders = len(c.leader_lanes())
+
+    # live-buffer/HBM probe (outside the timed region): hold the old carry
+    # across one dispatch — strictly lower with donation on
+    from raft_tpu.ops.fused import donation_enabled
+    from raft_tpu.utils.profiling import device_memory_stats, live_buffer_bytes
+
+    keep = (c.state, c.fab, c.metrics)
+    c.run(1, auto_propose=True, auto_compact_lag=lag)
+    sync()
+    live = live_buffer_bytes()
+    del keep
+    mem = device_memory_stats()
     print(json.dumps({
         "metric": "fused_round_ms",
         "per_round_ms": round(per_round, 3),
@@ -82,6 +94,9 @@ def main():
         "leaders": leaders,
         "unroll": os.environ.get("RAFT_TPU_UNROLL", "1"),
         "route": os.environ.get("RAFT_TPU_ROUTE", "auto"),
+        "donate": donation_enabled(),
+        "live_buffer_bytes": live,
+        "peak_bytes_in_use": None if mem is None else mem.get("peak_bytes_in_use"),
         "platform": jax.default_backend(),
     }))
 

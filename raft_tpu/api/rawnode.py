@@ -1549,7 +1549,13 @@ class RawNodeBatch:
         for lane in lanes:
             mask = mask.at[lane].set(True)
             dl = dl.at[lane].set(delta)
-        self.state = jax.jit(lg.rebase_indexes)(self.state, mask, dl)
+        # the shared module-level jit (ops/fused.py): a fresh jax.jit
+        # wrapper here would retrace/recompile on every rebase call. The
+        # copying variant on purpose — _StateView may hold zero-copy host
+        # views of the input state.
+        from raft_tpu.ops.fused import _rebase_indexes_jit
+
+        self.state = _rebase_indexes_jit(self.state, mask, dl)
         self.view.refresh(self.state)
         for lane in lanes:
             # payload store re-key: clear, re-put shifted

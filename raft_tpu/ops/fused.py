@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+from contextlib import contextmanager
 from types import SimpleNamespace
 from typing import Any, NamedTuple
 
@@ -167,15 +168,26 @@ class Fabric:
 
 
 def empty_fabric(n: int, v: int, e: int) -> Fabric:
-    z = jnp.zeros((n, v), I32)
-    zb = jnp.zeros((n, v), BOOL)
-    ze = jnp.zeros((n, v, e), I32)
-    none = jnp.full((n, v), MT.MSG_NONE, I32)
+    # Each field allocates its OWN buffer (no shared z/zb/ze Arrays): the
+    # fabric is part of the donated carry (donation_enabled below) and XLA
+    # rejects the same buffer donated twice within one dispatch.
+    def z():
+        return jnp.zeros((n, v), I32)
+
+    def zb():
+        return jnp.zeros((n, v), BOOL)
+
+    def ze():
+        return jnp.zeros((n, v, e), I32)
+
+    def none():
+        return jnp.full((n, v), MT.MSG_NONE, I32)
+
     return Fabric(
-        rep=RepChan(none, z, z, z, z, zb, z, z, ze, ze, ze, z, z),
-        hb=HbChan(none, z, z, z),
-        vote=VoteChan(none, z, z, z, zb, z),
-        vresp=VoteRespChan(none, z, zb),
+        rep=RepChan(none(), z(), z(), z(), z(), zb(), z(), z(), ze(), ze(), ze(), z(), z()),
+        hb=HbChan(none(), z(), z(), z()),
+        vote=VoteChan(none(), z(), z(), z(), zb(), z()),
+        vresp=VoteRespChan(none(), z(), zb()),
         self_=SelfMsg(jnp.full((n,), MT.MSG_NONE, I32), jnp.zeros((n,), I32), jnp.zeros((n,), I32)),
     )
 
@@ -1382,15 +1394,90 @@ def _bytes_between(state: RaftState, lo, hi):
 # index-space rebase under live traffic
 
 
-@jax.jit
-def _rebase_indexes_jit(state, mask, delta):
+def donation_enabled() -> bool:
+    """Read RAFT_TPU_DONATE lazily (default ON) so tests can toggle it
+    per-cluster; like metrics_enabled, the value is baked into each cluster
+    at construction. When on, every fused entry point donates its
+    (state, fab, metrics) carry to XLA — the carry updates in place instead
+    of double-buffering, halving resident carry HBM and removing a full
+    carry copy per dispatch. RAFT_TPU_DONATE=0 restores the copying
+    behavior (and keeps stale host references to pre-dispatch carries
+    readable, which the donating path deliberately does not).
+
+    Default exception: the tunneled axon TPU backend rejects
+    donate_argnums at runtime (INVALID_ARGUMENT), so when the axon PJRT
+    hook is active (PALLAS_AXON_POOL_IPS set and JAX_PLATFORMS not
+    pinning cpu) the unset-env default flips to OFF. An explicit
+    RAFT_TPU_DONATE=1 still wins."""
+    v = os.environ.get("RAFT_TPU_DONATE")
+    if v is not None:
+        return v not in ("0", "", "off")
+    if (
+        os.environ.get("PALLAS_AXON_POOL_IPS")
+        and os.environ.get("JAX_PLATFORMS", "").lower() != "cpu"
+    ):
+        return False
+    return True
+
+
+@contextmanager
+def _no_persistent_cache(active: bool = True):
+    """Compile-fence for donating dispatches: on this jax/XLA version a
+    donating executable DESERIALIZED from the persistent compilation cache
+    intermittently mis-executes (donated-adjacent inputs read as zeros —
+    flaky ~1/3 of warm processes, bit-exact when compiled fresh), so every
+    donating entry point compiles with the persistent cache disabled. The
+    flag only gates compilation: entering the context per dispatch is a
+    cheap config write, and once the executable is in the in-process jit
+    cache no compile (hence no cache lookup) happens at all. Non-donating
+    programs keep full persistent-cache coverage; RAFT_TPU_DONATE=0
+    restores it for the kernels too.
+
+    Flipping jax_enable_compilation_cache alone is NOT enough on this jax
+    version: compiler.py latches a per-process "cache used" bit at the
+    FIRST compile (compilation_cache.is_cache_used) and never re-reads the
+    config, so a process that compiled anything cache-enabled first would
+    still read poisoned donating entries. reset_cache() clears that latch
+    (and the in-memory cache handle — cheap, no disk I/O) on entry and
+    re-arms it on exit so the next non-donating compile re-latches
+    enabled."""
+    if not active or not jax.config.jax_enable_compilation_cache:
+        yield
+        return
+    _reset_compile_cache_latch()
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+        _reset_compile_cache_latch()
+
+
+def _reset_compile_cache_latch() -> None:
+    # private-API escape hatch, pinned-version container; degrade to the
+    # config flip alone if the symbol moves
+    try:
+        from jax._src.compilation_cache import reset_cache
+    except Exception:  # pragma: no cover
+        return
+    reset_cache()
+
+
+def _rebase_indexes(state, mask, delta):
     from raft_tpu.ops import log as _lg
 
     return _lg.rebase_indexes(state, mask, delta)
 
 
-@jax.jit
-def rebase_fabric(fab: Fabric, delta) -> Fabric:
+_rebase_indexes_jit = jax.jit(_rebase_indexes)
+# donating twin (state carry updated in place); used by FusedCluster when
+# donation_enabled() was true at construction. Kept separate so callers
+# that re-feed the input state (api/rawnode's serial path, tests holding
+# references) can keep the copying variant.
+_rebase_indexes_donate_jit = jax.jit(_rebase_indexes, donate_argnums=(0,))
+
+
+def _rebase_fabric(fab: Fabric, delta) -> Fabric:
     """Shift the index-valued columns of in-flight fabric messages down by
     `delta` [N] (per SOURCE lane; all lanes of a group rebase together, and
     delivery never crosses groups, so source-lane deltas are destination
@@ -1423,6 +1510,10 @@ def rebase_fabric(fab: Fabric, delta) -> Fabric:
         index=jnp.where(self_live, jnp.maximum(fab.self_.index - d, 0), fab.self_.index),
     )
     return dataclasses.replace(fab, rep=rep, hb=hb, vote=vote, self_=self_)
+
+
+rebase_fabric = jax.jit(_rebase_fabric)
+_rebase_fabric_donate_jit = jax.jit(_rebase_fabric, donate_argnums=(0,))
 
 
 # --------------------------------------------------------------------------
@@ -1518,18 +1609,32 @@ def fused_rounds(
     return state, fab, metrics
 
 
+_FUSED_STATIC = (
+    "v",
+    "n_rounds",
+    "do_tick",
+    "auto_propose",
+    "auto_compact_lag",
+    "ops_first_round_only",
+    "straddle",
+)
+
+# The default dispatch path DONATES the (state, fab, metrics) carry: XLA
+# aliases each donated input buffer to the matching output, so the slim
+# carry updates in place instead of double-buffering (HBM holds one carry
+# + the round's temporaries, not two carries). `ops`/`mute` are never
+# donated — callers re-feed them across dispatches. FusedCluster picks the
+# twin below when RAFT_TPU_DONATE=0.
 _fused_rounds_jit = jax.jit(
     fused_rounds,
-    static_argnames=(
-        "v",
-        "n_rounds",
-        "do_tick",
-        "auto_propose",
-        "auto_compact_lag",
-        "ops_first_round_only",
-        "straddle",
-    ),
+    static_argnames=_FUSED_STATIC,
+    donate_argnums=(0, 1),
+    donate_argnames=("metrics",),
 )
+
+# copying twin: inputs survive the dispatch (stale host references stay
+# readable) at the cost of a full extra carry in HBM
+_fused_rounds_nodonate_jit = jax.jit(fused_rounds, static_argnames=_FUSED_STATIC)
 
 
 class FusedCluster:
@@ -1577,6 +1682,17 @@ class FusedCluster:
         )
         self.fab = slim_fabric(empty_fabric(n, n_voters, self.shape.max_msg_entries))
         self.mute = jnp.zeros((n,), BOOL)
+        # carry donation (see donation_enabled): baked at construction like
+        # the metrics flag so a cluster's dispatch behavior never flips
+        # mid-run under an env change
+        self._donate = donation_enabled()
+        # ops is re-fed (never donated), so the all-zeros LocalOps for
+        # ops-less rounds is built once, not per dispatch
+        self._no_ops = no_ops(n)
+        # the WalStream we last pushed to, if its delta may still hold
+        # references to our (donatable) current state — resolved before the
+        # next dispatch invalidates those buffers
+        self._wal_pending = None
         # metrics plane (raft_tpu/metrics/): RAFT_TPU_METRICS is read at
         # construction; metrics=None keeps every metrics op out of the jaxpr
         self.metrics = metmod.init_metrics(n) if metmod.metrics_enabled() else None
@@ -1603,25 +1719,53 @@ class FusedCluster:
         while the next block computes (the AsyncStorageWrites=true shape
         on the fused engine; reference doc.go:172-258)."""
         if ops is None:
-            ops = no_ops(self.state.id.shape[0])
-        res = _fused_rounds_jit(
-            self.state,
-            self.fab,
-            ops,
-            self.mute,
-            v=self.v,
-            n_rounds=rounds,
-            do_tick=do_tick,
-            auto_propose=auto_propose,
-            auto_compact_lag=auto_compact_lag,
-            ops_first_round_only=ops_first_round_only,
-            metrics=self.metrics,
-        )
+            ops = self._no_ops
+        self._flush_pending_wal()
+        if self._donate:
+            with _no_persistent_cache():
+                res = _fused_rounds_jit(
+                    self.state,
+                    self.fab,
+                    ops,
+                    self.mute,
+                    v=self.v,
+                    n_rounds=rounds,
+                    do_tick=do_tick,
+                    auto_propose=auto_propose,
+                    auto_compact_lag=auto_compact_lag,
+                    ops_first_round_only=ops_first_round_only,
+                    metrics=self.metrics,
+                )
+        else:
+            res = _fused_rounds_nodonate_jit(
+                self.state,
+                self.fab,
+                ops,
+                self.mute,
+                v=self.v,
+                n_rounds=rounds,
+                do_tick=do_tick,
+                auto_propose=auto_propose,
+                auto_compact_lag=auto_compact_lag,
+                ops_first_round_only=ops_first_round_only,
+                metrics=self.metrics,
+            )
         self.state, self.fab = res[0], res[1]
         if self.metrics is not None:
             self.metrics = res[2]
         if wal is not None:
             wal.push(self.state)
+            if self._donate:
+                self._wal_pending = wal
+
+    def _flush_pending_wal(self):
+        """Resolve a WAL delta that still references this cluster's current
+        state before a donating dispatch invalidates those buffers. The
+        D2H copy started at push() time and has had a whole dispatch to
+        ride, so this is (nearly always) a cache read, not a sync."""
+        if self._wal_pending is not None:
+            self._wal_pending.flush()
+            self._wal_pending = None
 
     def ops(self, **kw) -> LocalOps:
         """Build a LocalOps with the given per-lane columns set. Values may
@@ -1676,10 +1820,20 @@ class FusedCluster:
         if not out:
             return out
         dj = jnp.asarray(deltas)
-        self.state = slim_state(
-            _rebase_indexes_jit(self.state, jnp.asarray(mask), dj)
-        )
-        self.fab = slim_fabric(rebase_fabric(fat_fabric(self.fab), dj))
+        self._flush_pending_wal()
+        if self._donate:
+            with _no_persistent_cache():
+                self.state = slim_state(
+                    _rebase_indexes_donate_jit(self.state, jnp.asarray(mask), dj)
+                )
+                self.fab = slim_fabric(
+                    _rebase_fabric_donate_jit(fat_fabric(self.fab), dj)
+                )
+        else:
+            self.state = slim_state(
+                _rebase_indexes_jit(self.state, jnp.asarray(mask), dj)
+            )
+            self.fab = slim_fabric(rebase_fabric(fat_fabric(self.fab), dj))
         if self.metrics is not None:
             # in-flight latency samples hold absolute indexes — shift them
             # with their lanes (or drop, never mismeasure)
@@ -1725,9 +1879,11 @@ class FusedCluster:
         for f in WalStream.FIELDS:  # the stream schema IS the restore set
             cur = getattr(st, f)
             upd[f] = jnp.asarray(np.asarray(delta[f]), dtype=cur.dtype)
-        # durability covered everything streamed; applying rejoins applied
-        upd["stabled"] = upd["last"]
-        upd["applying"] = upd["applied"]
+        # durability covered everything streamed; applying rejoins applied.
+        # jnp.copy, not aliasing: two state fields sharing one buffer would
+        # trip the donating run path ("donate the same buffer twice")
+        upd["stabled"] = jnp.copy(upd["last"])
+        upd["applying"] = jnp.copy(upd["applied"])
         if log_bytes is not None:
             upd["log_bytes"] = jnp.asarray(
                 np.asarray(log_bytes), dtype=st.log_bytes.dtype

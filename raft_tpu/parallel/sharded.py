@@ -35,6 +35,8 @@ from raft_tpu.cluster import (
     _bytes_between,
 )
 from raft_tpu.messages import MsgBatch, empty_batch
+from raft_tpu.ops.fused import _no_persistent_cache
+from raft_tpu.ops.fused import donation_enabled as _donation_enabled
 from raft_tpu.ops import log as lg
 from raft_tpu.ops import step as stepmod
 from raft_tpu.types import MessageType as MT, StateType
@@ -209,6 +211,9 @@ class ShardedCluster(Cluster):
         self.group_of = jax.device_put(self.group_of, self.lane_sharding)
         self.lane_of = jax.device_put(self.lane_of, self.repl_sharding)
         self._round_cache: dict = {}
+        # carry donation (ops/fused.py donation_enabled), baked like the
+        # fused path: the sharded state carry updates in place per shard
+        self._donate = _donation_enabled()
 
     def _shard_mapped(self, fn):
         """shard_map + jit `fn(state, inbox, group_of, lane_of)` with the
@@ -228,7 +233,10 @@ class ShardedCluster(Cluster):
                 P(),
             ),
         )
-        return jax.jit(sm)
+        # only the state carry is donated: the inbox is rebuilt from the
+        # host-side _pending mirror every dispatch (np -> device transfer
+        # whose buffer may be host-shared), and group_of/lane_of are re-fed
+        return jax.jit(sm, donate_argnums=(0,) if self._donate else ())
 
     def _sharded_round(self, do_tick: bool):
         if do_tick not in self._round_cache:
@@ -247,9 +255,10 @@ class ShardedCluster(Cluster):
     def _do_round(self, do_tick: bool):
         inbox = jax.tree.map(jnp.asarray, self._pending)
         fn = self._sharded_round(do_tick)
-        self.state, nxt, dropped = fn(
-            self.state, inbox, self.group_of, self.lane_of
-        )
+        with _no_persistent_cache(self._donate):
+            self.state, nxt, dropped = fn(
+                self.state, inbox, self.group_of, self.lane_of
+            )
         self._pending = jax.tree.map(lambda x: np.array(x), nxt)
         self.dropped += int(dropped)
 
@@ -290,9 +299,10 @@ class ShardedCluster(Cluster):
         """`rounds` sharded rounds in one dispatch."""
         fn = self._sharded_rounds(do_tick, rounds)
         inbox = jax.tree.map(jnp.asarray, self._pending)
-        self.state, nxt, dropped = fn(
-            self.state, inbox, self.group_of, self.lane_of
-        )
+        with _no_persistent_cache(self._donate):
+            self.state, nxt, dropped = fn(
+                self.state, inbox, self.group_of, self.lane_of
+            )
         self._pending = jax.tree.map(lambda x: np.array(x), nxt)
         self.dropped += int(dropped)
 
@@ -306,9 +316,10 @@ class ShardedCluster(Cluster):
         )
         total_dropped = jnp.zeros((), I32)
         for i in range(n_rounds):
-            state, pending, dropped = fn(
-                state, pending, self.group_of, self.lane_of
-            )
+            with _no_persistent_cache(self._donate):
+                state, pending, dropped = fn(
+                    state, pending, self.group_of, self.lane_of
+                )
             total_dropped = total_dropped + dropped
             if i % 8 == 7:  # bound in-flight executions (memory pressure)
                 jax.block_until_ready(state.term)
@@ -371,6 +382,9 @@ class ShardedFusedCluster:
         self._no_ops = jax.tree.map(shard_lanes, no_ops(n))
         self._shard_lanes = shard_lanes
         self._cache = {}
+        # donate the (state, fab, metrics) carry, mirroring FusedCluster;
+        # ops/mute stay un-donated (self._no_ops and inner.mute are re-fed)
+        self._donate = _donation_enabled()
 
     def run(self, rounds: int = 1, ops=None, do_tick: bool = True,
             auto_propose: bool = False, auto_compact_lag=None):
@@ -458,18 +472,22 @@ class ShardedFusedCluster:
                     ),
                     check_rep=False,
                 )
-            self._cache[key] = jax.jit(fn)
-        if met is None:
-            self.inner.state, self.inner.fab = self._cache[key](
-                self.inner.state, self.inner.fab, ops, self.inner.mute
-            )
-        else:
-            self.inner.state, self.inner.fab, self.inner.metrics = (
-                self._cache[key](
-                    self.inner.state, self.inner.fab, ops,
-                    self.inner.mute, met,
+            donate = ()
+            if self._donate:
+                donate = (0, 1) if met is None else (0, 1, 4)
+            self._cache[key] = jax.jit(fn, donate_argnums=donate)
+        with _no_persistent_cache(self._donate):
+            if met is None:
+                self.inner.state, self.inner.fab = self._cache[key](
+                    self.inner.state, self.inner.fab, ops, self.inner.mute
                 )
-            )
+            else:
+                self.inner.state, self.inner.fab, self.inner.metrics = (
+                    self._cache[key](
+                        self.inner.state, self.inner.fab, ops,
+                        self.inner.mute, met,
+                    )
+                )
 
     def __getattr__(self, name):
         return getattr(self.inner, name)

@@ -8,27 +8,42 @@ not have to be one million-lane tensor program — and on a real chip it must
 not be, for three reasons:
 
 1. **HBM peak.** Resident *state* scales with total lanes, but the fused
-   round's working set (XLA temporaries, the un-donatable scan double
-   buffer) scales with the lanes of the program being executed. Splitting
-   1M groups into K blocks keeps the temporaries at block size while all
-   K blocks' slim carries (state.STATE_SLIM / fused.FABRIC_SLIM) stay
-   resident: peak = total_carry + one block's working set, instead of
-   K times the working set.
+   round's working set (XLA temporaries) scales with the lanes of the
+   program being executed. Splitting 1M groups into K blocks keeps the
+   temporaries at block size while all K blocks' slim carries
+   (state.STATE_SLIM / fused.FABRIC_SLIM) stay resident: peak =
+   total_carry + one block's working set, instead of K times the working
+   set. With carry donation on (fused.donation_enabled, the default),
+   each block's carry additionally updates in place — the old
+   "un-donatable double buffer" is gone, so total_carry is ONE copy per
+   block, not two.
 2. **One compile.** Every block shares one (shape, static-args) signature,
    so the fused kernel compiles ONCE and serves every block — and every
    aggregate size that is a multiple of the block: the whole scaling
    ladder reuses a single 30-100 s TPU compilation.
-3. **Latency.** A round of the aggregate is K short dispatches instead of
-   one huge kernel; quorum-commit latency at 1M aggregate groups is the
-   latency of one block-sized round (the dispatches of idle blocks overlap
-   it via JAX async dispatch), not a 1M-lane kernel's.
+3. **Latency + queue occupancy.** Dispatch is ROUND-MAJOR: round r of
+   block b+1 is enqueued right behind round r of block b, so the device
+   queue always holds work from the other K-1 blocks while one block's
+   round executes — per-block host work (ops binding, WAL pushes) hides
+   behind the other blocks' compute instead of draining the queue
+   block-major. Quorum-commit latency at 1M aggregate groups is the
+   latency of one block-sized round, not a 1M-lane kernel's.
 
 Blocks are seeded differently so their randomized election timeouts
 (reference: raft.go:1984-1990) decorrelate exactly like lanes within a
 block do.
+
+Host-side dispatch cost is kept off the hot path: per-block `ops` slices
+are computed ONCE per injected ops object (`prepare_ops` / the identity
+cache in `run`), not re-sliced with `jax.tree.map` on every call, and the
+ops-less rounds reuse each block's cached zero-ops (fused.FusedCluster).
+`pipeline_depth` bounds enqueued-but-unfinished dispatches for drivers
+that need bounded device-queue memory (None = unbounded, pure async).
 """
 
 from __future__ import annotations
+
+from collections import deque
 
 import jax
 import jax.numpy as jnp
@@ -43,7 +58,14 @@ class BlockedFusedCluster:
     resident FusedClusters stepped with one shared compiled kernel.
 
     The driving API mirrors FusedCluster; per-lane injections address lanes
-    in global order (block i owns global lanes [i*B*V, (i+1)*B*V))."""
+    in global order (block i owns global lanes [i*B*V, (i+1)*B*V)).
+
+    round_chunk: rounds per dispatch in the round-major sweep (default 1 =
+    strict round-major interleave; larger values amortize per-dispatch host
+    overhead by letting each block scan `round_chunk` rounds between
+    interleave points — trajectories are bit-identical either way).
+    pipeline_depth: max enqueued-but-unfinished dispatches before the host
+    blocks on the oldest (None = unbounded)."""
 
     def __init__(
         self,
@@ -52,15 +74,28 @@ class BlockedFusedCluster:
         block_groups: int | None = None,
         seed: int = 1,
         shape: Shape | None = None,
+        round_chunk: int = 1,
+        pipeline_depth: int | None = None,
         **cfg,
     ):
         block_groups = block_groups or n_groups
         if n_groups % block_groups:
             raise ValueError("n_groups must be a multiple of block_groups")
+        if round_chunk < 1:
+            raise ValueError("round_chunk must be >= 1")
+        if pipeline_depth is not None and pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1 (or None)")
         self.g, self.v = n_groups, n_voters
         self.block_groups = block_groups
         self.k = n_groups // block_groups
         self.lanes_per_block = block_groups * n_voters
+        self.round_chunk = round_chunk
+        self.pipeline_depth = pipeline_depth
+        self._inflight: deque = deque()
+        # single-slot identity cache: (ops object, its per-block slices).
+        # Holding the ops reference pins its id, so the identity test can
+        # never false-positive on a recycled address.
+        self._ops_cache: tuple | None = None
         # distinct seeds decorrelate election timeouts across blocks
         self.blocks = [
             FusedCluster(
@@ -71,19 +106,105 @@ class BlockedFusedCluster:
 
     # -- driving ----------------------------------------------------------
 
-    def run(self, rounds: int = 1, ops: LocalOps | None = None, wal=None, **kw):
-        """`rounds` fused rounds on every block. Dispatches are enqueued
-        without host syncs, so the device pipelines block b+1's rounds
-        behind block b's (JAX async dispatch). wal: optional list of K
-        runtime.wal.WalStream, one per block."""
-        for i, b in enumerate(self.blocks):
-            o = None if ops is None else jax.tree.map(
-                lambda x, i=i: x[
-                    i * self.lanes_per_block : (i + 1) * self.lanes_per_block
-                ],
-                ops,
+    def prepare_ops(self, ops: LocalOps) -> list[LocalOps]:
+        """Slice a global-lane LocalOps into K per-block bindings ONCE.
+        The returned list can be passed to run(ops=...) any number of
+        times with zero further host-side slicing (run() also caches the
+        slices of the last raw LocalOps it saw, so callers that re-inject
+        the same object get this for free)."""
+        per = []
+        for i in range(self.k):
+            lo = i * self.lanes_per_block
+            per.append(
+                jax.tree.map(
+                    lambda x, lo=lo: x[lo : lo + self.lanes_per_block], ops
+                )
             )
-            b.run(rounds, ops=o, wal=None if wal is None else wal[i], **kw)
+        return per
+
+    def _bind_ops(self, ops) -> list | None:
+        if ops is None:
+            return None
+        if isinstance(ops, list):  # already per-block (prepare_ops). NOT
+            # tuple: LocalOps itself is a NamedTuple.
+            if len(ops) != self.k:
+                raise ValueError(
+                    f"per-block ops list must have one entry per resident "
+                    f"block: got {len(ops)}, expected {self.k}"
+                )
+            return list(ops)
+        cached = self._ops_cache
+        if cached is not None and cached[0] is ops:
+            return cached[1]
+        per = self.prepare_ops(ops)
+        self._ops_cache = (ops, per)
+        return per
+
+    def _check_wal(self, wal) -> list:
+        try:
+            k = len(wal)
+        except TypeError:
+            raise TypeError(
+                "wal must be a sequence of K WalStreams, one per resident "
+                f"block (this scheduler holds K={self.k})"
+            ) from None
+        if k != self.k:
+            raise ValueError(
+                f"wal must hold one stream per resident block: got {k} "
+                f"stream(s), expected K={self.k} "
+                f"({self.g} groups / {self.block_groups} per block)"
+            )
+        return list(wal)
+
+    def _throttle(self, b: FusedCluster):
+        if self.pipeline_depth is None:
+            return
+        self._inflight.append(b.state.term)
+        while len(self._inflight) > self.pipeline_depth:
+            jax.block_until_ready(self._inflight.popleft())
+
+    def run(self, rounds: int = 1, ops=None, wal=None, **kw):
+        """`rounds` fused rounds on every block, dispatched ROUND-MAJOR:
+        each sweep enqueues `round_chunk` rounds of every block before
+        advancing, so block b+1's round hides block b's host-side dispatch
+        work (JAX async dispatch; no syncs unless pipeline_depth bounds
+        the queue).
+
+        ops: a global-lane LocalOps, or a K-list from prepare_ops.
+        wal: optional list of K runtime.wal.WalStream, one per block
+        (each block's delta is pushed once, after its last round)."""
+        if wal is not None:
+            wal = self._check_wal(wal)
+        per_ops = self._bind_ops(ops)
+        ops_first = kw.get("ops_first_round_only", True)
+        if self.k == 1:
+            # one resident block: a single multi-round scan dispatch beats
+            # any interleave (nothing to overlap with)
+            b = self.blocks[0]
+            b.run(
+                rounds,
+                ops=None if per_ops is None else per_ops[0],
+                wal=None if wal is None else wal[0],
+                **kw,
+            )
+            self._throttle(b)
+            return
+        done = 0
+        while done < rounds:
+            step = min(self.round_chunk, rounds - done)
+            first, last = done == 0, done + step >= rounds
+            for i, b in enumerate(self.blocks):
+                o = None
+                if per_ops is not None and (first or not ops_first):
+                    o = per_ops[i]
+                b.run(
+                    step,
+                    ops=o,
+                    wal=wal[i] if (wal is not None and last) else None,
+                    **kw,
+                )
+                self._throttle(b)
+            done += step
 
     def ops(self, **kw) -> LocalOps:
         """Global-lane LocalOps (same contract as FusedCluster.ops)."""
@@ -92,6 +213,7 @@ class BlockedFusedCluster:
         return make_local_ops(self.g * self.v, **kw)
 
     def block_until_ready(self):
+        self._inflight.clear()
         jax.block_until_ready([b.state.term for b in self.blocks])
 
     # -- inspection (aggregate) -------------------------------------------
@@ -101,14 +223,45 @@ class BlockedFusedCluster:
         return self.blocks[0].metrics is not None
 
     def metrics_snapshot(self) -> dict | None:
-        """One merged snapshot over all K resident blocks: each block's
-        device counters are already lane-reduced (K tiny pulls, not K*N),
-        the host just sums them (raft_tpu/metrics/)."""
+        """One merged snapshot over all K resident blocks with ONE device
+        sync: the K blocks' already-lane-reduced counter/hist vectors are
+        stacked into a single [K, C+B+2] pull (one transfer), then folded
+        into each block's wraparound-aware host accumulator and merged
+        (raft_tpu/metrics/)."""
         if not self.metrics_enabled:
             return None
+        from types import SimpleNamespace
+
+        from raft_tpu.metrics.device import COUNTERS, N_BUCKETS
         from raft_tpu.metrics.host import merge_snapshots
 
-        return merge_snapshots(b.metrics_snapshot() for b in self.blocks)
+        nc = len(COUNTERS)
+        rows = np.asarray(
+            jnp.stack(
+                [
+                    jnp.concatenate(
+                        [
+                            b.metrics.counters,
+                            b.metrics.hist,
+                            b.metrics.lat_sum[None],
+                            b.metrics.round_ctr[None],
+                        ]
+                    )
+                    for b in self.blocks
+                ]
+            )
+        )
+        snaps = []
+        for b, row in zip(self.blocks, rows):
+            pulled = SimpleNamespace(
+                counters=row[:nc],
+                hist=row[nc : nc + N_BUCKETS],
+                lat_sum=row[nc + N_BUCKETS],
+                round_ctr=row[nc + N_BUCKETS + 1],
+            )
+            b._metrics_acc.pull(pulled)
+            snaps.append(b._metrics_acc.snapshot())
+        return merge_snapshots(snaps)
 
     def total_committed(self) -> int:
         return int(sum(int(jnp.sum(b.state.committed)) for b in self.blocks))

@@ -313,8 +313,15 @@ def init_state(
     self_slot = peer_ids == ids[:, None]
     own_learner = (peer_is_learner & self_slot).any(axis=1)
 
-    zeros_n = jnp.zeros((n,), I32)
-    zeros_nv = jnp.zeros((n, v), I32)
+    # Every zero-initialized field gets its OWN buffer: the fused engine
+    # donates the whole state carry (ops/fused.py donation_enabled), and
+    # XLA rejects the same buffer appearing in two donated positions
+    # ("Attempt to donate the same buffer twice in Execute()").
+    def zeros_n():
+        return jnp.zeros((n,), I32)
+
+    def zeros_nv():
+        return jnp.zeros((n, v), I32)
 
     # Distinct per-lane streams: lane index scaled by an odd constant so no
     # two lanes collide (a bare +lane collapses adjacent lanes under the |1
@@ -336,32 +343,32 @@ def init_state(
 
     return RaftState(
         id=jnp.asarray(ids),
-        term=zeros_n,
-        vote=zeros_n,
+        term=zeros_n(),
+        vote=zeros_n(),
         state=jnp.full((n,), StateType.FOLLOWER, I32),
-        lead=zeros_n,
-        lead_transferee=zeros_n,
+        lead=zeros_n(),
+        lead_transferee=zeros_n(),
         is_learner=jnp.asarray(own_learner),
-        pending_conf_index=zeros_n,
-        uncommitted_size=zeros_n,
-        election_elapsed=zeros_n,
-        heartbeat_elapsed=zeros_n,
+        pending_conf_index=zeros_n(),
+        uncommitted_size=zeros_n(),
+        election_elapsed=zeros_n(),
+        heartbeat_elapsed=zeros_n(),
         randomized_election_timeout=jnp.asarray(rand_to),
         rng=jnp.asarray(rng),
         log_term=jnp.zeros((n, w), I32),
         log_type=jnp.zeros((n, w), I32),
         log_bytes=jnp.zeros((n, w), I32),
-        last=zeros_n,
-        stabled=zeros_n,
-        committed=zeros_n,
-        applying=zeros_n,
-        applied=zeros_n,
-        snap_index=zeros_n,
-        snap_term=zeros_n,
-        pending_snap_index=zeros_n,
-        pending_snap_term=zeros_n,
-        avail_snap_index=zeros_n,
-        avail_snap_term=zeros_n,
+        last=zeros_n(),
+        stabled=zeros_n(),
+        committed=zeros_n(),
+        applying=zeros_n(),
+        applied=zeros_n(),
+        snap_index=zeros_n(),
+        snap_term=zeros_n(),
+        pending_snap_index=zeros_n(),
+        pending_snap_term=zeros_n(),
+        avail_snap_index=zeros_n(),
+        avail_snap_term=zeros_n(),
         snap_unavailable=jnp.zeros((n,), BOOL),
         prs_id=jnp.asarray(peer_ids),
         voters_in=jnp.asarray(voters_in),
@@ -369,13 +376,13 @@ def init_state(
         learners=jnp.asarray(peer_is_learner & present),
         learners_next=jnp.zeros((n, v), BOOL),
         auto_leave=jnp.zeros((n,), BOOL),
-        pr_match=zeros_nv,
+        pr_match=zeros_nv(),
         pr_next=jnp.ones((n, v), I32),
-        pr_state=zeros_nv,
-        pr_pending_snapshot=zeros_nv,
+        pr_state=zeros_nv(),
+        pr_pending_snapshot=zeros_nv(),
         pr_recent_active=jnp.zeros((n, v), BOOL),
         pr_msg_app_flow_paused=jnp.zeros((n, v), BOOL),
-        votes=zeros_nv,
+        votes=zeros_nv(),
         ro_ctx=jnp.zeros((n, r), I32),
         ro_from=jnp.zeros((n, r), I32),
         ro_index=jnp.zeros((n, r), I32),
@@ -386,12 +393,12 @@ def init_state(
         pri_from=jnp.zeros((n, r), I32),
         rs_ctx=jnp.zeros((n, r), I32),
         rs_index=jnp.zeros((n, r), I32),
-        rs_count=zeros_n,
+        rs_count=zeros_n(),
         infl_index=jnp.zeros((n, v, f), I32),
         infl_bytes=jnp.zeros((n, v, f), I32),
-        infl_start=zeros_nv,
-        infl_count=zeros_nv,
-        infl_total_bytes=zeros_nv,
-        error_bits=zeros_n,
+        infl_start=zeros_nv(),
+        infl_count=zeros_nv(),
+        infl_total_bytes=zeros_nv(),
+        error_bits=zeros_n(),
         cfg=cfg,
     )

@@ -426,11 +426,13 @@ class LockstepPair:
                     (int(ctx[lane, k]), int(idx[lane, k]))
                     for k in range(int(cnt[lane]))
                 )
-            z = jnp.zeros_like(c.state.rs_ctx)
+            # one distinct buffer per field: the fused carry is donated on
+            # the next dispatch, and two leaves sharing a buffer trip XLA's
+            # donate-same-buffer-twice check (or silently alias outputs)
             c.state = dataclasses.replace(
                 c.state,
-                rs_ctx=z,
-                rs_index=z,
+                rs_ctx=jnp.zeros_like(c.state.rs_ctx),
+                rs_index=jnp.zeros_like(c.state.rs_index),
                 rs_count=jnp.zeros_like(c.state.rs_count),
             )
 

@@ -82,3 +82,30 @@ class StepStats:
 
 def env_trace_dir() -> str | None:
     return os.environ.get("RAFT_TPU_TRACE") or None
+
+
+def live_buffer_bytes() -> int:
+    """Total bytes of live (not-deleted) device arrays in this process —
+    the host-visible live-buffer analog of an HBM-peak probe. Donated
+    inputs count as deleted even while Python still references them, so
+    a donation-on dispatch shows strictly lower live bytes than the same
+    dispatch with RAFT_TPU_DONATE=0 holding the pre-dispatch carry."""
+    import jax
+
+    return int(
+        sum(x.nbytes for x in jax.live_arrays() if not x.is_deleted())
+    )
+
+
+def device_memory_stats() -> dict | None:
+    """Allocator stats of device 0 ({bytes_in_use, peak_bytes_in_use, ...})
+    or None where the backend exposes none (XLA:CPU)."""
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {k: int(v) for k, v in stats.items()}

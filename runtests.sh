@@ -8,7 +8,17 @@
 #
 # XLA:CPU reproducibly segfaults/aborts on a fresh compile once a few
 # hundred programs were compiled earlier in the same process; the suite
-# therefore spreads over multiple worker processes (details below).
+# therefore spreads over multiple worker processes. With pytest-xdist
+# installed, 6 loadfile workers do that in parallel; on 1-core rigs
+# without xdist (this container), the fallback below runs the same suite
+# as a CHUNKED SERIAL LADDER — ~6 sequential pytest processes, each well
+# under the per-process compile-count crash threshold, with
+# test_sharded.py LAST in its own process (its big 8-device shard_map
+# programs are the original crash trigger and its autouse fixture
+# disables the persistent compile cache).
+#
+# RAFT_TPU_COMPILE_CACHE=<dir> (utils/compile_cache.py) is forwarded to
+# the bench smokes so repeat runs skip the fused-kernel compile.
 
 run() {
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -16,19 +26,66 @@ run() {
     python -m pytest "$@" -x -q
 }
 
+# serial-ladder invocation: neutralize pytest.ini's xdist addopts
+run_chunk() {
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python -m pytest "$@" -x -q -o addopts= -p no:cacheprovider -p no:randomly
+}
+
+run_bench() {
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python "$@"
+}
+
+smokes() {
+  # device-metrics smoke + the donation A/B dispatch smoke (fails if
+  # donation-on regresses throughput or stops lowering live buffers)
+  run_bench benches/metrics_smoke.py \
+    && run_bench benches/dispatch_ab.py
+}
+
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
-  # pytest-xdist, one file per worker (--dist loadfile): 6 worker processes
-  # keep every process's XLA:CPU compile count far under the crash
-  # threshold (the round-4 corpus outgrew even 4 sequential chunks), and
-  # the wall time drops ~4x. test_sharded still runs in its own process
-  # LAST: its big 8-device shard_map programs are the original crash
-  # trigger and its autouse fixture disables the persistent compile cache.
-  run -n 6 --dist loadfile --max-worker-restart 0 \
-    $(ls tests/test_*.py | grep -v test_sharded) \
-    && run tests/test_sharded.py \
-    && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-      XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
-      python benches/metrics_smoke.py
+  if python -c "import xdist" >/dev/null 2>&1; then
+    # pytest-xdist, one file per worker (--dist loadfile): 6 worker
+    # processes keep every process's XLA:CPU compile count far under the
+    # crash threshold and the wall time drops ~4x.
+    run -n 6 --dist loadfile --max-worker-restart 0 \
+      $(ls tests/test_*.py | grep -v test_sharded) \
+      && run tests/test_sharded.py \
+      && smokes
+  else
+    # chunked serial ladder (1-core rigs; see header). Chunk boundaries
+    # only balance compile counts — adjust freely as the corpus grows.
+    set -e
+    run_chunk tests/test_backpressure.py tests/test_bridge.py \
+      tests/test_bridge_fused.py tests/test_bridge_process.py \
+      tests/test_codec.py tests/test_confchange.py \
+      tests/test_confchange_datadriven.py tests/test_confchange_scenarios.py
+    run_chunk tests/test_donation.py tests/test_e2e.py \
+      tests/test_fast_log_rejection.py tests/test_flow_control.py \
+      tests/test_fused.py tests/test_fused_confchange.py tests/test_fused_ids.py
+    run_chunk tests/test_fused_invariants.py tests/test_fused_rebase.py \
+      tests/test_fused_restore.py tests/test_go_frame_parse.py \
+      tests/test_go_interop.py tests/test_interaction.py tests/test_learner.py \
+      tests/test_lockstep.py tests/test_lockstep_more.py
+    run_chunk tests/test_log.py tests/test_log_tables.py \
+      tests/test_logoracle_fuzz.py tests/test_metrics.py \
+      tests/test_native_store.py tests/test_network_sim.py \
+      tests/test_node_api.py tests/test_node_ports.py tests/test_pagination.py
+    run_chunk tests/test_paper.py tests/test_prevote.py tests/test_progress.py \
+      tests/test_quorum.py tests/test_quorum_datadriven.py \
+      tests/test_quorum_pallas.py tests/test_rawnode.py \
+      tests/test_rawnode_ports.py tests/test_readindex.py tests/test_rebase.py
+    run_chunk tests/test_restart.py tests/test_restore.py \
+      tests/test_scenarios.py tests/test_scenarios_r4.py tests/test_slim.py \
+      tests/test_snapshot.py tests/test_status.py tests/test_transfer.py \
+      tests/test_unstable.py tests/test_util_ports.py tests/test_vote_states.py \
+      tests/test_wal.py
+    run_chunk tests/test_sharded.py
+    smokes
+  fi
 else
   run "$@"
 fi
