@@ -1,0 +1,137 @@
+"""Liveness-SLO chaos soak: in-fabric fault injection + bounded recovery.
+
+Drives the device-resident chaos plane (raft_tpu/chaos/) through a mixed
+scenario — rolling partitions, leader-targeted kills, flapping links, and
+background drop/duplicate/skew noise — and asserts the recovery SLO: every
+faulted group re-elects AND re-commits within CHAOS_BUDGET ticks of its
+heal, with Election Safety checked after every segment.
+
+Modes:
+
+    python benches/chaos_soak.py           # chip-scale soak (CHAOS_GROUPS)
+    python benches/chaos_soak.py --smoke   # small CI soak, run TWICE with
+                                           # the same seed: trajectories and
+                                           # probe snapshots must be
+                                           # bit-identical (determinism gate)
+
+Env: CHAOS_GROUPS (default 4096), CHAOS_VOTERS (3), CHAOS_SEED (0),
+CHAOS_BUDGET (64 ticks), CHAOS_BLOCK_GROUPS (block size for the scheduler
+at scale). Prints one JSON line per run with the recovery histograms.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# the chaos plane is opt-in at construction: flip it on BEFORE any cluster
+# is built (mirrors metrics_smoke.py's RAFT_TPU_METRICS handling)
+os.environ["RAFT_TPU_CHAOS"] = "1"
+
+import jax
+
+from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+if jax.default_backend() != "cpu":
+    enable_persistent_cache()
+
+
+def fail(msg: str):
+    print(f"chaos_soak: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def scenario(g: int, v: int):
+    """The mixed fault schedule, scaled to g groups: quarters of the batch
+    get partitions / leader kills / flapping links, with background
+    drop+duplicate+skew noise over the kill quarter (faults compose)."""
+    from raft_tpu.chaos import ChaosSchedule
+
+    q = max(1, g // 4)
+    part = list(range(0, q))
+    kill = list(range(q, 2 * q))
+    flap = list(range(2 * q, 3 * q))
+    sched = (
+        ChaosSchedule(g, v)
+        .rolling_partitions(at=24, waves=2, duration=10, settle=8)
+        .partition(groups=part, at=70, duration=12)
+        .kill_leaders(groups=kill, at=72, down=8)
+        .flap(groups=flap, at=70, cycles=2, down=4, up=4)
+        .drop(groups=kill, at=70, duration=16, prob=0.2)
+        .duplicate(groups=kill, at=70, duration=16, prob=0.2)
+        .skew(groups=flap, at=70, duration=16, prob=0.3)
+    )
+    return sched
+
+
+def one_run(g: int, v: int, seed: int, budget: int, block_groups: int | None):
+    from raft_tpu.chaos import ChaosRunner, trajectory_digest
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.scheduler import BlockedFusedCluster
+
+    if block_groups and block_groups < g:
+        c = BlockedFusedCluster(g, v, block_groups=block_groups, seed=seed)
+    else:
+        c = FusedCluster(g, v, seed=seed)
+    runner = ChaosRunner(c, scenario(g, v), tick_budget=budget)
+    snap = runner.run()
+    return snap, trajectory_digest(c)
+
+
+def main():
+    smoke = "--smoke" in sys.argv[1:]
+    g = 64 if smoke else int(os.environ.get("CHAOS_GROUPS", 4096))
+    v = int(os.environ.get("CHAOS_VOTERS", 3))
+    seed = int(os.environ.get("CHAOS_SEED", 0))
+    budget = int(os.environ.get("CHAOS_BUDGET", 64))
+    block_groups = int(os.environ.get("CHAOS_BLOCK_GROUPS", 0)) or (
+        None if smoke else min(g, 1024)
+    )
+
+    t0 = time.perf_counter()
+    snap, digest = one_run(g, v, 1000 + seed, budget, block_groups)
+    elapsed = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "bench": "chaos_soak",
+                "mode": "smoke" if smoke else "full",
+                "groups": g,
+                "voters": v,
+                "seed": seed,
+                "elapsed_s": round(elapsed, 3),
+                "digest": digest,
+                **snap,
+            }
+        ),
+        flush=True,
+    )
+    if not snap["slo"]["ok"]:
+        fail(
+            f"recovery SLO violated: {snap['counters']['chaos_unrecovered']} "
+            f"group(s) unrecovered, {snap['counters']['chaos_over_budget']} "
+            f"over the {budget}-tick budget"
+        )
+    if snap["counters"]["chaos_groups_probed"] == 0:
+        fail("probe saw zero healed groups — the schedule injected nothing")
+
+    if smoke:
+        # determinism gate: the SAME seed must reproduce the run bit for
+        # bit — trajectory digest AND every probe number
+        snap2, digest2 = one_run(g, v, 1000 + seed, budget, block_groups)
+        if digest2 != digest:
+            fail(f"trajectory diverged across same-seed runs: "
+                 f"{digest} != {digest2}")
+        if snap2 != snap:
+            fail("probe snapshot diverged across same-seed runs")
+        print("chaos_soak: determinism OK (two same-seed runs bit-identical)")
+
+    print(f"chaos_soak: OK ({'smoke' if smoke else 'full'}, {g}x{v}, "
+          f"{elapsed:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()

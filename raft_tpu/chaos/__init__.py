@@ -1,0 +1,25 @@
+"""Device-resident chaos plane: in-fabric fault injection + recovery-SLO
+probes for the fused engine.
+
+- `device`: the `ChaosState` carry riding the fused-round scan — per-edge
+  drop/duplicate masks, partition bitmasks, tick skew, lane crash/restart —
+  compile-time elidable via RAFT_TPU_CHAOS=0 (the default).
+- `schedule`: the host plane — the `ChaosSchedule` scenario DSL compiled
+  into device mask timelines, the `ChaosRunner` segment driver, and the
+  `RecoveryProbe` ticks-to-reelection / ticks-to-first-commit histograms.
+"""
+
+from raft_tpu.chaos.device import (  # noqa: F401
+    NEVER,
+    P_ONE,
+    ChaosState,
+    chaos_enabled,
+    init_chaos,
+    probability,
+)
+from raft_tpu.chaos.schedule import (  # noqa: F401
+    ChaosRunner,
+    ChaosSchedule,
+    RecoveryProbe,
+    trajectory_digest,
+)

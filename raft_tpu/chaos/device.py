@@ -1,0 +1,398 @@
+"""Device half of the chaos plane: the `ChaosState` pytree carried through
+the fused round (ops/fused.py) next to `MetricsState`.
+
+The paper's premise is that Raft is a pure deterministic state machine and
+faults are "the application's job" — so fault injection belongs IN the
+application fabric, not bolted on per-lane from the host. This module makes
+faults batched tensor ops that ride the fused-round scan, the same pattern
+the metrics plane proved out (raft_tpu/metrics/device.py):
+
+1. **Zero cost when off.** Every fault site in fused_rounds/fused_round is
+   guarded by trace-time `if chaos is not None:` / `if tick_mask is not
+   None:` Python conditionals, so `RAFT_TPU_CHAOS=0` (the default) produces
+   a jaxpr with no chaos ops at all (asserted by tests/test_chaos.py).
+2. **Deterministic, donation-safe randomness.** Faults draw from a
+   counter-based hash PRNG — a pure function of (seed, round, site index,
+   salt) with NO mutable key threading — so the fault timeline is
+   bit-identical across runs and processes, is insensitive to dispatch
+   chunking (the round counter is absolute), and adds nothing stateful to
+   the donated carry beyond the [] round counter itself.
+3. **Crash ≠ amnesia.** Lane crash/restart wipes volatile state through
+   `state.wipe_volatile`, which preserves exactly the WAL-streamed set
+   (runtime/wal.py WalStream.FIELDS: HardState, log metadata, membership,
+   cursors) — the in-fabric twin of FusedCluster.restore_from_wal.
+
+Fault model (all knobs are host-settable columns; see SETTABLE):
+
+- drop_num [N, V]: per-inbound-edge loss probability in 2^-16 units
+  (P_ONE = certain). Cell [d, i] drops messages from group-member slot i
+  to lane d; each channel (rep/hb/vote/vresp) draws independently.
+- dup_num [N, V]: per-outbound-edge duplicate probability. Implemented
+  with ZERO extra resident memory: after a round, last round's outbox
+  cells are re-injected into still-empty slots of the new outbox, so the
+  message stays in flight one extra round and the receiver sees it twice
+  (delayed redelivery — the realistic shape of a retransmit).
+- part_send / part_recv [N]: partition bitmasks. Edge src->dst is allowed
+  iff `part_send[src] & part_recv[dst] != 0`; differing send/recv masks
+  express ASYMMETRIC partitions (a lane whose packets get out but none
+  get in). Default 1 everywhere = fully connected.
+- tick_skew_num [N]: probability a lane skips its tick this round (clock
+  skew: a slow lane's timers fire late relative to its group).
+- crash_at / restart_at [N]: absolute round bounds of a crash window.
+  While `crash_at <= round < restart_at` the lane is dead: volatile state
+  wiped (at both edges), no inbound, no outbound (peers' inbound from it
+  is cut), no tick, host ops zeroed. `crash_at == restart_at` is an
+  instant restart (wipe only). NEVER disables.
+
+Recovery probe (heal SLO): the host arms `heal_round`; from that round on
+the plane records, per group, the first round a leader exists
+(reelect_round) and the first round `committed` advances past its value at
+the heal (recommit_round) — ticks-to-reelection / ticks-to-first-commit,
+read back by the host into metrics-plane-style histograms
+(raft_tpu/chaos/schedule.py RecoveryProbe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.state import wipe_volatile
+from raft_tpu.types import MessageType as MT, StateType
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+# Sentinel round for "never": far beyond any soak horizon, far below the
+# i32 overflow guard, so `round >= crash_at` style compares never wrap.
+NEVER = 1 << 30
+
+# Fault probabilities are fixed-point in 2^-16 units: u16 of hash output
+# `< num` fires with probability num / P_ONE exactly.
+P_ONE = 1 << 16
+
+# Per-site salts: every decision family hashes a distinct stream.
+_SALT_DROP_REP = 1
+_SALT_DROP_HB = 2
+_SALT_DROP_VOTE = 3
+_SALT_DROP_VRESP = 4
+_SALT_DUP_REP = 5
+_SALT_DUP_HB = 6
+_SALT_DUP_VOTE = 7
+_SALT_DUP_VRESP = 8
+_SALT_TICK_SKEW = 9
+
+
+def _dc(cls):
+    fields = [f.name for f in dataclasses.fields(cls)]
+    return jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class ChaosState:
+    """The chaos carry. Knob columns are host-written (SETTABLE) and
+    host-read (PROBE_FIELDS); round/seed drive the counter PRNG."""
+
+    seed: Any  # [] u32 PRNG stream id (derived from the cluster seed)
+    round: Any  # [] i32 absolute chaos round (never resets)
+    drop_num: Any  # [N, V] i32 inbound-edge drop probability (2^-16 units)
+    dup_num: Any  # [N, V] i32 outbound-edge duplicate probability
+    part_send: Any  # [N] i32 partition send bitmask (default 1)
+    part_recv: Any  # [N] i32 partition recv bitmask (default 1)
+    tick_skew_num: Any  # [N] i32 tick-skip probability
+    crash_at: Any  # [N] i32 absolute crash round (NEVER = alive)
+    restart_at: Any  # [N] i32 absolute restart round (NEVER = stays down)
+    heal_round: Any  # [] i32 recovery probe armed from this round (NEVER = off)
+    base_committed: Any  # [N] i32 committed captured at the heal round
+    reelect_round: Any  # [N] i32 first round with a leader post-heal (NEVER)
+    recommit_round: Any  # [N] i32 first round committed > base post-heal
+    n_reelected: Any  # [] i32 groups with reelect_round recorded (recount)
+    n_recommitted: Any  # [] i32 groups with recommit_round recorded
+
+
+# Host-settable knob columns (FusedCluster.set_chaos) and the probe columns
+# the host reads back after a heal phase.
+SETTABLE = (
+    "drop_num",
+    "dup_num",
+    "part_send",
+    "part_recv",
+    "tick_skew_num",
+    "crash_at",
+    "restart_at",
+    "heal_round",
+    "base_committed",
+    "reelect_round",
+    "recommit_round",
+)
+PROBE_FIELDS = (
+    "round",
+    "heal_round",
+    "base_committed",
+    "reelect_round",
+    "recommit_round",
+    "n_reelected",
+    "n_recommitted",
+)
+
+
+def chaos_enabled() -> bool:
+    """Read RAFT_TPU_CHAOS lazily (default OFF — chaos is opt-in, unlike
+    metrics); the value is baked into each cluster at construction."""
+    return os.environ.get("RAFT_TPU_CHAOS", "0") not in ("0", "", "off")
+
+
+def probability(p: float) -> int:
+    """Float probability -> fixed-point 2^-16 knob value."""
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"probability {p} outside [0, 1]")
+    return min(P_ONE, int(round(p * P_ONE)))
+
+
+def init_chaos(n: int, v: int, seed: int = 1) -> ChaosState:
+    """All-quiet chaos state for N = G*V lanes. The PRNG stream id derives
+    from the cluster seed (+ RAFT_TPU_CHAOS_SEED offset), so sibling blocks
+    of a BlockedFusedCluster (seed + 7919*i) decorrelate automatically and
+    two same-seed processes replay the identical fault timeline."""
+    if n % v:
+        raise ValueError("chaos plane requires group-aligned lanes (N = G*V)")
+    base = int(os.environ.get("RAFT_TPU_CHAOS_SEED", "0") or 0)
+    sid = (((seed + base) * 2654435761) ^ 0x5EEDC0DE) & 0xFFFFFFFF
+
+    # every field gets its OWN buffer: the carry is donated whole and XLA
+    # rejects one buffer in two donated positions (see state.init_state)
+    def zn():
+        return jnp.zeros((n,), I32)
+
+    return ChaosState(
+        seed=jnp.asarray(sid, U32),
+        round=jnp.zeros((), I32),
+        drop_num=jnp.zeros((n, v), I32),
+        dup_num=jnp.zeros((n, v), I32),
+        part_send=jnp.ones((n,), I32),
+        part_recv=jnp.ones((n,), I32),
+        tick_skew_num=zn(),
+        crash_at=jnp.full((n,), NEVER, I32),
+        restart_at=jnp.full((n,), NEVER, I32),
+        heal_round=jnp.asarray(NEVER, I32),
+        base_committed=zn(),
+        reelect_round=jnp.full((n,), NEVER, I32),
+        recommit_round=jnp.full((n,), NEVER, I32),
+        n_reelected=jnp.zeros((), I32),
+        n_recommitted=jnp.zeros((), I32),
+    )
+
+
+def with_columns(chaos: ChaosState, **cols) -> ChaosState:
+    """Host setter: overwrite SETTABLE columns ([N]/[N,V] arrays or scalars
+    to broadcast). Each written column is a fresh buffer (donation-safe)."""
+    import numpy as np
+
+    upd = {}
+    for k, val in cols.items():
+        if k not in SETTABLE:
+            raise KeyError(f"not a settable chaos column: {k!r} (see SETTABLE)")
+        cur = getattr(chaos, k)
+        arr = jnp.asarray(np.asarray(val), dtype=cur.dtype)
+        if arr.shape != cur.shape:
+            arr = jnp.broadcast_to(arr, cur.shape) + jnp.zeros((), cur.dtype)
+        upd[k] = arr
+    return dataclasses.replace(chaos, **upd) if upd else chaos
+
+
+# --------------------------------------------------------------------------
+# counter-based PRNG
+
+
+def _mix(x):
+    """32-bit finalizer (lowbias32): full-avalanche hash of the counter."""
+    x = x ^ (x >> U32(16))
+    x = x * U32(0x7FEB352D)
+    x = x ^ (x >> U32(15))
+    x = x * U32(0x846CA68B)
+    x = x ^ (x >> U32(16))
+    return x
+
+
+def chaos_bits(seed, rnd, idx, salt: int):
+    """u32 hash of (seed, round, site index, salt) — stateless, so the
+    draw at a given (round, site) never depends on dispatch chunking."""
+    x = (
+        idx.astype(U32) * U32(0x9E3779B9)
+        + rnd.astype(U32) * U32(0x85EBCA6B)
+        + seed
+        + U32(salt) * U32(0xC2B2AE35)
+    )
+    return _mix(x)
+
+
+def _decide(seed, rnd, idx, salt: int, num):
+    """True with probability num / 2^16 (num >= P_ONE: always)."""
+    u16 = (chaos_bits(seed, rnd, idx, salt) & U32(0xFFFF)).astype(I32)
+    return u16 < num
+
+
+# --------------------------------------------------------------------------
+# round hooks (called from ops/fused.py fused_rounds when chaos is not None)
+
+
+def _peer_cols(x, v: int):
+    """[N] per-lane column -> [N, V] where cell [d, i] reads the value of
+    d's group-member slot i (the aligned_peer_mute broadcast, any dtype)."""
+    n = x.shape[0]
+    g = n // v
+    return jnp.broadcast_to(x.reshape(g, 1, v), (g, v, v)).reshape(n, v)
+
+
+def _group_any(x, v: int):
+    """[N] bool -> [N] bool, true everywhere in a group where any lane is."""
+    n = x.shape[0]
+    g = n // v
+    a = x.reshape(g, v).any(axis=1)
+    return jnp.broadcast_to(a[:, None], (g, v)).reshape(n)
+
+
+def begin_round(chaos: ChaosState, state, inb, ops, v: int):
+    """Pre-step fault application: crash-window wipes, inbound cuts
+    (drop/partition/crash), host-op suppression, tick mask. `state` and
+    `inb` are the FAT (i32) round inputs, `inb` already routed.
+
+    Returns (chaos, state, inb, ops, tick_mask)."""
+    n = state.id.shape[0]
+    rnd = chaos.round
+    seed = chaos.seed
+    lane = jnp.arange(n, dtype=U32)
+    edge = jnp.arange(n * v, dtype=U32).reshape(n, v)
+
+    # crash/restart: wipe volatile state at BOTH window edges — at crash so
+    # the dead lane holds no leadership (an ex-leader must not keep
+    # appending via auto-propose while down), at restart so it rejoins as
+    # the fresh-boot follower restore_from_wal would produce
+    wipe = (rnd == chaos.crash_at) | (rnd == chaos.restart_at)
+    state = wipe_volatile(state, wipe)
+    crashed = (rnd >= chaos.crash_at) & (rnd < chaos.restart_at)
+
+    # edge admission: partition bitmasks + either endpoint dead.
+    # inb cell [d, i] carries the message from d's group-member slot i.
+    allowed = (_peer_cols(chaos.part_send, v) & chaos.part_recv[:, None]) != 0
+    base_cut = ~allowed | crashed[:, None] | _peer_cols(crashed, v)
+
+    def cut(chan, salt: int):
+        c = base_cut | _decide(seed, rnd, edge, salt, chaos.drop_num)
+        return dataclasses.replace(
+            chan, kind=jnp.where(c, MT.MSG_NONE, chan.kind)
+        )
+
+    inb = dataclasses.replace(
+        inb,
+        rep=cut(inb.rep, _SALT_DROP_REP),
+        hb=cut(inb.hb, _SALT_DROP_HB),
+        vote=cut(inb.vote, _SALT_DROP_VOTE),
+        vresp=cut(inb.vresp, _SALT_DROP_VRESP),
+        # the self slot is a local ack, not network traffic: cut only on
+        # crash (the dead process loses it), never dropped/partitioned
+        self_=dataclasses.replace(
+            inb.self_, kind=jnp.where(crashed, MT.MSG_NONE, inb.self_.kind)
+        ),
+    )
+
+    # a dead lane takes no host injections
+    ops = jax.tree.map(
+        lambda x: jnp.where(crashed, jnp.zeros_like(x), x), ops
+    )
+
+    skip = _decide(seed, rnd, lane, _SALT_TICK_SKEW, chaos.tick_skew_num)
+    tick_mask = ~crashed & ~skip
+
+    # recovery probe baseline: committed as of the heal round's start
+    # (the segment dispatched at heal_round runs with the fault lifted)
+    chaos = dataclasses.replace(
+        chaos,
+        base_committed=jnp.where(
+            rnd == chaos.heal_round, state.committed, chaos.base_committed
+        ),
+    )
+    return chaos, state, inb, ops, tick_mask
+
+
+def end_round(chaos: ChaosState, state, prev_fab, out_fab, v: int):
+    """Post-step fault application: duplicate redelivery + recovery-probe
+    recording. `state` is the post-round state; `prev_fab` the FAT outbox
+    that was delivered this round, `out_fab` the FAT outbox just produced.
+
+    Returns (chaos, out_fab)."""
+    n = state.id.shape[0]
+    rnd = chaos.round
+    edge = jnp.arange(n * v, dtype=U32).reshape(n, v)
+
+    # duplicate delivery: re-inject last round's outbox cells into empty
+    # slots of the new outbox — the message rides one extra round and the
+    # receiver sees it twice, with zero extra resident fabric memory
+    def dup(prev, new, salt: int):
+        keep = (
+            (prev.kind != MT.MSG_NONE)
+            & (new.kind == MT.MSG_NONE)
+            & _decide(chaos.seed, rnd, edge, salt, chaos.dup_num)
+        )
+        return jax.tree.map(
+            lambda a, b: jnp.where(
+                keep[..., None] if b.ndim == 3 else keep, a, b
+            ),
+            prev,
+            new,
+        )
+
+    out_fab = dataclasses.replace(
+        out_fab,
+        rep=dup(prev_fab.rep, out_fab.rep, _SALT_DUP_REP),
+        hb=dup(prev_fab.hb, out_fab.hb, _SALT_DUP_HB),
+        vote=dup(prev_fab.vote, out_fab.vote, _SALT_DUP_VOTE),
+        vresp=dup(prev_fab.vresp, out_fab.vresp, _SALT_DUP_VRESP),
+    )
+
+    # recovery probe: record, per group, the first post-heal round with a
+    # leader and the first with committed past the heal baseline. Updates
+    # are group-uniform (the any() is group-broadcast), so the counts
+    # recount exactly as lane-sums / v.
+    armed = rnd >= chaos.heal_round
+    has_leader = _group_any(state.state == StateType.LEADER, v)
+    reelect = jnp.where(
+        armed & (chaos.reelect_round == NEVER) & has_leader,
+        rnd,
+        chaos.reelect_round,
+    )
+    committed_past = _group_any(state.committed > chaos.base_committed, v)
+    recommit = jnp.where(
+        armed & (chaos.recommit_round == NEVER) & committed_past,
+        rnd,
+        chaos.recommit_round,
+    )
+    chaos = dataclasses.replace(
+        chaos,
+        reelect_round=reelect,
+        recommit_round=recommit,
+        # absolute recounts (not deltas): idempotent across rounds, and a
+        # sharded run turns them global with one psum per dispatch
+        n_reelected=jnp.sum((reelect != NEVER).astype(I32)) // v,
+        n_recommitted=jnp.sum((recommit != NEVER).astype(I32)) // v,
+        round=rnd + 1,
+    )
+    return chaos, out_fab
+
+
+def rebase(chaos: ChaosState, mask, delta) -> ChaosState:
+    """Keep the recovery baseline coherent across an index-space rebase
+    (FusedCluster.rebase_groups): base_committed holds absolute committed
+    values, so it shifts with its lanes (same contract as
+    metrics.rebase_samples)."""
+    return dataclasses.replace(
+        chaos,
+        base_committed=jnp.where(
+            mask, chaos.base_committed - delta, chaos.base_committed
+        ),
+    )

@@ -1,0 +1,490 @@
+"""Host half of the chaos plane: scenario DSL, segment driver, recovery SLO.
+
+`ChaosSchedule` describes a fault scenario ONCE, in absolute chaos rounds
+(the device round counter never resets), and compiles it into per-segment
+device mask columns — the analog of the reference's rafttest scenario
+scripts (rafttest/network.go + raft_test.go fault fixtures), but batched:
+one schedule drives faults across thousands of groups in lockstep.
+
+Compilation model: the timeline splits at every event boundary and heal
+round (`segments`), and `columns(start)` rebuilds the FULL knob column set
+active at a segment's first round. Segment semantics are therefore exact
+regardless of how the driver chunks dispatches, and re-running the same
+schedule against the same seed replays a bit-identical fault timeline
+(the device PRNG is counter-based — chaos/device.py).
+
+`ChaosRunner` drives any FusedCluster-shaped engine (FusedCluster,
+BlockedFusedCluster, ShardedFusedCluster) segment by segment: write
+columns, dispatch, check the batched election-safety invariant, arm the
+heal probe at each heal round and collect per-group ticks-to-reelection /
+ticks-to-first-commit into `RecoveryProbe`, whose snapshot speaks the
+metrics-plane schema (raft_tpu/metrics/host.py) so the same exporters
+apply.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+from raft_tpu.chaos.device import NEVER, probability
+
+
+@dataclasses.dataclass
+class _Event:
+    kind: str  # "partition" | "drop" | "dup" | "skew" | "kill"
+    start: int
+    end: int  # exclusive; for "kill": the restart round
+    groups: tuple = ()
+    lanes: tuple | None = None  # "kill": explicit lanes (None = leaders)
+    members: tuple = (0,)
+    prob: float = 1.0
+    asymmetric: bool = False
+
+
+class ChaosSchedule:
+    """Fault scenario over G groups x V voters. Every builder returns self
+    for chaining; rounds are absolute. Scenarios that end in a heal
+    register a recovery-probe phase for the affected groups."""
+
+    def __init__(self, n_groups: int, n_voters: int):
+        self.g, self.v = n_groups, n_voters
+        self.events: list[_Event] = []
+        # heal phases: round -> set of groups expected to recover by then
+        self.heals: dict[int, set] = {}
+
+    # -- scenario builders -------------------------------------------------
+
+    def _groups(self, groups):
+        gs = tuple(int(x) for x in (range(self.g) if groups is None else groups))
+        for g in gs:
+            if not 0 <= g < self.g:
+                raise ValueError(f"group {g} outside 0..{self.g - 1}")
+        return gs
+
+    def _heal(self, at: int, groups):
+        self.heals.setdefault(int(at), set()).update(groups)
+
+    def partition(self, groups, at, duration, members=(0,), asymmetric=False):
+        """Cut member slots `members` of each group off the rest for
+        [at, at+duration): symmetric by default; asymmetric=True lets the
+        minority's packets OUT while it receives none (one-way link)."""
+        gs = self._groups(groups)
+        if not 0 < len(members) < self.v:
+            raise ValueError("partition must leave both sides non-empty")
+        self.events.append(
+            _Event(
+                "partition", at, at + duration, groups=gs,
+                members=tuple(members), asymmetric=asymmetric,
+            )
+        )
+        self._heal(at + duration, gs)
+        return self
+
+    def rolling_partitions(self, at, waves, duration, settle, members=(0,)):
+        """Partition wave w covers group slice w of `waves` equal slices,
+        back-to-back with `settle` recovery rounds between heals."""
+        per = self.g // waves
+        if per < 1:
+            raise ValueError("more waves than groups")
+        for w in range(waves):
+            gs = range(w * per, self.g if w == waves - 1 else (w + 1) * per)
+            self.partition(gs, at + w * (duration + settle), duration, members)
+        return self
+
+    def flap(self, groups, at, cycles, down=3, up=3, members=(0,)):
+        """Flapping link: `cycles` x (down rounds cut, up rounds healthy).
+        One probe phase at the final heal (intermediate heals are part of
+        the fault, not a recovery target)."""
+        gs = self._groups(groups)
+        for k in range(cycles):
+            s = at + k * (down + up)
+            self.events.append(
+                _Event("partition", s, s + down, groups=gs, members=tuple(members))
+            )
+        self._heal(at + cycles * (down + up) - up, gs)
+        return self
+
+    def drop(self, groups, at, duration, prob, members=None):
+        """Background message loss on every inbound edge of the groups
+        (members=None), or on both directions of the given member slots.
+        No probe phase: lossy links are degradation, not an outage."""
+        self.events.append(
+            _Event(
+                "drop", at, at + duration, groups=self._groups(groups),
+                members=None if members is None else tuple(members), prob=prob,
+            )
+        )
+        return self
+
+    def duplicate(self, groups, at, duration, prob):
+        """Duplicate-delivery probability on the groups' outbound edges."""
+        self.events.append(
+            _Event("dup", at, at + duration, groups=self._groups(groups), prob=prob)
+        )
+        return self
+
+    def skew(self, groups, at, duration, prob, members=(0,)):
+        """Clock skew: member slots probabilistically skip ticks."""
+        self.events.append(
+            _Event(
+                "skew", at, at + duration, groups=self._groups(groups),
+                members=tuple(members), prob=prob,
+            )
+        )
+        return self
+
+    def kill(self, lanes, at, down):
+        """Crash explicit global lanes at `at`, restart at `at+down`
+        (down=0: instant restart — volatile wipe only)."""
+        lanes = tuple(int(x) for x in lanes)
+        gs = sorted({ln // self.v for ln in lanes})
+        self.events.append(
+            _Event("kill", at, at + down, groups=tuple(gs), lanes=lanes)
+        )
+        self._heal(at + down, gs)
+        return self
+
+    def kill_leaders(self, groups, at, down):
+        """Leader-targeted kill: the lanes are resolved AT round `at` from
+        the live cluster (ChaosRunner resolves via leader_lanes(); still
+        deterministic — the leader set at a given round is a pure function
+        of the seeds). Groups with no leader at `at` are skipped."""
+        gs = self._groups(groups)
+        self.events.append(_Event("kill", at, at + down, groups=gs, lanes=None))
+        self._heal(at + down, gs)
+        return self
+
+    def staggered_restart(self, groups, at, down=2, gap=4, members=None):
+        """Rolling restart: member slot m of each group crash-restarts in
+        its own window starting at `at + m*gap` — at most one member of a
+        group down at a time when gap >= down."""
+        gs = self._groups(groups)
+        members = tuple(range(self.v)) if members is None else tuple(members)
+        last = at
+        for j, m in enumerate(members):
+            s = at + j * gap
+            lanes = tuple(g * self.v + m for g in gs)
+            self.events.append(
+                _Event("kill", s, s + down, groups=gs, lanes=lanes)
+            )
+            last = s + down
+        self._heal(last, gs)
+        return self
+
+    # -- compilation -------------------------------------------------------
+
+    def horizon(self) -> int:
+        ends = [e.end for e in self.events] + list(self.heals)
+        return max(ends, default=0)
+
+    def segments(self, settle: int) -> list[tuple[int, int]]:
+        """[start, end) timeline pieces cut at every event edge and heal
+        round, plus `settle` trailing rounds after the last edge so the
+        final heal phase has room to record its recovery."""
+        stop = self.horizon() + settle
+        cuts = {0, stop}
+        for e in self.events:
+            cuts.update((e.start, e.end))
+        cuts.update(self.heals)
+        cuts = sorted(c for c in cuts if 0 <= c <= stop)
+        return [(a, b) for a, b in zip(cuts, cuts[1:]) if b > a]
+
+    def heal_groups_at(self, rnd: int) -> tuple:
+        return tuple(sorted(self.heals.get(rnd, ())))
+
+    def columns(self, start: int) -> dict:
+        """The full device knob column set in force at round `start`
+        (a segment boundary). Kill events program the earliest crash
+        cycle still ahead of (or spanning) `start` per lane; overlapping
+        partitions of one group: the later-added event wins."""
+        n, v = self.g * self.v, self.v
+        drop = np.zeros((n, v), np.int32)
+        dup = np.zeros((n, v), np.int32)
+        skew = np.zeros((n,), np.int32)
+        send = np.ones((n,), np.int32)
+        recv = np.ones((n,), np.int32)
+        crash = np.full((n,), NEVER, np.int32)
+        restart = np.full((n,), NEVER, np.int32)
+        for e in self.events:
+            if e.kind == "kill":
+                if e.end <= start and e.end != e.start:
+                    continue  # cycle fully behind this segment
+                if e.start == e.end and e.start < start:
+                    continue  # instant restart already fired
+                lanes = e.lanes
+                if lanes is None:
+                    if start < e.start:
+                        continue  # leaders not resolvable yet
+                    # set by resolve_kills at e.start (ChaosRunner)
+                    lanes = getattr(e, "resolved", ())
+                for ln in lanes:
+                    if e.start < crash[ln]:  # earliest upcoming cycle wins
+                        crash[ln], restart[ln] = e.start, e.end
+                continue
+            if not e.start <= start < e.end:
+                continue
+            p = probability(e.prob)
+            for g in e.groups:
+                lo = g * v
+                if e.kind == "partition":
+                    for m in e.members:
+                        # bit 1 = majority side, bit 2 = minority side;
+                        # asymmetric keeps bit 1 in the minority's SEND mask
+                        send[lo + m] = 3 if e.asymmetric else 2
+                        recv[lo + m] = 2
+                elif e.kind == "drop":
+                    if e.members is None:
+                        drop[lo : lo + v, :] = p
+                    else:
+                        for m in e.members:
+                            drop[lo + m, :] = p  # member's inbound
+                            drop[lo : lo + v, m] = p  # member's outbound
+                elif e.kind == "dup":
+                    dup[lo : lo + v, :] = p
+                elif e.kind == "skew":
+                    for m in e.members:
+                        skew[lo + m] = p
+        return dict(
+            drop_num=drop,
+            dup_num=dup,
+            tick_skew_num=skew,
+            part_send=send,
+            part_recv=recv,
+            crash_at=crash,
+            restart_at=restart,
+        )
+
+    def resolve_kills(self, start: int, leader_lanes) -> None:
+        """Pin leader-targeted kill events whose start is `start` to the
+        concrete leader lanes (callable -> [K] global lane array)."""
+        for e in self.events:
+            if e.kind == "kill" and e.lanes is None and e.start == start:
+                lanes = np.asarray(leader_lanes())
+                grp = lanes // self.v
+                keep = np.isin(grp, np.asarray(e.groups, grp.dtype))
+                e.resolved = tuple(int(x) for x in lanes[keep])
+
+
+# --------------------------------------------------------------------------
+# recovery probe
+
+
+class RecoveryProbe:
+    """Per-heal-phase recovery accounting: ticks-to-reelection and
+    ticks-to-first-commit per partitioned/killed group, folded into
+    metrics-plane-style le-bucket histograms. A group still unrecovered
+    when its phase is collected counts as an SLO violation."""
+
+    EDGES = (4, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256)
+
+    def __init__(self, tick_budget: int):
+        self.tick_budget = int(tick_budget)
+        self.phases: list[dict] = []
+        nb = len(self.EDGES) + 1
+        self._hist = {
+            "reelect": np.zeros(nb, np.int64),
+            "recommit": np.zeros(nb, np.int64),
+        }
+        self._sum = {"reelect": 0, "recommit": 0}
+        self._count = {"reelect": 0, "recommit": 0}
+        self.unrecovered = 0
+        self.over_budget = 0
+
+    def _fold(self, which: str, ticks: np.ndarray):
+        for t in ticks:
+            if t < 0:  # never recovered within the phase
+                self.unrecovered += 1
+                continue
+            if t > self.tick_budget:
+                self.over_budget += 1
+            b = len(self.EDGES)
+            for i, e in enumerate(self.EDGES):
+                if t <= e:
+                    b = i
+                    break
+            self._hist[which][b] += 1
+            self._sum[which] += int(t)
+            self._count[which] += 1
+
+    def observe(self, heal_round: int, groups, reelect, recommit):
+        """reelect/recommit: absolute device rounds per group (NEVER =
+        unrecovered). Ticks count from the heal round, 1-based: recovery
+        within the heal round itself is 1 tick."""
+        reelect = np.asarray(reelect, np.int64)
+        recommit = np.asarray(recommit, np.int64)
+        re_t = np.where(reelect == NEVER, -1, reelect - heal_round + 1)
+        co_t = np.where(recommit == NEVER, -1, recommit - heal_round + 1)
+        self._fold("reelect", re_t)
+        self._fold("recommit", co_t)
+        self.phases.append(
+            {
+                "heal_round": int(heal_round),
+                "groups": [int(g) for g in groups],
+                "reelect_ticks": re_t.tolist(),
+                "recommit_ticks": co_t.tolist(),
+            }
+        )
+
+    def ok(self) -> bool:
+        return self.unrecovered == 0 and self.over_budget == 0
+
+    def snapshot(self) -> dict:
+        """Metrics-plane-schema snapshot (metrics/host.py): counters +
+        le-bucket hists, merge-safe with merge_snapshots-style tooling."""
+        out = {
+            "counters": {
+                "chaos_phases": len(self.phases),
+                "chaos_groups_probed": self._count["reelect"]
+                + self.unrecovered,
+                "chaos_unrecovered": self.unrecovered,
+                "chaos_over_budget": self.over_budget,
+            },
+            "slo": {"tick_budget": self.tick_budget, "ok": self.ok()},
+            "phases": self.phases,
+        }
+        for which in ("reelect", "recommit"):
+            out[f"hist_{which}"] = {
+                "edges": list(self.EDGES),
+                "buckets": self._hist[which].tolist(),
+                "sum": self._sum[which],
+                "count": self._count[which],
+            }
+        return out
+
+
+# --------------------------------------------------------------------------
+# runner
+
+
+class ChaosRunner:
+    """Drive a cluster through a ChaosSchedule. Works with any engine
+    exposing set_chaos/chaos_columns/run/leader_lanes/check_no_errors
+    (FusedCluster, BlockedFusedCluster, ShardedFusedCluster).
+
+    settle: trailing rounds appended after the last event so the final
+    heal phase can recover (default: 2 * tick_budget)."""
+
+    def __init__(
+        self,
+        cluster,
+        schedule: ChaosSchedule,
+        *,
+        tick_budget: int = 64,
+        settle: int | None = None,
+        check_invariants: bool = True,
+        **run_kw,
+    ):
+        if getattr(cluster, "chaos", None) is None and not getattr(
+            cluster, "chaos_enabled", False
+        ):
+            raise RuntimeError(
+                "cluster has no chaos plane (construct under RAFT_TPU_CHAOS=1)"
+            )
+        if (cluster.g, cluster.v) != (schedule.g, schedule.v):
+            raise ValueError("schedule geometry != cluster geometry")
+        self.cluster = cluster
+        self.schedule = schedule
+        self.probe = RecoveryProbe(tick_budget)
+        self.settle = 2 * tick_budget if settle is None else settle
+        self.check_invariants = check_invariants
+        self.run_kw = dict(run_kw)
+        self.run_kw.setdefault("auto_propose", True)
+        # without compaction the log window fills after ~window commits and
+        # auto-propose stalls — the recommit probe would then report a
+        # liveness failure that is really just a full window. Soaks want
+        # the same steady-state the long benches run (auto_compact_lag=8);
+        # pass auto_compact_lag=None explicitly to disable.
+        self.run_kw.setdefault("auto_compact_lag", 8)
+
+    def _collect(self, phases):
+        """Read the recovery columns ONCE and fold every pending phase into
+        the probe (each lane stores the ABSOLUTE round of its first
+        post-heal recovery, so one late read serves all phases)."""
+        if not phases:
+            return
+        cols = self.cluster.chaos_columns("reelect_round", "recommit_round")
+        re = cols["reelect_round"].reshape(self.schedule.g, self.schedule.v)
+        co = cols["recommit_round"].reshape(self.schedule.g, self.schedule.v)
+        for heal_round, groups in phases:
+            gs = np.asarray(groups, np.int64)
+            self.probe.observe(heal_round, groups, re[gs, 0], co[gs, 0])
+
+    def run(self) -> dict:
+        """Execute the whole schedule; returns the probe snapshot.
+
+        Probe collection is DEFERRED: phases stay armed until the end of
+        the run (or until one of their groups is re-faulted), so heals
+        that land close together each still get the full remaining run to
+        recover — collecting at the very next heal would clip the earlier
+        phase's probe window to the gap between heals."""
+        pending: list[tuple[int, tuple[int, ...]]] = []
+        for a, b in self.schedule.segments(self.settle):
+            self.schedule.resolve_kills(a, self.cluster.leader_lanes)
+            cols = self.schedule.columns(a)
+            heal_groups = self.schedule.heal_groups_at(a)
+            if heal_groups:
+                # a group being healed AGAIN (it was re-faulted meanwhile)
+                # ends its probe window here — but ONLY that group: the
+                # rest of its phase stays pending with the full run left
+                # to recover
+                hv = set(heal_groups)
+                clipped, still = [], []
+                for hr, gs in pending:
+                    inter = tuple(g for g in gs if g in hv)
+                    rest = tuple(g for g in gs if g not in hv)
+                    if inter:
+                        clipped.append((hr, inter))
+                    if rest:
+                        still.append((hr, rest))
+                self._collect(clipped)
+                pending = still
+                # arm the probe for the healing groups ONLY: their lanes'
+                # recovery columns reset to NEVER while every other
+                # group's in-flight or recorded rounds stay put; the
+                # device captures base_committed at round == heal_round
+                cur = self.cluster.chaos_columns(
+                    "reelect_round", "recommit_round"
+                )
+                re = np.array(cur["reelect_round"], np.int32)
+                co = np.array(cur["recommit_round"], np.int32)
+                lanes = (
+                    np.asarray(heal_groups, np.int64)[:, None]
+                    * self.schedule.v
+                    + np.arange(self.schedule.v)
+                ).ravel()
+                re[lanes] = NEVER
+                co[lanes] = NEVER
+                cols["heal_round"] = a
+                cols["reelect_round"] = re
+                cols["recommit_round"] = co
+                pending.append((a, heal_groups))
+            self.cluster.set_chaos(**cols)
+            self.cluster.run(b - a, **self.run_kw)
+            if self.check_invariants:
+                from raft_tpu.testing.invariants import election_safety_batched
+
+                self.cluster.check_no_errors()
+                election_safety_batched(self.cluster)
+        self._collect(pending)
+        return self.probe.snapshot()
+
+
+def trajectory_digest(cluster) -> str:
+    """SHA-256 over every raft-state and chaos-probe array of the cluster —
+    the bit-identity oracle for same-seed chaos runs. Leaf order is the
+    registered dataclass field order, so the digest is stable across
+    processes."""
+    import jax
+
+    h = hashlib.sha256()
+    blocks = getattr(cluster, "blocks", None) or [cluster]
+    for b in blocks:
+        for leaf in jax.tree.leaves(b.state):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        if getattr(b, "chaos", None) is not None:
+            for leaf in jax.tree.leaves(b.chaos):
+                h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()

@@ -63,6 +63,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.chaos import device as chmod
 from raft_tpu.metrics import device as metmod
 from raft_tpu.ops import log as lg
 from raft_tpu.ops import onehot as ohm
@@ -640,6 +641,7 @@ def fused_round(
     do_tick: bool = True,
     auto_propose: bool = False,
     auto_compact_lag: int | None = None,
+    tick_mask: Any = None,
     metrics: "metmod.MetricsState | None" = None,
 ):
     """One complete synchronous round for every lane. Returns the next state
@@ -650,7 +652,12 @@ def fused_round(
 
     peer_mute: optional [N, V] mute bits of each lane's group members;
     defaults to the aligned reshape of `mute` — REQUIRED on straddling
-    shards (straddle_peer_mute), where lanes are not group-aligned."""
+    shards (straddle_peer_mute), where lanes are not group-aligned.
+
+    tick_mask: optional [N] bool from the chaos plane (raft_tpu/chaos/) —
+    lanes with False skip this round's tick entirely (crashed lanes,
+    clock-skew skips). None (the default) adds ZERO ops to the trace, the
+    same compile-time-elision contract as `metrics`."""
     n, v = state.prs_id.shape
     e = inb.rep.ent_term.shape[-1]
     out = ChannelOutbox(state, e)
@@ -679,15 +686,24 @@ def fused_round(
         is_leader0 = state.state == StateType.LEADER
         ee = state.election_elapsed + 1
         he = jnp.where(is_leader0, state.heartbeat_elapsed + 1, state.heartbeat_elapsed)
+        if tick_mask is not None:
+            # chaos plane: a masked-out lane's clock does not advance
+            ee = jnp.where(tick_mask, ee, state.election_elapsed)
+            he = jnp.where(tick_mask, he, state.heartbeat_elapsed)
         fire_hup = (
             ~is_leader0
             & stepmod.promotable(state)
             & (ee >= state.randomized_election_timeout)
         )
         lead_etick = is_leader0 & (ee >= state.cfg.election_tick)
+        if tick_mask is not None:
+            fire_hup = fire_hup & tick_mask
+            lead_etick = lead_etick & tick_mask
         fire_cq = lead_etick & state.cfg.check_quorum
         ee = jnp.where(fire_hup | lead_etick, 0, ee)
         fire_beat = is_leader0 & (he >= state.cfg.heartbeat_tick)
+        if tick_mask is not None:
+            fire_beat = fire_beat & tick_mask
         he = jnp.where(fire_beat, 0, he)
         state = dataclasses.replace(
             state,
@@ -1534,6 +1550,7 @@ def fused_rounds(
     ops_first_round_only: bool = True,
     straddle: StraddleSpec | None = None,
     metrics: "metmod.MetricsState | None" = None,
+    chaos: "chmod.ChaosState | None" = None,
 ):
     """n_rounds fused rounds in one dispatch. `ops` applies to the first
     round only (one-shot injections) unless ops_first_round_only=False.
@@ -1549,9 +1566,20 @@ def fused_rounds(
 
     metrics: optional metrics carry (raft_tpu/metrics/); when set the
     return is (state, fab, metrics) and the carry threads through the scan
-    (already-scalar counters — no per-lane state leaves the device)."""
+    (already-scalar counters — no per-lane state leaves the device).
+
+    chaos: optional chaos carry (raft_tpu/chaos/); when set, faults apply
+    around every round (drops/partitions/crashes before the step,
+    duplicates + recovery probing after) and the carry is appended to the
+    return tuple. None keeps every fault op out of the trace, like
+    metrics=None. Requires group-aligned lanes (no straddle)."""
     from raft_tpu.state import fat_state, slim_state
 
+    if chaos is not None and straddle is not None:
+        raise ValueError(
+            "chaos plane needs group-aligned lanes; straddling shards are "
+            "not supported (its group reductions reshape [N] -> [G, V])"
+        )
     state = slim_state(state)
     fab = slim_fabric(fab)
     peer_mute = None
@@ -1565,7 +1593,7 @@ def fused_rounds(
             peer_mute = aligned_peer_mute(mute, v)
 
     def body(carry, i):
-        st, f, met = carry
+        st, f, met, ch = carry
         o = ops
         if ops_first_round_only:
             first = i == 0
@@ -1575,14 +1603,20 @@ def fused_rounds(
                 ),
                 ops,
             )
+        st_fat = fat_state(st)
+        f_fat = fat_fabric(f)
         if straddle is None:
-            inb = route_fabric(fat_fabric(f), v, mute, peer_mute=peer_mute)
+            inb = route_fabric(f_fat, v, mute, peer_mute=peer_mute)
         else:
-            inb = route_fabric_straddle(
-                fat_fabric(f), v, mute, straddle, peer_mute
+            inb = route_fabric_straddle(f_fat, v, mute, straddle, peer_mute)
+        tick_mask = None
+        if ch is not None:
+            # pre-step faults: crash wipes, inbound cuts, op suppression
+            ch, st_fat, inb, o, tick_mask = chmod.begin_round(
+                ch, st_fat, inb, o, v
             )
         res = fused_round(
-            fat_state(st),
+            st_fat,
             inb,
             o,
             mute,
@@ -1590,23 +1624,31 @@ def fused_rounds(
             do_tick=do_tick,
             auto_propose=auto_propose,
             auto_compact_lag=auto_compact_lag,
+            tick_mask=tick_mask,
             metrics=met,
         )
-        st, f = res[0], res[1]
+        st, f2 = res[0], res[1]
         met = res[2] if met is not None else None
-        return (slim_state(st), slim_fabric(f), met), None
+        if ch is not None:
+            # post-step faults: duplicate redelivery (re-injects last
+            # round's outbox cells), recovery probing, round advance
+            ch, f2 = chmod.end_round(ch, st, f_fat, f2, v)
+        return (slim_state(st), slim_fabric(f2), met, ch), None
 
-    # a None metrics slot is an empty pytree: the scan carry shape is
-    # unchanged when the plane is off
-    (state, fab, metrics), _ = jax.lax.scan(
+    # a None metrics/chaos slot is an empty pytree: the scan carry shape
+    # is unchanged when a plane is off
+    (state, fab, metrics, chaos), _ = jax.lax.scan(
         body,
-        (state, fab, metrics),
+        (state, fab, metrics, chaos),
         jnp.arange(n_rounds, dtype=I32),
         unroll=min(_SCAN_UNROLL, n_rounds),
     )
-    if metrics is None:
-        return state, fab
-    return state, fab, metrics
+    res = (state, fab)
+    if metrics is not None:
+        res += (metrics,)
+    if chaos is not None:
+        res += (chaos,)
+    return res
 
 
 _FUSED_STATIC = (
@@ -1629,7 +1671,7 @@ _fused_rounds_jit = jax.jit(
     fused_rounds,
     static_argnames=_FUSED_STATIC,
     donate_argnums=(0, 1),
-    donate_argnames=("metrics",),
+    donate_argnames=("metrics", "chaos"),
 )
 
 # copying twin: inputs survive the dispatch (stale host references stay
@@ -1701,6 +1743,16 @@ class FusedCluster:
             from raft_tpu.metrics.host import CounterAccumulator
 
             self._metrics_acc = CounterAccumulator()
+        # chaos plane (raft_tpu/chaos/): RAFT_TPU_CHAOS is read at
+        # construction (default OFF); chaos=None keeps every fault op out
+        # of the jaxpr — asserted by tests/test_chaos.py. The fault-PRNG
+        # stream derives from this cluster's seed, so sibling blocks of a
+        # BlockedFusedCluster decorrelate like their election timeouts do.
+        self.chaos = (
+            chmod.init_chaos(n, n_voters, seed=seed)
+            if chmod.chaos_enabled()
+            else None
+        )
 
     # -- driving ----------------------------------------------------------
 
@@ -1735,6 +1787,7 @@ class FusedCluster:
                     auto_compact_lag=auto_compact_lag,
                     ops_first_round_only=ops_first_round_only,
                     metrics=self.metrics,
+                    chaos=self.chaos,
                 )
         else:
             res = _fused_rounds_nodonate_jit(
@@ -1749,10 +1802,15 @@ class FusedCluster:
                 auto_compact_lag=auto_compact_lag,
                 ops_first_round_only=ops_first_round_only,
                 metrics=self.metrics,
+                chaos=self.chaos,
             )
         self.state, self.fab = res[0], res[1]
+        i = 2
         if self.metrics is not None:
-            self.metrics = res[2]
+            self.metrics = res[i]
+            i += 1
+        if self.chaos is not None:
+            self.chaos = res[i]
         if wal is not None:
             wal.push(self.state)
             if self._donate:
@@ -1787,6 +1845,28 @@ class FusedCluster:
         m = np.asarray(self.mute).copy()
         m[np.asarray(lanes, dtype=np.int64)] = on
         self.mute = jnp.asarray(m)
+
+    def set_chaos(self, **cols):
+        """Overwrite chaos-plane knob columns (chaos/device.py SETTABLE):
+        [N]/[N,V] arrays in this cluster's lane order, or scalars to
+        broadcast. Requires RAFT_TPU_CHAOS=1 at construction; the usual
+        driver is a ChaosSchedule (raft_tpu/chaos/schedule.py)."""
+        if self.chaos is None:
+            raise RuntimeError(
+                "chaos plane is off: construct under RAFT_TPU_CHAOS=1"
+            )
+        self.chaos = chmod.with_columns(self.chaos, **cols)
+
+    def chaos_columns(self, *names) -> dict:
+        """Read chaos columns back as numpy (default: the recovery-probe
+        set, chaos/device.py PROBE_FIELDS). Empty dict when the plane is
+        off."""
+        import numpy as np
+
+        if self.chaos is None:
+            return {}
+        names = names or chmod.PROBE_FIELDS
+        return {k: np.asarray(getattr(self.chaos, k)) for k in names}
 
     def rebase_groups(self, groups, delta: int | None = None) -> dict:
         """Re-key the index space of whole groups downward by a
@@ -1840,6 +1920,10 @@ class FusedCluster:
             self.metrics = metmod.rebase_samples(
                 self.metrics, jnp.asarray(mask), dj
             )
+        if self.chaos is not None:
+            # the recovery baseline holds absolute committed values — it
+            # shifts with its lanes like the latency samples above
+            self.chaos = chmod.rebase(self.chaos, jnp.asarray(mask), dj)
         return out
 
     @classmethod

@@ -104,8 +104,7 @@ def has_unapplied_conf_changes(state: RaftState):
     return (inrange & (state.log_type != 0)).any(axis=1)
 
 
-def _rng_next(rng):
-    return rng * jnp.uint32(1664525) + jnp.uint32(1013904223)
+from raft_tpu.state import rng_next as _rng_next  # shared with the crash wipe
 
 
 # --------------------------------------------------------------------------

@@ -379,6 +379,16 @@ class ShardedFusedCluster:
             # lane-reduced counters/hist/scalars replicate (shard_lanes
             # routes by leading dim)
             self.inner.metrics = jax.tree.map(shard_lanes, self.inner.metrics)
+        if self.inner.chaos is not None:
+            if straddle:
+                raise ValueError(
+                    "chaos + straddle is unsupported: the halo router does "
+                    "not thread the fault masks across shard boundaries "
+                    "(disable RAFT_TPU_CHAOS or straddle)"
+                )
+            # fault mask columns shard with their lanes; the seed/round/
+            # heal scalars and recovery tallies replicate
+            self.inner.chaos = jax.tree.map(shard_lanes, self.inner.chaos)
         self._no_ops = jax.tree.map(shard_lanes, no_ops(n))
         self._shard_lanes = shard_lanes
         self._cache = {}
@@ -398,40 +408,27 @@ class ShardedFusedCluster:
             )
         )
         met = self.inner.metrics
+        ch = self.inner.chaos
+        has_met, has_ch = met is not None, ch is not None
+        extras = [x for x in (met, ch) if x is not None]
         key = (rounds, do_tick, auto_propose, auto_compact_lag)
         if key not in self._cache:
-            if met is None:
-                fn = shard_map(
-                    lambda st, f, o, m: fused_rounds(
-                        st, f, o, m,
-                        v=self.v, n_rounds=rounds, do_tick=do_tick,
-                        auto_propose=auto_propose,
-                        auto_compact_lag=auto_compact_lag,
-                        straddle=self._spec,
-                    ),
-                    mesh=self.mesh,
-                    in_specs=(
-                        lane_specs(self.inner.state),
-                        lane_specs(self.inner.fab),
-                        lane_specs(self._no_ops),
-                        P("groups"),
-                    ),
-                    out_specs=(
-                        lane_specs(self.inner.state),
-                        lane_specs(self.inner.fab),
-                    ),
-                )
-            else:
-                from raft_tpu.metrics.device import MetricsState
 
-                def stepper(st, f, o, m, mt):
-                    st, f, mt2 = fused_rounds(
-                        st, f, o, m,
-                        v=self.v, n_rounds=rounds, do_tick=do_tick,
-                        auto_propose=auto_propose,
-                        auto_compact_lag=auto_compact_lag,
-                        straddle=self._spec, metrics=mt,
-                    )
+            def stepper(st, f, o, m, *ex):
+                mt = ex[0] if has_met else None
+                c = ex[int(has_met)] if has_ch else None
+                res = fused_rounds(
+                    st, f, o, m,
+                    v=self.v, n_rounds=rounds, do_tick=do_tick,
+                    auto_propose=auto_propose,
+                    auto_compact_lag=auto_compact_lag,
+                    straddle=self._spec, metrics=mt, chaos=c,
+                )
+                out = [res[0], res[1]]
+                j = 2
+                if has_met:
+                    mt2 = res[j]
+                    j += 1
                     # each shard accumulated ONLY its own lanes' events on
                     # top of the replicated running totals; one psum of the
                     # scalar deltas per dispatch (not per round) rebuilds
@@ -449,45 +446,85 @@ class ShardedFusedCluster:
                         # from the replicated input
                         round_ctr=mt.round_ctr + jnp.int32(rounds),
                     )
-                    return st, f, mt2
+                    out.append(mt2)
+                if has_ch:
+                    c2 = res[j]
+                    # the recovery tallies are absolute recounts over the
+                    # shard's own (group-aligned) lanes, so ONE psum per
+                    # dispatch rebuilds the exact replicated global count
+                    c2 = dataclasses.replace(
+                        c2,
+                        n_reelected=jax.lax.psum(c2.n_reelected, "groups"),
+                        n_recommitted=jax.lax.psum(
+                            c2.n_recommitted, "groups"
+                        ),
+                    )
+                    out.append(c2)
+                return tuple(out)
+
+            in_specs = [
+                lane_specs(self.inner.state),
+                lane_specs(self.inner.fab),
+                lane_specs(self._no_ops),
+                P("groups"),
+            ]
+            out_specs = [
+                lane_specs(self.inner.state),
+                lane_specs(self.inner.fab),
+            ]
+            if has_met:
+                from raft_tpu.metrics.device import MetricsState
 
                 met_specs = MetricsState(
                     counters=P(), hist=P(), lat_sum=P(), round_ctr=P(),
                     samp_index=P("groups"), samp_round=P("groups"),
                 )
-                fn = shard_map(
-                    stepper,
-                    mesh=self.mesh,
-                    in_specs=(
-                        lane_specs(self.inner.state),
-                        lane_specs(self.inner.fab),
-                        lane_specs(self._no_ops),
-                        P("groups"),
-                        met_specs,
-                    ),
-                    out_specs=(
-                        lane_specs(self.inner.state),
-                        lane_specs(self.inner.fab),
-                        met_specs,
-                    ),
-                    check_rep=False,
+                in_specs.append(met_specs)
+                out_specs.append(met_specs)
+            if has_ch:
+                from raft_tpu.chaos.device import ChaosState
+
+                ch_specs = ChaosState(
+                    seed=P(), round=P(),
+                    drop_num=P("groups"), dup_num=P("groups"),
+                    part_send=P("groups"), part_recv=P("groups"),
+                    tick_skew_num=P("groups"),
+                    crash_at=P("groups"), restart_at=P("groups"),
+                    heal_round=P(), base_committed=P("groups"),
+                    reelect_round=P("groups"), recommit_round=P("groups"),
+                    n_reelected=P(), n_recommitted=P(),
                 )
+                in_specs.append(ch_specs)
+                out_specs.append(ch_specs)
+            fn = shard_map(
+                stepper,
+                mesh=self.mesh,
+                in_specs=tuple(in_specs),
+                out_specs=tuple(out_specs),
+                **({"check_rep": False} if extras else {}),
+            )
             donate = ()
             if self._donate:
-                donate = (0, 1) if met is None else (0, 1, 4)
+                donate = (0, 1) + tuple(range(4, 4 + len(extras)))
             self._cache[key] = jax.jit(fn, donate_argnums=donate)
         with _no_persistent_cache(self._donate):
-            if met is None:
-                self.inner.state, self.inner.fab = self._cache[key](
-                    self.inner.state, self.inner.fab, ops, self.inner.mute
-                )
-            else:
-                self.inner.state, self.inner.fab, self.inner.metrics = (
-                    self._cache[key](
-                        self.inner.state, self.inner.fab, ops,
-                        self.inner.mute, met,
-                    )
-                )
+            res = self._cache[key](
+                self.inner.state, self.inner.fab, ops, self.inner.mute,
+                *extras,
+            )
+        self.inner.state, self.inner.fab = res[0], res[1]
+        j = 2
+        if has_met:
+            self.inner.metrics = res[j]
+            j += 1
+        if has_ch:
+            self.inner.chaos = res[j]
+
+    def set_chaos(self, **cols):
+        """Install chaos columns, then re-shard them over the mesh (the
+        inner setter materializes plain unsharded buffers)."""
+        self.inner.set_chaos(**cols)
+        self.inner.chaos = jax.tree.map(self._shard_lanes, self.inner.chaos)
 
     def __getattr__(self, name):
         return getattr(self.inner, name)

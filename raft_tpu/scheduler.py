@@ -222,6 +222,51 @@ class BlockedFusedCluster:
     def metrics_enabled(self) -> bool:
         return self.blocks[0].metrics is not None
 
+    @property
+    def chaos_enabled(self) -> bool:
+        return self.blocks[0].chaos is not None
+
+    def set_chaos(self, **cols):
+        """Install chaos columns addressed in GLOBAL lane order: [n]- or
+        [n, v]-leading arrays are sliced per block exactly like
+        prepare_ops; scalars (seed-salt-free fields like heal_round) are
+        broadcast to every block."""
+        if not self.chaos_enabled:
+            raise RuntimeError(
+                "chaos plane is off (RAFT_TPU_CHAOS=0); set it before "
+                "constructing the cluster"
+            )
+        n = self.g * self.v
+        for i, b in enumerate(self.blocks):
+            lo = i * self.lanes_per_block
+            per = {}
+            for name, val in cols.items():
+                xa = np.asarray(val)
+                if xa.ndim >= 1 and xa.shape[0] == n:
+                    per[name] = xa[lo : lo + self.lanes_per_block]
+                else:
+                    per[name] = xa
+            b.set_chaos(**per)
+
+    def chaos_columns(self, *names) -> dict:
+        """Aggregate chaos columns over all K blocks: per-lane columns are
+        concatenated in global lane order, the recovery tallies
+        (n_reelected / n_recommitted) are summed, other scalars (round,
+        heal_round — identical across blocks) come from block 0."""
+        if not self.chaos_enabled:
+            return {}
+        per = [b.chaos_columns(*names) for b in self.blocks]
+        out = {}
+        for name, v0 in per[0].items():
+            vals = [p[name] for p in per]
+            if np.ndim(v0) >= 1 and np.shape(v0)[0] == self.lanes_per_block:
+                out[name] = np.concatenate(vals)
+            elif name in ("n_reelected", "n_recommitted"):
+                out[name] = sum(int(x) for x in vals)
+            else:
+                out[name] = v0
+        return out
+
     def metrics_snapshot(self) -> dict | None:
         """One merged snapshot over all K resident blocks with ONE device
         sync: the K blocks' already-lane-reduced counter/hist vectors are

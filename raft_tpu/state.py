@@ -281,6 +281,83 @@ def draw_timeout(rng, election_tick):
     return (et + (rng >> jnp.uint32(16)) % et).astype(I32)
 
 
+def rng_next(rng):
+    """One step of the per-lane LCG (Numerical Recipes constants) — the
+    batched lockedRand (reference: raft.go:89-102). Shared by the in-kernel
+    reset (ops/step.py) and the crash wipe below."""
+    return rng * jnp.uint32(1664525) + jnp.uint32(1013904223)
+
+
+def wipe_volatile(state: RaftState, mask) -> RaftState:
+    """Crash-restart the masked lanes IN PLACE: everything the WAL streams
+    (runtime/wal.py WalStream.FIELDS — HardState, log metadata, membership,
+    cursors) plus the application snapshot origin survives; every volatile
+    field resets to the fresh-boot follower defaults of init_state, exactly
+    what FusedCluster.restore_from_wal produces when rebuilding a block
+    from its delta. Used by the chaos plane (raft_tpu/chaos/) for in-fabric
+    lane crashes; `stabled = last` holds because the fused engine persists
+    synchronously every round, so a crash loses nothing appended.
+
+    mask: [N] bool. The lane's PRNG advances one step and the randomized
+    election timeout redraws, so a restarted lane re-enters the election
+    lottery decorrelated from its pre-crash schedule. error_bits are
+    deliberately NOT wiped: they are the test oracle, not raft state, and
+    a pre-crash invariant violation must stay visible to the soaks."""
+    m = mask
+    mv = mask[:, None]
+    mvf = mask[:, None, None]
+    rng2 = jnp.where(m, rng_next(state.rng), state.rng)
+    rand2 = jnp.where(
+        m,
+        draw_timeout(rng2, state.cfg.election_tick).astype(
+            state.randomized_election_timeout.dtype
+        ),
+        state.randomized_election_timeout,
+    )
+    return dataclasses.replace(
+        state,
+        state=jnp.where(m, int(StateType.FOLLOWER), state.state),
+        lead=jnp.where(m, 0, state.lead),
+        lead_transferee=jnp.where(m, 0, state.lead_transferee),
+        uncommitted_size=jnp.where(m, 0, state.uncommitted_size),
+        election_elapsed=jnp.where(m, 0, state.election_elapsed),
+        heartbeat_elapsed=jnp.where(m, 0, state.heartbeat_elapsed),
+        randomized_election_timeout=rand2,
+        rng=rng2,
+        # durability covered everything streamed; applying rejoins applied
+        stabled=jnp.where(m, state.last, state.stabled),
+        applying=jnp.where(m, state.applied, state.applying),
+        pending_snap_index=jnp.where(m, 0, state.pending_snap_index),
+        pending_snap_term=jnp.where(m, 0, state.pending_snap_term),
+        snap_unavailable=jnp.where(m, False, state.snap_unavailable),
+        pr_match=jnp.where(mv, 0, state.pr_match),
+        pr_next=jnp.where(mv, 1, state.pr_next),
+        pr_state=jnp.where(mv, 0, state.pr_state),
+        pr_pending_snapshot=jnp.where(mv, 0, state.pr_pending_snapshot),
+        pr_recent_active=jnp.where(mv, False, state.pr_recent_active),
+        pr_msg_app_flow_paused=jnp.where(
+            mv, False, state.pr_msg_app_flow_paused
+        ),
+        votes=jnp.where(mv, 0, state.votes),
+        infl_index=jnp.where(mvf, 0, state.infl_index),
+        infl_bytes=jnp.where(mvf, 0, state.infl_bytes),
+        infl_start=jnp.where(mv, 0, state.infl_start),
+        infl_count=jnp.where(mv, 0, state.infl_count),
+        infl_total_bytes=jnp.where(mv, 0, state.infl_total_bytes),
+        ro_ctx=jnp.where(mv, 0, state.ro_ctx),
+        ro_from=jnp.where(mv, 0, state.ro_from),
+        ro_index=jnp.where(mv, 0, state.ro_index),
+        ro_acks=jnp.where(mvf, False, state.ro_acks),
+        ro_seq=jnp.where(mv, 0, state.ro_seq),
+        ro_next_seq=jnp.where(m, 1, state.ro_next_seq),
+        pri_ctx=jnp.where(mv, 0, state.pri_ctx),
+        pri_from=jnp.where(mv, 0, state.pri_from),
+        rs_ctx=jnp.where(mv, 0, state.rs_ctx),
+        rs_index=jnp.where(mv, 0, state.rs_index),
+        rs_count=jnp.where(m, 0, state.rs_count),
+    )
+
+
 def init_state(
     shape: Shape,
     ids: np.ndarray,

@@ -80,6 +80,35 @@ def election_safety(c, terms_seen: dict):
         )
 
 
+def election_safety_batched(c):
+    """At most one leader per (group, term) RIGHT NOW, fully vectorized:
+    the instantaneous form of `election_safety` for chaos soaks, where a
+    partition legitimately leaves a stale leader and a new one coexisting
+    in DIFFERENT terms — only a same-term pair is a violation.
+
+    Accepts a FusedCluster-like object or a BlockedFusedCluster (recurses
+    over `.blocks`)."""
+    blocks = getattr(c, "blocks", None)
+    if blocks is not None:
+        for b in blocks:
+            election_safety_batched(b)
+        return
+    v = c.v
+    lead = (np.asarray(c.state.state) == int(StateType.LEADER)).reshape(-1, v)
+    tm = np.asarray(c.state.term).reshape(-1, v)
+    both = (
+        lead[:, :, None]
+        & lead[:, None, :]
+        & (tm[:, :, None] == tm[:, None, :])
+    )
+    both &= ~np.eye(v, dtype=bool)[None]
+    if both.any():
+        bad = np.nonzero(both.any(axis=(1, 2)))[0]
+        raise AssertionError(
+            f"two leaders share a term in group(s) {bad.tolist()[:16]}"
+        )
+
+
 def check_all(c, com_prev, terms_seen: dict, sample: int | None = None, rng=None):
     """Composite checkpoint: error_bits clean, cursors ordered, commits
     monotone, Election Safety, Log Matching. Returns the new committed

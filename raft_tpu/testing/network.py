@@ -14,10 +14,27 @@ from __future__ import annotations
 
 import dataclasses
 import random
-import time
 from typing import Callable
 
 from raft_tpu.api.rawnode import ErrProposalDropped, Message, RawNodeBatch
+
+
+class VirtualClock:
+    """Deterministic simulated clock for LossyNetwork: starts at 0.0 and
+    only moves when the test advances it, so delayed-delivery trajectories
+    are reproducible run-to-run (no wall-clock reads anywhere)."""
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError("clock cannot run backwards")
+        self.t += dt
+        return self.t
+
+    def __call__(self) -> float:
+        return self.t
 
 
 class SyncNetwork:
@@ -81,7 +98,17 @@ class SyncNetwork:
                     progressed = True
             if not progressed and not pending:
                 return
-        raise RuntimeError("network did not quiesce")
+        ready = [
+            lane
+            for lane in range(self.batch.shape.n)
+            if self.batch.has_ready(lane)
+        ]
+        raise RuntimeError(
+            f"network did not quiesce after {max_iters} iterations: "
+            f"{len(pending)} message(s) still pending, lanes with Ready "
+            f"work: {ready or 'none'} (likely a livelock — raise max_iters "
+            f"only if the exchange is genuinely this deep)"
+        )
 
 
 @dataclasses.dataclass
@@ -99,8 +126,13 @@ class LossyNetwork:
         seed: int = 1,
         drop_prob: float = 0.0,
         max_delay: float = 0.0,
+        clock: Callable[[], float] | None = None,
     ):
         self.rng = random.Random(seed)
+        # no wall-clock fallback: when send/recv are called without an
+        # explicit `now`, time comes from this injectable clock (default
+        # VirtualClock at 0.0), keeping every trajectory deterministic
+        self.clock = clock if callable(clock) else VirtualClock()
         self.drop_prob = {(a, b): drop_prob for a in ids for b in ids if a != b}
         self.delay = {
             (a, b): (0.0, max_delay) for a in ids for b in ids if a != b
@@ -122,7 +154,7 @@ class LossyNetwork:
 
     def send(self, m: Message, now: float | None = None):
         """reference: network.go:92-121 — drop/delay applied at send time."""
-        now = time.monotonic() if now is None else now
+        now = self.clock() if now is None else now
         if m.frm in self.disconnected or m.to in self.disconnected:
             return
         if m.to not in self.queues:
@@ -137,7 +169,7 @@ class LossyNetwork:
         q.append(_InFlight(now + d, m))
 
     def recv(self, nid: int, now: float | None = None) -> list[Message]:
-        now = time.monotonic() if now is None else now
+        now = self.clock() if now is None else now
         q = self.queues.get(nid, [])
         due = [f for f in q if f.deliver_at <= now]
         self.queues[nid] = [f for f in q if f.deliver_at > now]

@@ -41,9 +41,13 @@ run_bench() {
 
 smokes() {
   # device-metrics smoke + the donation A/B dispatch smoke (fails if
-  # donation-on regresses throughput or stops lowering live buffers)
+  # donation-on regresses throughput or stops lowering live buffers) +
+  # the chaos recovery-SLO smoke (two same-seed soaks must be
+  # bit-identical; RAFT_TPU_CHAOS / CHAOS_SEED / CHAOS_BUDGET inherit
+  # through run_bench like RAFT_TPU_COMPILE_CACHE)
   run_bench benches/metrics_smoke.py \
-    && run_bench benches/dispatch_ab.py
+    && run_bench benches/dispatch_ab.py \
+    && run_bench benches/chaos_soak.py --smoke
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
@@ -61,7 +65,7 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
     set -e
     run_chunk tests/test_backpressure.py tests/test_bridge.py \
       tests/test_bridge_fused.py tests/test_bridge_process.py \
-      tests/test_codec.py tests/test_confchange.py \
+      tests/test_chaos.py tests/test_codec.py tests/test_confchange.py \
       tests/test_confchange_datadriven.py tests/test_confchange_scenarios.py
     run_chunk tests/test_donation.py tests/test_e2e.py \
       tests/test_fast_log_rejection.py tests/test_flow_control.py \

@@ -68,3 +68,56 @@ def test_network_delay():
     assert undelayed >= sent * (1 - delayrate) * 0.8
     late = len(nt.recv(2, now=delay))
     assert undelayed + late == sent
+
+
+# -- clock injection + quiesce diagnostics (this repo's satellites) ----------
+
+
+def test_lossy_network_default_clock_is_virtual_and_deterministic():
+    """With no explicit `now`, time comes from an injectable VirtualClock
+    starting at 0.0 — never the wall clock — so delayed-delivery
+    trajectories replay identically run to run."""
+    from raft_tpu.testing.network import VirtualClock
+
+    def drive(nt):
+        nt.delay_conn(1, 2, 5.0, rate=1.0)
+        for _ in range(50):
+            nt.send(_msg())          # no `now`: virtual t=0.0
+        due_now = len(nt.recv(2))    # delays pending, clock still at 0
+        nt.clock.advance(5.0)
+        due_late = len(nt.recv(2))   # everything due by t=5
+        return due_now, due_late
+
+    a = LossyNetwork([1, 2], seed=3)
+    assert isinstance(a.clock, VirtualClock)
+    ra = drive(a)
+    rb = drive(LossyNetwork([1, 2], seed=3))
+    assert ra == rb
+    assert ra[0] + ra[1] == 50  # nothing lost, nothing left in flight
+
+    clk = VirtualClock()
+    try:
+        clk.advance(-1.0)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("negative advance must raise")
+
+
+def test_sync_network_quiesce_error_is_informative():
+    """SyncNetwork.send names the iteration budget, the pending backlog,
+    and the lanes still holding Ready work when it gives up."""
+    from raft_tpu.testing.network import SyncNetwork
+    from tests.test_paper import make_batch
+
+    b = make_batch(3)
+    net = SyncNetwork(b)
+    b.campaign(0)
+    try:
+        net.send([], max_iters=0)
+    except RuntimeError as e:
+        msg = str(e)
+        assert "did not quiesce after 0 iterations" in msg
+        assert "pending" in msg and "Ready" in msg
+    else:
+        raise AssertionError("exhausted send must raise")
