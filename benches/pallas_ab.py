@@ -1,0 +1,203 @@
+"""Engine A/B: the VMEM-resident pallas round vs the XLA round.
+
+Runs the same FusedCluster workload twice in fresh subprocesses —
+RAFT_TPU_ENGINE=xla then =pallas (the production selection knob, so this
+harness exercises exactly what users flip) — and emits one bench JSON
+line per engine plus a summary, with ms/round AND the bytes-moved probes
+in `extra`:
+
+  - ms_per_round: wall clock over AB_ITERS timed dispatches
+  - bytes_accessed_per_round: the compiled executable's cost-analysis
+    "bytes accessed" (XLA's own HBM-traffic estimate — the quantity the
+    round-5 profile showed at ~3 GB/round on the XLA path)
+  - live_buffer_bytes / device_memory: allocator-level probes
+    (raft_tpu/utils/profiling.py; device stats are None on XLA:CPU)
+
+Asserted invariants:
+  - both engines end on an identical slim_state digest (bit-identity)
+  - the pallas child really ran pallas: no silent engine fallback
+  - [TPU only] pallas ms/round <= AB_TOL x XLA ms/round at the default
+    tile, and pallas moves strictly fewer bytes/round than XLA
+
+Exit 0 = pass, 1 = regression. `--smoke` shrinks the workload for CI
+(CPU interpret mode: correctness + plumbing only, timings meaningless).
+Env: AB_GROUPS, AB_VOTERS, AB_ROUNDS, AB_ITERS, AB_TOL, RAFT_TPU_*
+(RAFT_TPU_COMPILE_CACHE is forwarded to the children verbatim).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DIGEST_FIELDS = (
+    "term", "vote", "lead", "state", "committed", "last",
+    "log_term", "error_bits",
+)
+
+
+def child():
+    import time
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.config import Shape
+    from raft_tpu.metrics.host import ENGINE_EVENTS
+    from raft_tpu.ops import fused
+    from raft_tpu.ops import pallas_round as plr
+    from raft_tpu.utils.profiling import device_memory_stats, live_buffer_bytes
+
+    engine = os.environ["RAFT_TPU_ENGINE"]
+    groups = int(os.environ.get("AB_GROUPS", 4096))
+    v = int(os.environ.get("AB_VOTERS", 3))
+    w, e = 16, 2
+    shape = Shape(
+        n_lanes=groups * v, max_peers=v, log_window=w,
+        max_msg_entries=e, max_inflight=2, max_read_index=2,
+    )
+    c = fused.FusedCluster(groups, v, seed=42, shape=shape)
+    lag = min(8, w // 2)
+    rounds = int(os.environ.get("AB_ROUNDS", 16))
+    iters = int(os.environ.get("AB_ITERS", 8))
+
+    c.run(rounds, auto_propose=True, auto_compact_lag=lag)  # compile
+    jax.block_until_ready(c.state.term)
+    warm = 0
+    # both engines walk the identical (bit-exact) trajectory, so this loop
+    # runs the same number of sweeps in both children and the final digest
+    # comparison is apples-to-apples
+    while len(c.leader_lanes()) < groups:
+        c.run(rounds, auto_propose=True, auto_compact_lag=lag)
+        warm += rounds
+        if warm > 40 * 16:
+            raise RuntimeError("A/B warm-up stalled before full election")
+    jax.block_until_ready(c.state.term)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        c.run(rounds, auto_propose=True, auto_compact_lag=lag)
+    jax.block_until_ready(c.state.term)
+    ms_per_round = (time.perf_counter() - t0) / (rounds * iters) * 1e3
+
+    # bytes-moved probe: the compiled round block's own cost analysis
+    kw = dict(
+        v=v, n_rounds=rounds, do_tick=True, auto_propose=True,
+        auto_compact_lag=lag, ops_first_round_only=True,
+        metrics=c.metrics, chaos=c.chaos,
+    )
+    bytes_per_round = None
+    try:
+        if c.engine == "pallas":
+            lowered = plr._pallas_rounds_nodonate_jit.lower(
+                c.state, c.fab, c._no_ops, c.mute,
+                tile_lanes=c._pallas_tile, interpret=c._pallas_interpret,
+                **kw,
+            )
+        else:
+            lowered = fused._fused_rounds_nodonate_jit.lower(
+                c.state, c.fab, c._no_ops, c.mute, straddle=None, **kw
+            )
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        ba = cost.get("bytes accessed") if cost else None
+        if ba is not None:
+            bytes_per_round = float(ba) / rounds
+    except Exception:
+        pass  # backends without cost analysis: probe stays None
+
+    digest = hashlib.sha256()
+    for name in DIGEST_FIELDS:
+        digest.update(np.ascontiguousarray(getattr(c.state, name)).tobytes())
+    c.check_no_errors()
+    print(json.dumps({
+        "config": f"pallas_ab:{engine}",
+        "value": round(ms_per_round, 4),
+        "unit": "ms/round",
+        "extra": {
+            "engine_requested": engine,
+            "engine_after": c.engine,
+            "fallbacks": ENGINE_EVENTS.get("engine_pallas_fallback"),
+            "tile_lanes": c._pallas_tile,
+            "interpret": c._pallas_interpret,
+            "ms_per_round": ms_per_round,
+            "bytes_accessed_per_round": bytes_per_round,
+            "live_buffer_bytes": live_buffer_bytes(),
+            "device_memory": device_memory_stats(),
+            "digest": digest.hexdigest(),
+            "backend": jax.default_backend(),
+        },
+    }), flush=True)
+
+
+def run_child(engine: str) -> dict:
+    env = dict(os.environ, RAFT_TPU_ENGINE=engine)  # forwards
+    # RAFT_TPU_COMPILE_CACHE / RAFT_TPU_DONATE / JAX_PLATFORMS etc. verbatim
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    if "--smoke" in sys.argv:
+        os.environ.setdefault("AB_GROUPS", "8")
+        os.environ.setdefault("AB_ROUNDS", "4")
+        os.environ.setdefault("AB_ITERS", "2")
+    tol = float(os.environ.get("AB_TOL", 1.05))
+    xla = run_child("xla")
+    pal = run_child("pallas")
+    print(json.dumps(xla), flush=True)
+    print(json.dumps(pal), flush=True)
+    xx, pp = xla["extra"], pal["extra"]
+    on_tpu = pp["backend"] == "tpu"
+
+    fails = []
+    if pp["digest"] != xx["digest"]:
+        fails.append("slim_state digest mismatch: pallas != xla trajectory")
+    if pp["engine_after"] != "pallas" or pp["fallbacks"]:
+        fails.append(
+            f"pallas child fell back to {pp['engine_after']} "
+            f"({pp['fallbacks']} fallback(s)) — kernel failed to lower"
+        )
+    ratio = pal["value"] / max(xla["value"], 1e-9)
+    if on_tpu and ratio > tol:
+        fails.append(
+            f"pallas regressed throughput: {pal['value']:.4f} ms/round vs "
+            f"xla {xla['value']:.4f} (ratio {ratio:.3f} > tol {tol})"
+        )
+    if on_tpu and not (
+        pp["bytes_accessed_per_round"]
+        and xx["bytes_accessed_per_round"]
+        and pp["bytes_accessed_per_round"] < xx["bytes_accessed_per_round"]
+    ):
+        fails.append(
+            f"pallas does not move fewer bytes/round: "
+            f"{pp['bytes_accessed_per_round']} vs {xx['bytes_accessed_per_round']}"
+        )
+    print(json.dumps({
+        "metric": "pallas_ab",
+        "ok": not fails,
+        "ms_ratio_pallas_over_xla": round(ratio, 3),
+        "bytes_pallas": pp["bytes_accessed_per_round"],
+        "bytes_xla": xx["bytes_accessed_per_round"],
+        "tpu_gates": on_tpu,
+        "tol": tol,
+    }), flush=True)
+    for f in fails:
+        print(f"FAIL: {f}", file=sys.stderr)
+    sys.exit(1 if fails else 0)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -1,32 +1,29 @@
-"""Feasibility probe: the ENTIRE fused round as ONE Pallas TPU kernel.
+"""Probe the VMEM-resident Pallas round engine against the XLA path.
 
-The round-5 profile shows the fused round is HBM-bound at ~3GB/round moved
-— ~12x the one-read+one-write floor of the resident state — because XLA
-partitions the round into ~190 loop fusions that each re-read shared carry
-arrays. A single Pallas kernel over group-aligned lane tiles would read
-each state field into VMEM once, run all phases, and write once: the
-theoretical ~8x.
+Historically this file was the feasibility probe that first wrapped
+fused_round + route_fabric in a hand-built pallas_call — the round-5
+profile showed the XLA round HBM-bound at ~3GB/round (~190 loop fusions
+re-reading the shared carry; ~12x the one-read+one-write floor, a
+theoretical ~8x win for a VMEM-resident round). That kernel has since been
+promoted to the production engine in raft_tpu/ops/pallas_round.py
+(RAFT_TPU_ENGINE=pallas); this probe is now a thin wrapper over it,
+keeping its original two jobs: answer "does Mosaic lower the full round on
+this chip?" cheaply, and diff the trajectory bit-for-bit against XLA.
 
-This probe wraps the EXISTING fused_round + route_fabric (unchanged jnp
-code) in a pallas_call over lane tiles and tries to compile+run it on the
-chip, steady-state-stepping a small cluster and diffing against the plain
-XLA path. It answers ONE question cheaply: can Mosaic lower the round at
-all, and if so what does a VMEM-resident round cost?
+For the instrumented two-engine comparison (bench JSON, bytes-moved
+probe), use benches/pallas_ab.py instead.
 
-Tile invariant: tile_lanes % v == 0 (groups never straddle a tile), so
-in-tile jnp.arange(T) % v equals the global lane % v and the shift-router's
-wrap masking argument holds within a tile.
+Env knobs: PP_GROUPS, PP_VOTERS, PP_TILE (lane tile, must be a multiple
+of PP_VOTERS), PP_BLOCK (rounds per dispatch), PP_INTERPRET,
+BENCH_WINDOW, BENCH_ENTRIES.
 """
 
 from __future__ import annotations
 
-import functools
 import os
 import time
 
 import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
 from raft_tpu.utils.compile_cache import enable_persistent_cache
 
@@ -35,66 +32,8 @@ if jax.default_backend() != "cpu":
 
 from raft_tpu.config import Shape
 from raft_tpu.ops import fused
-from raft_tpu.ops.fused import FusedCluster, fat_fabric, slim_fabric, route_fabric
-from raft_tpu.state import fat_state, slim_state
-
-
-def pallas_rounds(state, fab, ops, *, v, tile_lanes, n_rounds,
-                  auto_compact_lag, interpret=False):
-    """n_rounds fused rounds, each as one pallas_call over lane tiles.
-    Slim carry between rounds, like fused_rounds."""
-    state = slim_state(state)
-    fab = slim_fabric(fab)
-
-    flat_s, tree_s = jax.tree.flatten(state)
-    flat_f, tree_f = jax.tree.flatten(fab)
-    flat_o, tree_o = jax.tree.flatten(ops)
-    ls, lf, lo = len(flat_s), len(flat_f), len(flat_o)
-    n = state.term.shape[0]
-    assert n % tile_lanes == 0 and tile_lanes % v == 0
-    grid = (n // tile_lanes,)
-
-    def spec_of(x):
-        bs = (tile_lanes,) + x.shape[1:]
-        nd = x.ndim
-        return pl.BlockSpec(bs, lambda i, nd=nd: (i,) + (0,) * (nd - 1))
-
-    in_specs = [spec_of(x) for x in flat_s + flat_f + flat_o]
-    out_specs = [spec_of(x) for x in flat_s + flat_f]
-    out_shape = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in flat_s + flat_f]
-
-    def kernel(*refs):
-        ins, outs = refs[: ls + lf + lo], refs[ls + lf + lo :]
-        vals = [r[...] for r in ins]
-        st = jax.tree.unflatten(tree_s, vals[:ls])
-        fb = jax.tree.unflatten(tree_f, vals[ls : ls + lf])
-        op = jax.tree.unflatten(tree_o, vals[ls + lf :])
-        inb = route_fabric(fat_fabric(fb), v, None)
-        st2, fb2 = fused.fused_round(
-            fat_state(st), inb, op, None,
-            do_tick=True, auto_propose=True,
-            auto_compact_lag=auto_compact_lag,
-        )
-        for r, x in zip(outs, jax.tree.leaves(slim_state(st2))
-                        + jax.tree.leaves(slim_fabric(fb2))):
-            r[...] = x
-
-    call = pl.pallas_call(
-        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
-        out_shape=out_shape, interpret=interpret,
-    )
-
-    @jax.jit
-    def run(flat_s, flat_f, flat_o):
-        def body(carry, _):
-            fs, ff = carry
-            out = call(*fs, *ff, *flat_o)
-            return (list(out[:ls]), list(out[ls:])), None
-        (fs, ff), _ = jax.lax.scan(body, (flat_s, flat_f), length=n_rounds)
-        return fs, ff
-
-    fs, ff = run(flat_s, flat_f, flat_o)
-    return (jax.tree.unflatten(tree_s, fs), jax.tree.unflatten(tree_f, ff))
+from raft_tpu.ops.fused import FusedCluster
+from raft_tpu.ops.pallas_round import _pallas_rounds_nodonate_jit
 
 
 def main():
@@ -116,18 +55,17 @@ def main():
     print(f"steady: leaders={len(c.leader_lanes())}/{groups}")
 
     ops = fused.no_ops(shape.n)
-    # the copying (nodonate) twin throughout: this probe re-reads c.state /
-    # c.fab after dispatching them, which the donating jit would delete
-    # reference: one more XLA block
+    # the copying (nodonate) twins throughout: this probe re-reads c.state /
+    # c.fab after dispatching them, which the donating jits would delete
+    kw = dict(v=v, n_rounds=block, do_tick=True, auto_propose=True,
+              auto_compact_lag=lag, ops_first_round_only=False)
     ref_s, ref_f = fused._fused_rounds_nodonate_jit(
-        c.state, c.fab, ops, None, v=v, n_rounds=block, do_tick=True,
-        auto_propose=True, auto_compact_lag=lag, ops_first_round_only=False, straddle=None)
+        c.state, c.fab, ops, None, straddle=None, **kw)
     jax.block_until_ready(ref_s.term)
 
     t0 = time.perf_counter()
-    got_s, got_f = pallas_rounds(
-        c.state, c.fab, ops, v=v, tile_lanes=tile, n_rounds=block,
-        auto_compact_lag=lag, interpret=interpret)
+    got_s, got_f = _pallas_rounds_nodonate_jit(
+        c.state, c.fab, ops, None, tile_lanes=tile, interpret=interpret, **kw)
     jax.block_until_ready(got_s.term)
     compile_s = time.perf_counter() - t0
     print(f"pallas compiled+ran {block} rounds in {compile_s:.1f}s")
@@ -151,17 +89,14 @@ def main():
     def run_pallas(k):
         s, f = c.state, c.fab
         for _ in range(k):
-            s, f = pallas_rounds(s, f, ops, v=v, tile_lanes=tile,
-                                 n_rounds=block, auto_compact_lag=lag,
-                                 interpret=interpret)
+            s, f = _pallas_rounds_nodonate_jit(
+                s, f, ops, None, tile_lanes=tile, interpret=interpret, **kw)
         jax.block_until_ready(s.term)
     def run_xla(k):
         s, f = c.state, c.fab
         for _ in range(k):
             s, f = fused._fused_rounds_nodonate_jit(
-                s, f, ops, None, v=v, n_rounds=block, do_tick=True,
-                auto_propose=True, auto_compact_lag=lag,
-                ops_first_round_only=False, straddle=None)
+                s, f, ops, None, straddle=None, **kw)
         jax.block_until_ready(s.term)
     tp = timed(run_pallas) / block * 1e3
     tx = timed(run_xla) / block * 1e3

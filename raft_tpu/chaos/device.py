@@ -238,7 +238,21 @@ def _decide(seed, rnd, idx, salt: int, num):
 
 
 # --------------------------------------------------------------------------
-# round hooks (called from ops/fused.py fused_rounds when chaos is not None)
+# round hooks (called from ops/fused.py fused_rounds when chaos is not None,
+# and per lane tile from ops/pallas_round.py with lane_offset = tile start)
+
+
+def _lane_edge(n: int, v: int, lane_offset):
+    """GLOBAL (lane, edge) PRNG site indices for a window of n lanes that
+    starts at lane_offset (0/None = the whole batch). The fault draw at a
+    given global site must not depend on how lanes are tiled, so a tiled
+    kernel passes its tile start and reproduces the monolithic stream
+    bit-for-bit."""
+    lane = jnp.arange(n, dtype=U32)
+    if lane_offset is not None:
+        lane = lane + jnp.asarray(lane_offset).astype(U32)
+    edge = lane[:, None] * U32(v) + jnp.arange(v, dtype=U32)[None, :]
+    return lane, edge
 
 
 def _peer_cols(x, v: int):
@@ -257,17 +271,19 @@ def _group_any(x, v: int):
     return jnp.broadcast_to(a[:, None], (g, v)).reshape(n)
 
 
-def begin_round(chaos: ChaosState, state, inb, ops, v: int):
+def begin_round(chaos: ChaosState, state, inb, ops, v: int, *, lane_offset=None):
     """Pre-step fault application: crash-window wipes, inbound cuts
     (drop/partition/crash), host-op suppression, tick mask. `state` and
     `inb` are the FAT (i32) round inputs, `inb` already routed.
+
+    lane_offset: global index of this window's first lane (pallas tiles);
+    None = lanes 0..n-1 (the monolithic fused_rounds path).
 
     Returns (chaos, state, inb, ops, tick_mask)."""
     n = state.id.shape[0]
     rnd = chaos.round
     seed = chaos.seed
-    lane = jnp.arange(n, dtype=U32)
-    edge = jnp.arange(n * v, dtype=U32).reshape(n, v)
+    lane, edge = _lane_edge(n, v, lane_offset)
 
     # crash/restart: wipe volatile state at BOTH window edges — at crash so
     # the dead lane holds no leadership (an ex-leader must not keep
@@ -320,15 +336,17 @@ def begin_round(chaos: ChaosState, state, inb, ops, v: int):
     return chaos, state, inb, ops, tick_mask
 
 
-def end_round(chaos: ChaosState, state, prev_fab, out_fab, v: int):
+def end_round(chaos: ChaosState, state, prev_fab, out_fab, v: int, *, lane_offset=None):
     """Post-step fault application: duplicate redelivery + recovery-probe
     recording. `state` is the post-round state; `prev_fab` the FAT outbox
     that was delivered this round, `out_fab` the FAT outbox just produced.
 
+    lane_offset: see begin_round.
+
     Returns (chaos, out_fab)."""
     n = state.id.shape[0]
     rnd = chaos.round
-    edge = jnp.arange(n * v, dtype=U32).reshape(n, v)
+    _, edge = _lane_edge(n, v, lane_offset)
 
     # duplicate delivery: re-inject last round's outbox cells into empty
     # slots of the new outbox — the message rides one extra round and the

@@ -1692,6 +1692,8 @@ class FusedCluster:
         seed: int = 1,
         shape=None,
         learner_ids: tuple = (),
+        engine: str | None = None,
+        tile_lanes: int | None = None,
         **cfg,
     ):
         import numpy as np
@@ -1699,6 +1701,16 @@ class FusedCluster:
         from raft_tpu.config import Shape
         from raft_tpu.state import init_state, make_lane_config
 
+        # round engine: "xla" (this module's fused_rounds) or "pallas"
+        # (ops/pallas_round.py — the VMEM-resident kernel). kwarg > env >
+        # xla; resolved once at construction, and flipped back to "xla"
+        # in-place if the pallas path fails to lower (engine fallback).
+        from raft_tpu.ops import pallas_round as plr
+
+        self.engine = plr.resolve_engine(engine)
+        self._tile_req = tile_lanes  # explicit tile (None = env/autotune)
+        self._pallas_tile = None  # resolved lazily at first pallas dispatch
+        self._pallas_interpret = None
         self.g, self.v = n_groups, n_voters
         n = n_groups * n_voters
         self.shape = shape or Shape(n_lanes=n, max_peers=n_voters)
@@ -1773,7 +1785,22 @@ class FusedCluster:
         if ops is None:
             ops = self._no_ops
         self._flush_pending_wal()
-        if self._donate:
+        res = None
+        if self.engine == "pallas":
+            res = self._run_pallas(
+                rounds,
+                ops,
+                do_tick,
+                auto_propose,
+                auto_compact_lag,
+                ops_first_round_only,
+            )
+            # None = the engine fell back (self.engine is now "xla"); the
+            # carry is untouched — lowering fails before execution — so
+            # the XLA dispatch below redrives the same rounds
+        if res is not None:
+            pass
+        elif self._donate:
             with _no_persistent_cache():
                 res = _fused_rounds_jit(
                     self.state,
@@ -1824,6 +1851,124 @@ class FusedCluster:
         if self._wal_pending is not None:
             self._wal_pending.flush()
             self._wal_pending = None
+
+    # -- pallas engine (ops/pallas_round.py) ------------------------------
+
+    def _run_pallas(
+        self,
+        rounds,
+        ops,
+        do_tick,
+        auto_propose,
+        auto_compact_lag,
+        ops_first_round_only,
+    ):
+        """One dispatch on the VMEM-resident pallas engine. Returns the
+        fused_rounds-shaped result tuple, or None after an engine fallback:
+        a Mosaic lowering failure is logged ONCE via the metrics host plane
+        (metrics/host.py record_engine_fallback), self.engine flips to
+        "xla", and the caller redispatches on the XLA path. Lowering fails
+        at trace/compile time, before any buffer (donated or not) is
+        touched, so the carry is intact for the redrive. TileErrors are
+        configuration errors and propagate."""
+        from raft_tpu.ops import pallas_round as plr
+
+        tile = self._resolve_pallas_tile()
+        if self._pallas_interpret is None:
+            self._pallas_interpret = plr.default_interpret()
+        kw = dict(
+            v=self.v,
+            tile_lanes=tile,
+            n_rounds=rounds,
+            do_tick=do_tick,
+            auto_propose=auto_propose,
+            auto_compact_lag=auto_compact_lag,
+            ops_first_round_only=ops_first_round_only,
+            interpret=self._pallas_interpret,
+            metrics=self.metrics,
+            chaos=self.chaos,
+        )
+        try:
+            plr.maybe_force_fail()
+            if self._donate:
+                with _no_persistent_cache():
+                    return plr._pallas_rounds_jit(
+                        self.state, self.fab, ops, self.mute, **kw
+                    )
+            return plr._pallas_rounds_nodonate_jit(
+                self.state, self.fab, ops, self.mute, **kw
+            )
+        except plr.TileError:
+            raise
+        except Exception as e:
+            from raft_tpu.metrics.host import record_engine_fallback
+
+            record_engine_fallback(
+                f"{type(self).__name__}(n={self.shape.n_lanes}, v={self.v}, "
+                f"tile={tile}, backend={jax.default_backend()})",
+                e,
+            )
+            self.engine = "xla"
+            return None
+
+    def _resolve_pallas_tile(self) -> int:
+        """Pick the lane tile once per cluster: explicit ctor tile_lanes >
+        RAFT_TPU_PALLAS_TILE env > the process-wide (shape, backend) cache
+        > TPU autotune sweep (pallas_round.autotune_tile) > default_tile.
+        Interpret mode never sweeps (it would time the interpreter)."""
+        if self._pallas_tile is not None:
+            return self._pallas_tile
+        from raft_tpu.ops import pallas_round as plr
+
+        n = self.shape.n_lanes
+        backend = jax.default_backend()
+        key = plr.shape_key(self.shape, backend)
+        t = self._tile_req
+        if t is None:
+            env = os.environ.get("RAFT_TPU_PALLAS_TILE")
+            t = int(env) if env else None
+        if t is None:
+            t = plr.cached_tile(key)
+        if t is None:
+            if backend == "tpu" and plr.autotune_enabled():
+                for c in plr.tile_candidates(n, self.v):
+                    plr.check_tile(n, self.v, c)
+                t = plr.autotune_tile(
+                    n, self.v, key=key, time_fn=self._time_tile
+                )
+            else:
+                t = plr.default_tile(n, self.v)
+        plr.check_tile(n, self.v, t)
+        plr.remember_tile(key, t)
+        self._pallas_tile = t
+        return t
+
+    def _time_tile(self, tile_lanes: int) -> float:
+        """Autotune probe: seconds for a short warmed block of rounds on
+        the copying twin (the carry is untouched)."""
+        import time as _time
+
+        from raft_tpu.ops import pallas_round as plr
+
+        kw = dict(
+            v=self.v,
+            tile_lanes=tile_lanes,
+            n_rounds=4,
+            do_tick=True,
+            auto_propose=False,
+            auto_compact_lag=None,
+            ops_first_round_only=True,
+            interpret=False,
+            metrics=self.metrics,
+            chaos=self.chaos,
+        )
+        args = (self.state, self.fab, self._no_ops, self.mute)
+        jax.block_until_ready(
+            plr._pallas_rounds_nodonate_jit(*args, **kw)
+        )  # compile + warm
+        t0 = _time.perf_counter()
+        jax.block_until_ready(plr._pallas_rounds_nodonate_jit(*args, **kw))
+        return _time.perf_counter() - t0
 
     def ops(self, **kw) -> LocalOps:
         """Build a LocalOps with the given per-lane columns set. Values may

@@ -1,0 +1,517 @@
+"""VMEM-resident fused round: the `pallas` engine behind RAFT_TPU_ENGINE.
+
+The round-5 profile shows the XLA fused round is HBM-bound at ~3 GB/round
+moved — ~12x the one-read+one-write floor of the resident carry — because
+XLA partitions the round into ~190 loop fusions that each re-read the
+shared state arrays (benches/pallas_probe.py header, which this module
+productionizes). The cure is the hand-fused-kernel pattern TPU serving
+stacks reach for when XLA's fusion boundaries leave bandwidth on the
+table: ONE Pallas kernel per group-aligned lane tile that reads every
+slim-carry field into VMEM once, runs the whole round (route_fabric +
+fused_round, unchanged jnp bodies), and writes the slim carry back once.
+
+Contract vs ops/fused.py fused_rounds:
+
+- `pallas_rounds` mirrors fused_rounds' signature and return tuple
+  (state, fab[, metrics][, chaos]) and is BIT-IDENTICAL to it per round
+  (asserted over >=32 rounds by tests/test_pallas_round.py in interpret
+  mode; interpret=True is the CPU path — Mosaic only lowers on TPU).
+- Tile invariant: `tile_lanes % v == 0` and `n % tile_lanes == 0`
+  (TileError otherwise) — a raft group's voters never straddle a tile, so
+  the in-tile shift router, aligned_peer_mute, and the chaos/metrics
+  group reductions ([T] -> [T/v, v]) all hold within a tile.
+- The metrics/chaos carries thread THROUGH the kernel: per-lane columns
+  (latency sampler, fault knobs, recovery probe) tile like state; the
+  lane-reduced scalars (counters/hist/lat_sum, recovery recounts) come
+  back as one [n_tiles, 128] partials row per tile and are reduced
+  OUTSIDE the call, so `metrics=None` / `chaos=None` still elide every
+  plane op from the trace exactly like the XLA path.
+- The chaos PRNG is a pure function of GLOBAL lane index, so each tile
+  passes `lane_offset = program_id * tile_lanes` into the chaos hooks
+  (chaos/device.py _lane_edge) and reproduces the monolithic fault
+  timeline bit-for-bit.
+- Donation composes like fused_rounds: `_pallas_rounds_jit` donates the
+  (state, fab, metrics, chaos) carry and must run under the jax 0.4.37
+  persistent-cache fence (ops/fused.py _no_persistent_cache);
+  `_pallas_rounds_nodonate_jit` is the copying twin.
+- Straddle sharding is NOT supported (groups must be shard- and
+  tile-resident); parallel/sharded.py routes straddle configs to XLA.
+
+Engine selection lives in resolve_engine (RAFT_TPU_ENGINE env or the
+`engine=` kwarg on FusedCluster / BlockedFusedCluster /
+ShardedFusedCluster). Dispatchers degrade gracefully: if Mosaic fails to
+lower for a given Shape, they log once via the metrics host plane
+(metrics/host.py record_engine_fallback) and fall back to the XLA path
+rather than erroring — see FusedCluster._run_pallas.
+
+Tile autotuner: `autotune_tile` sweeps tile_candidates at first dispatch
+(TPU only; sweeping interpret mode would time the interpreter) and caches
+the winner per (shape, backend) in the module-level _TILE_CACHE, shared
+by every scheduler in the process. RAFT_TPU_PALLAS_TILE pins the tile;
+RAFT_TPU_PALLAS_AUTOTUNE=0 skips the sweep (default_tile is used).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on some CPU-only jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _SMEM = pltpu.SMEM
+except Exception:  # pragma: no cover - interpret mode works without SMEM
+    pltpu = None
+    _SMEM = None
+
+from raft_tpu.chaos import device as chmod
+from raft_tpu.metrics import device as metmod
+from raft_tpu.ops import fused as fmod
+from raft_tpu.state import fat_state, slim_state
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+ENGINES = ("xla", "pallas")
+
+# Width of the per-tile partials row: one TPU lane register. Layout (i32):
+#   [0 : K)          metrics counter deltas       (K = len(metmod.COUNTERS))
+#   [K : K+B)        commit-latency hist deltas   (B = metmod.N_BUCKETS)
+#   [K+B]            lat_sum delta
+#   [C], [C+1]       chaos n_reelected / n_recommitted per-tile recounts
+# where C = K+B+1 when metrics ride along, else 0. Deltas accumulate
+# across tiles; the chaos recounts are absolute per-tile counts that sum
+# exactly because tiles are group-aligned and the probe columns are
+# group-uniform (chaos/device.py end_round).
+PARTIAL_WIDTH = 128
+
+# chaos per-lane columns that enter the kernel: host-set knobs (read-only
+# in-kernel) then the recovery-probe columns (read-write, tiled outputs)
+_CH_KNOBS = (
+    "drop_num",
+    "dup_num",
+    "part_send",
+    "part_recv",
+    "tick_skew_num",
+    "crash_at",
+    "restart_at",
+)
+_CH_PROBE = ("base_committed", "reelect_round", "recommit_round")
+
+
+class TileError(ValueError):
+    """A lane tile that violates the group-alignment invariant. This is a
+    configuration error, never swallowed by the engine fallback."""
+
+
+def resolve_engine(engine: str | None = None) -> str:
+    """kwarg > RAFT_TPU_ENGINE env > "xla". Unknown names raise."""
+    e = engine if engine is not None else os.environ.get("RAFT_TPU_ENGINE")
+    e = (e or "xla").lower()
+    if e not in ENGINES:
+        raise ValueError(f"unknown engine {e!r}: expected one of {ENGINES}")
+    return e
+
+
+def default_interpret() -> bool:
+    """Interpret-mode default: RAFT_TPU_PALLAS_INTERPRET if set, else
+    everything but real TPU hardware interprets (Mosaic is TPU-only)."""
+    env = os.environ.get("RAFT_TPU_PALLAS_INTERPRET")
+    if env not in (None, ""):
+        return env not in ("0", "off")
+    return jax.default_backend() != "tpu"
+
+
+def autotune_enabled() -> bool:
+    return os.environ.get("RAFT_TPU_PALLAS_AUTOTUNE", "1") not in (
+        "0",
+        "",
+        "off",
+    )
+
+
+def check_tile(n: int, v: int, tile_lanes: int) -> None:
+    """Enforce the tile invariant with a clear error (TileError)."""
+    if tile_lanes < 1:
+        raise TileError(f"tile_lanes={tile_lanes} must be >= 1")
+    if tile_lanes % v:
+        raise TileError(
+            f"tile_lanes={tile_lanes} is not a multiple of v={v}: a raft "
+            "group's voters must never straddle a lane tile (the in-tile "
+            "router, aligned_peer_mute, and the chaos/metrics group "
+            "reductions all reshape [T] -> [T/v, v])"
+        )
+    if n % tile_lanes:
+        raise TileError(
+            f"tile_lanes={tile_lanes} does not divide the lane count "
+            f"n={n}: the tile grid must cover the batch exactly"
+        )
+
+
+def maybe_force_fail() -> None:
+    """Test hook standing in for a Mosaic lowering failure so the engine
+    fallback path is exercisable on any backend. Checked both at trace
+    time (pallas_rounds) and at dispatch time (FusedCluster._run_pallas,
+    the sharded stepper) — a warm jit cache skips tracing entirely, and
+    the fallback must still fire."""
+    if os.environ.get("RAFT_TPU_PALLAS_FORCE_FAIL", "0") not in ("0", ""):
+        raise RuntimeError(
+            "pallas lowering forced to fail (RAFT_TPU_PALLAS_FORCE_FAIL)"
+        )
+
+
+def tile_candidates(n: int, v: int) -> list[int]:
+    """Small sweep set for the autotuner: group-aligned powers-of-two
+    sub-tiles plus the whole batch, every one dividing n."""
+    cands = []
+    for base in (256, 512, 1024, 2048, 4096):
+        t = base * v
+        if t < n and n % t == 0:
+            cands.append(t)
+    cands.append(n)
+    return cands
+
+
+def default_tile(n: int, v: int) -> int:
+    """Largest candidate <= 1024*v (a VMEM-comfortable tile at the default
+    Shape), else the smallest candidate."""
+    cands = tile_candidates(n, v)
+    best = None
+    for t in cands:
+        if t <= 1024 * v:
+            best = t
+    return best if best is not None else cands[0]
+
+
+def shape_key(shape, backend: str) -> tuple:
+    """Autotune cache key per (shape, backend)."""
+    try:
+        dims = dataclasses.astuple(shape)
+    except TypeError:  # pragma: no cover - non-dataclass shape stand-ins
+        dims = tuple(sorted(vars(shape).items()))
+    return (dims, backend)
+
+
+# winner tile per shape_key, shared process-wide (FusedCluster and the
+# blocked/sharded schedulers all consult it before sweeping)
+_TILE_CACHE: dict[tuple, int] = {}
+
+
+def cached_tile(key: tuple) -> int | None:
+    return _TILE_CACHE.get(key)
+
+
+def remember_tile(key: tuple, tile_lanes: int) -> None:
+    _TILE_CACHE[key] = tile_lanes
+
+
+def autotune_tile(n: int, v: int, *, key: tuple, time_fn) -> int:
+    """Sweep tile_candidates with the caller's `time_fn(tile) -> seconds`
+    (warmed, post-compile) and cache the winner under `key`."""
+    hit = cached_tile(key)
+    if hit is not None:
+        return hit
+    best_t, best = None, None
+    for t in tile_candidates(n, v):
+        dt = time_fn(t)
+        if best is None or dt < best:
+            best, best_t = dt, t
+    remember_tile(key, best_t)
+    return best_t
+
+
+# --------------------------------------------------------------------------
+# the engine
+
+
+def pallas_rounds(
+    state,
+    fab,
+    ops,
+    mute,
+    *,
+    v: int,
+    tile_lanes: int,
+    n_rounds: int,
+    do_tick: bool = True,
+    auto_propose: bool = False,
+    auto_compact_lag: int | None = None,
+    ops_first_round_only: bool = True,
+    interpret: bool = False,
+    metrics=None,
+    chaos=None,
+):
+    """n_rounds fused rounds, each as ONE pallas_call over group-aligned
+    lane tiles. Same contract and bit-identical trajectories as
+    ops/fused.py fused_rounds (minus straddle support) — see module doc."""
+    maybe_force_fail()
+    state = slim_state(state)
+    fab = fmod.slim_fabric(fab)
+    n = state.term.shape[0]
+    check_tile(n, v, tile_lanes)
+
+    has_mute = mute is not None
+    has_met = metrics is not None
+    has_ch = chaos is not None
+    has_scal = has_met or has_ch
+
+    flat_s, tree_s = jax.tree.flatten(state)
+    flat_f, tree_f = jax.tree.flatten(fab)
+    flat_o, tree_o = jax.tree.flatten(ops)
+    ls, lf, lo = len(flat_s), len(flat_f), len(flat_o)
+    grid = (n // tile_lanes,)
+
+    K = len(metmod.COUNTERS)
+    B = metmod.N_BUCKETS
+    ch_off = (K + B + 1) if has_met else 0
+
+    def lane_spec(x):
+        bs = (tile_lanes,) + x.shape[1:]
+        nd = x.ndim
+        return pl.BlockSpec(bs, lambda i, nd=nd: (i,) + (0,) * (nd - 1))
+
+    def kernel(*refs):
+        pos = 0
+
+        def take(k):
+            nonlocal pos
+            out = list(refs[pos : pos + k])
+            pos += k
+            return out
+
+        s_in, f_in, o_in = take(ls), take(lf), take(lo)
+        mute_ref = take(1)[0] if has_mute else None
+        samp_in = take(2) if has_met else None
+        knob_in = take(len(_CH_KNOBS)) if has_ch else None
+        probe_in = take(len(_CH_PROBE)) if has_ch else None
+        scal_ref = take(1)[0] if has_scal else None
+        s_out, f_out = take(ls), take(lf)
+        samp_out = take(2) if has_met else None
+        probe_out = take(len(_CH_PROBE)) if has_ch else None
+        part_ref = take(1)[0] if has_scal else None
+
+        st = fat_state(jax.tree.unflatten(tree_s, [r[...] for r in s_in]))
+        fb = fmod.fat_fabric(
+            jax.tree.unflatten(tree_f, [r[...] for r in f_in])
+        )
+        op = jax.tree.unflatten(tree_o, [r[...] for r in o_in])
+        mt = mute_ref[...] if has_mute else None
+        pm = fmod.aligned_peer_mute(mt, v) if has_mute else None
+        inb = fmod.route_fabric(fb, v, mt, peer_mute=pm)
+
+        # global index of this tile's first lane: the chaos PRNG streams
+        # are functions of global lane, so tiling is invisible to them
+        lane_off = pl.program_id(0) * tile_lanes
+
+        tick_mask = None
+        ch_t = None
+        if has_ch:
+            knobs = {k: r[...] for k, r in zip(_CH_KNOBS, knob_in)}
+            probes = {k: r[...] for k, r in zip(_CH_PROBE, probe_in)}
+            ch_t = chmod.ChaosState(
+                seed=jax.lax.bitcast_convert_type(scal_ref[0, 3], U32),
+                round=scal_ref[0, 1],
+                heal_round=scal_ref[0, 2],
+                n_reelected=jnp.zeros((), I32),
+                n_recommitted=jnp.zeros((), I32),
+                **knobs,
+                **probes,
+            )
+            ch_t, st, inb, op, tick_mask = chmod.begin_round(
+                ch_t, st, inb, op, v, lane_offset=lane_off
+            )
+        mt_t = None
+        if has_met:
+            # zero-based counter slots: the kernel computes this tile's
+            # DELTA; the true running totals never enter the kernel
+            mt_t = metmod.MetricsState(
+                counters=jnp.zeros((K,), I32),
+                hist=jnp.zeros((B,), I32),
+                lat_sum=jnp.zeros((), I32),
+                round_ctr=scal_ref[0, 0],
+                samp_index=samp_in[0][...],
+                samp_round=samp_in[1][...],
+            )
+        res = fmod.fused_round(
+            st,
+            inb,
+            op,
+            mt,
+            peer_mute=pm,
+            do_tick=do_tick,
+            auto_propose=auto_propose,
+            auto_compact_lag=auto_compact_lag,
+            tick_mask=tick_mask,
+            metrics=mt_t,
+        )
+        st2, f2 = res[0], res[1]
+        mt2 = res[2] if has_met else None
+        if has_ch:
+            ch_t, f2 = chmod.end_round(
+                ch_t, st2, fb, f2, v, lane_offset=lane_off
+            )
+        for r, x in zip(s_out, jax.tree.leaves(slim_state(st2))):
+            r[...] = x
+        for r, x in zip(f_out, jax.tree.leaves(fmod.slim_fabric(f2))):
+            r[...] = x
+        if has_met:
+            samp_out[0][...] = mt2.samp_index
+            samp_out[1][...] = mt2.samp_round
+        if has_ch:
+            for r, k in zip(probe_out, _CH_PROBE):
+                r[...] = getattr(ch_t, k)
+        if has_scal:
+            parts = []
+            if has_met:
+                parts += [mt2.counters, mt2.hist, mt2.lat_sum[None]]
+            if has_ch:
+                parts += [ch_t.n_reelected[None], ch_t.n_recommitted[None]]
+            row = jnp.concatenate(parts)
+            row = jnp.pad(row, (0, PARTIAL_WIDTH - row.shape[0]))
+            part_ref[...] = row[None, :]
+
+    # -- specs / shapes -----------------------------------------------------
+    in_specs = [lane_spec(x) for x in flat_s + flat_f + flat_o]
+    if has_mute:
+        in_specs.append(lane_spec(mute))
+    if has_met:
+        in_specs += [lane_spec(metrics.samp_index), lane_spec(metrics.samp_round)]
+    if has_ch:
+        in_specs += [lane_spec(getattr(chaos, k)) for k in _CH_KNOBS]
+        in_specs += [lane_spec(getattr(chaos, k)) for k in _CH_PROBE]
+    if has_scal:
+        smem = {} if _SMEM is None else {"memory_space": _SMEM}
+        in_specs.append(pl.BlockSpec((1, 4), lambda i: (0, 0), **smem))
+
+    out_leaves = list(flat_s + flat_f)
+    if has_met:
+        out_leaves += [metrics.samp_index, metrics.samp_round]
+    if has_ch:
+        out_leaves += [getattr(chaos, k) for k in _CH_PROBE]
+    out_specs = [lane_spec(x) for x in out_leaves]
+    out_shape = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in out_leaves]
+    if has_scal:
+        out_specs.append(pl.BlockSpec((1, PARTIAL_WIDTH), lambda i: (i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((grid[0], PARTIAL_WIDTH), jnp.int32)
+        )
+
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )
+
+    # -- scan over rounds ---------------------------------------------------
+    def body(carry, i):
+        fs, ff, met, ch = carry
+        o_leaves = flat_o
+        if ops_first_round_only:
+            first = i == 0
+            o_leaves = [
+                jnp.where(first, x, jnp.zeros_like(x)) for x in flat_o
+            ]
+        inputs = list(fs) + list(ff) + list(o_leaves)
+        if has_mute:
+            inputs.append(mute)
+        if has_met:
+            inputs += [met.samp_index, met.samp_round]
+        if has_ch:
+            inputs += [getattr(ch, k) for k in _CH_KNOBS]
+            inputs += [getattr(ch, k) for k in _CH_PROBE]
+        if has_scal:
+            z = jnp.zeros((), I32)
+            inputs.append(
+                jnp.stack(
+                    [
+                        met.round_ctr if has_met else z,
+                        ch.round if has_ch else z,
+                        ch.heal_round if has_ch else z,
+                        jax.lax.bitcast_convert_type(ch.seed, I32)
+                        if has_ch
+                        else z,
+                    ]
+                ).reshape(1, 4)
+            )
+        out = list(call(*inputs))
+        pos = 0
+
+        def take(k):
+            nonlocal pos
+            res = out[pos : pos + k]
+            pos += k
+            return res
+
+        new_fs, new_ff = take(ls), take(lf)
+        if has_met:
+            samp_i, samp_r = take(2)
+        if has_ch:
+            probes = take(len(_CH_PROBE))
+        if has_scal:
+            parts = jnp.sum(take(1)[0], axis=0)  # [PARTIAL_WIDTH] i32
+            if has_met:
+                met = dataclasses.replace(
+                    met,
+                    counters=met.counters + parts[:K],
+                    hist=met.hist + parts[K : K + B],
+                    lat_sum=met.lat_sum + parts[K + B],
+                    round_ctr=met.round_ctr + 1,
+                    samp_index=samp_i,
+                    samp_round=samp_r,
+                )
+            if has_ch:
+                ch = dataclasses.replace(
+                    ch,
+                    **dict(zip(_CH_PROBE, probes)),
+                    n_reelected=parts[ch_off],
+                    n_recommitted=parts[ch_off + 1],
+                    round=ch.round + 1,
+                )
+        return (new_fs, new_ff, met, ch), None
+
+    (flat_s, flat_f, metrics, chaos), _ = jax.lax.scan(
+        body,
+        (flat_s, flat_f, metrics, chaos),
+        jnp.arange(n_rounds, dtype=I32),
+    )
+    res = (
+        jax.tree.unflatten(tree_s, flat_s),
+        jax.tree.unflatten(tree_f, flat_f),
+    )
+    if metrics is not None:
+        res += (metrics,)
+    if chaos is not None:
+        res += (chaos,)
+    return res
+
+
+_PALLAS_STATIC = (
+    "v",
+    "tile_lanes",
+    "n_rounds",
+    "do_tick",
+    "auto_propose",
+    "auto_compact_lag",
+    "ops_first_round_only",
+    "interpret",
+)
+
+# donating/copying twins, mirroring ops/fused.py: the donating twin MUST be
+# dispatched under fused._no_persistent_cache (jax 0.4.37 deserializes
+# donating executables that mis-execute; see fused.py)
+_pallas_rounds_jit = jax.jit(
+    pallas_rounds,
+    static_argnames=_PALLAS_STATIC,
+    donate_argnums=(0, 1),
+    donate_argnames=("metrics", "chaos"),
+)
+_pallas_rounds_nodonate_jit = jax.jit(
+    pallas_rounds, static_argnames=_PALLAS_STATIC
+)

@@ -24,11 +24,24 @@ neighbors, which a pallas_call boundary would prevent. So this kernel is
 kept as a validated, benchmarked alternative (tests/test_quorum_pallas.py
 asserts bit-equality in interpret mode and the TPU microbench above runs it
 compiled), not wired in by default.
+
+The joint form deserves emphasis: even though `_joint_kernel` already fuses
+both halves' reductions AND their min into one VMEM pass (there is nothing
+left to fuse), it pays the relayout TWICE (three [N, V] operands vs two) and
+XLA's joint path shares the transposed operand between halves — hence
+2.3x slower despite the tighter kernel. `joint_committed_dispatch` below
+therefore routes joint configs to the XLA path by default; the pallas
+kernel runs only on explicit request (engine="pallas" or
+RAFT_TPU_QUORUM_PALLAS=1), mirroring the opt-in posture of the full-round
+engine (ops/pallas_round.py, RAFT_TPU_ENGINE=pallas) where the whole round
+— not one reduction — crosses the pallas_call boundary and the relayout
+amortizes over every phase.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -134,3 +147,31 @@ def joint_committed_pallas(match, mask_in, mask_out, interpret: bool | None = No
         _pad(mask_out, n_pad, v),
     )
     return out[0, :n]
+
+
+def joint_committed_dispatch(
+    match, mask_in, mask_out, *, engine: str | None = None,
+    interpret: bool | None = None,
+):
+    """JointConfig.CommittedIndex with the measured-fastest default: XLA
+    (2.49 ms vs the fused kernel's 5.77 ms at 1M x 7 — the kernel pays the
+    voter-major relayout once per operand, see module doc). The pallas
+    kernel runs only on explicit opt-in: engine="pallas" or
+    RAFT_TPU_QUORUM_PALLAS=1. Outputs are bit-identical either way
+    (tests/test_quorum_pallas.py)."""
+    e = engine
+    if e is None:
+        e = (
+            "pallas"
+            if os.environ.get("RAFT_TPU_QUORUM_PALLAS", "0") not in ("0", "")
+            else "xla"
+        )
+    if e == "pallas":
+        return joint_committed_pallas(
+            match, mask_in, mask_out, interpret=interpret
+        )
+    if e != "xla":
+        raise ValueError(f"unknown engine {e!r}: expected 'xla' or 'pallas'")
+    from raft_tpu.ops.quorum import joint_committed
+
+    return joint_committed(match, mask_in, mask_out)

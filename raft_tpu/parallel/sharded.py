@@ -16,6 +16,7 @@ device path.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -370,6 +371,25 @@ class ShardedFusedCluster:
         self.inner = FusedCluster(n_groups, n_voters, seed=seed, **cfg)
         self.g, self.v = n_groups, n_voters
         n = n_groups * n_voters
+        self.n_shards = len(devices)
+        self.lanes_per_shard = n // len(devices)
+        self._shard_tile = None
+        if straddle and self.inner.engine == "pallas":
+            # the pallas kernel's router is strictly tile-local; the halo
+            # ppermute of the straddle path has no kernel analog
+            if cfg.get("engine"):
+                raise ValueError(
+                    "engine='pallas' does not support straddle=True: the "
+                    "in-kernel router never crosses a lane tile, let alone "
+                    "a shard boundary (drop straddle or use engine='xla')"
+                )
+            from raft_tpu.metrics.host import record_engine_fallback
+
+            record_engine_fallback(
+                f"ShardedFusedCluster(straddle, n={n}, v={n_voters})",
+                RuntimeError("straddle unsupported on the pallas engine"),
+            )
+            self.inner.engine = "xla"
         self.mesh, self.lane_sharding, shard_lanes = make_group_mesh(devices, n)
         self.inner.state = jax.tree.map(shard_lanes, self.inner.state)
         self.inner.fab = jax.tree.map(shard_lanes, self.inner.fab)
@@ -396,9 +416,29 @@ class ShardedFusedCluster:
         # ops/mute stay un-donated (self._no_ops and inner.mute are re-fed)
         self._donate = _donation_enabled()
 
+    def _resolve_shard_tile(self) -> int:
+        """Lane tile for the PER-SHARD pallas grid (the kernel sees
+        lanes_per_shard lanes inside shard_map). Explicit ctor tile_lanes >
+        RAFT_TPU_PALLAS_TILE env > default_tile; no autotune sweep here —
+        the per-shard sweep would time the whole collective program."""
+        if self._shard_tile is not None:
+            return self._shard_tile
+        from raft_tpu.ops import pallas_round as plr
+
+        t = self.inner._tile_req
+        if t is None:
+            env = os.environ.get("RAFT_TPU_PALLAS_TILE")
+            t = int(env) if env else None
+        if t is None:
+            t = plr.default_tile(self.lanes_per_shard, self.v)
+        plr.check_tile(self.lanes_per_shard, self.v, t)
+        self._shard_tile = t
+        return t
+
     def run(self, rounds: int = 1, ops=None, do_tick: bool = True,
             auto_propose: bool = False, auto_compact_lag=None):
         from raft_tpu.ops.fused import fused_rounds
+        from raft_tpu.ops import pallas_round as plr
 
         ops = (
             self._no_ops
@@ -411,19 +451,42 @@ class ShardedFusedCluster:
         ch = self.inner.chaos
         has_met, has_ch = met is not None, ch is not None
         extras = [x for x in (met, ch) if x is not None]
-        key = (rounds, do_tick, auto_propose, auto_compact_lag)
+        engine = self.inner.engine
+        tile = interp = None
+        if engine == "pallas":
+            # tile/force-fail problems surface here, before the carry is
+            # handed to a donating dispatch (TileErrors still propagate)
+            try:
+                plr.maybe_force_fail()
+                tile = self._resolve_shard_tile()
+                interp = plr.default_interpret()
+            except plr.TileError:
+                raise
+            except Exception as e:
+                self._fall_back(e)
+                engine = "xla"
+        key = (engine, rounds, do_tick, auto_propose, auto_compact_lag)
         if key not in self._cache:
 
             def stepper(st, f, o, m, *ex):
                 mt = ex[0] if has_met else None
                 c = ex[int(has_met)] if has_ch else None
-                res = fused_rounds(
-                    st, f, o, m,
-                    v=self.v, n_rounds=rounds, do_tick=do_tick,
-                    auto_propose=auto_propose,
-                    auto_compact_lag=auto_compact_lag,
-                    straddle=self._spec, metrics=mt, chaos=c,
-                )
+                if engine == "pallas":
+                    res = plr.pallas_rounds(
+                        st, f, o, m,
+                        v=self.v, tile_lanes=tile, n_rounds=rounds,
+                        do_tick=do_tick, auto_propose=auto_propose,
+                        auto_compact_lag=auto_compact_lag,
+                        interpret=interp, metrics=mt, chaos=c,
+                    )
+                else:
+                    res = fused_rounds(
+                        st, f, o, m,
+                        v=self.v, n_rounds=rounds, do_tick=do_tick,
+                        auto_propose=auto_propose,
+                        auto_compact_lag=auto_compact_lag,
+                        straddle=self._spec, metrics=mt, chaos=c,
+                    )
                 out = [res[0], res[1]]
                 j = 2
                 if has_met:
@@ -507,10 +570,23 @@ class ShardedFusedCluster:
             if self._donate:
                 donate = (0, 1) + tuple(range(4, 4 + len(extras)))
             self._cache[key] = jax.jit(fn, donate_argnums=donate)
-        with _no_persistent_cache(self._donate):
-            res = self._cache[key](
-                self.inner.state, self.inner.fab, ops, self.inner.mute,
-                *extras,
+        try:
+            with _no_persistent_cache(self._donate):
+                res = self._cache[key](
+                    self.inner.state, self.inner.fab, ops, self.inner.mute,
+                    *extras,
+                )
+        except Exception as e:
+            if engine != "pallas" or isinstance(e, plr.TileError):
+                raise
+            # Mosaic lowering fails at trace/compile time, before any
+            # donated buffer is consumed: the carry is intact, redrive the
+            # same rounds on the XLA stepper
+            self._fall_back(e)
+            return self.run(
+                rounds, ops=ops, do_tick=do_tick,
+                auto_propose=auto_propose,
+                auto_compact_lag=auto_compact_lag,
             )
         self.inner.state, self.inner.fab = res[0], res[1]
         j = 2
@@ -519,6 +595,18 @@ class ShardedFusedCluster:
             j += 1
         if has_ch:
             self.inner.chaos = res[j]
+
+    def _fall_back(self, err):
+        """Log the pallas -> XLA engine fallback once via the metrics host
+        plane and flip the inner engine (sticky for this cluster)."""
+        from raft_tpu.metrics.host import record_engine_fallback
+
+        record_engine_fallback(
+            f"ShardedFusedCluster(n={self.g * self.v}, v={self.v}, "
+            f"shards={self.n_shards}, backend={jax.default_backend()})",
+            err,
+        )
+        self.inner.engine = "xla"
 
     def set_chaos(self, **cols):
         """Install chaos columns, then re-shard them over the mesh (the

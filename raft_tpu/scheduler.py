@@ -60,12 +60,21 @@ class BlockedFusedCluster:
     The driving API mirrors FusedCluster; per-lane injections address lanes
     in global order (block i owns global lanes [i*B*V, (i+1)*B*V)).
 
+    Engine selection (`engine=` / `RAFT_TPU_ENGINE`, ops/pallas_round.py)
+    flows through **cfg to every resident block's FusedCluster: all K
+    blocks share one pallas kernel signature exactly like they share the
+    XLA one, and a per-block fallback flips only that block (the shared
+    compile cache makes the first block's failure everyone's fallback in
+    practice).
+
     round_chunk: rounds per dispatch in the round-major sweep (default 1 =
     strict round-major interleave; larger values amortize per-dispatch host
     overhead by letting each block scan `round_chunk` rounds between
     interleave points — trajectories are bit-identical either way).
     pipeline_depth: max enqueued-but-unfinished dispatches before the host
     blocks on the oldest (None = unbounded)."""
+
+    _OPS_CACHE_SLOTS = 2
 
     def __init__(
         self,
@@ -92,10 +101,14 @@ class BlockedFusedCluster:
         self.round_chunk = round_chunk
         self.pipeline_depth = pipeline_depth
         self._inflight: deque = deque()
-        # single-slot identity cache: (ops object, its per-block slices).
-        # Holding the ops reference pins its id, so the identity test can
-        # never false-positive on a recycled address.
-        self._ops_cache: tuple | None = None
+        # small identity LRU: [(ops object, its per-block slices), ...],
+        # most-recent-first, capacity _OPS_CACHE_SLOTS. Holding the ops
+        # references pins their ids, so the identity test can never
+        # false-positive on a recycled address. Two slots (not one) so the
+        # common alternation pattern — a driver flipping between two
+        # prepared ops objects round after round — hits every time instead
+        # of silently re-slicing K subtrees per call.
+        self._ops_cache: list = []
         # distinct seeds decorrelate election timeouts across blocks
         self.blocks = [
             FusedCluster(
@@ -133,11 +146,14 @@ class BlockedFusedCluster:
                     f"block: got {len(ops)}, expected {self.k}"
                 )
             return list(ops)
-        cached = self._ops_cache
-        if cached is not None and cached[0] is ops:
-            return cached[1]
+        for j, (obj, per) in enumerate(self._ops_cache):
+            if obj is ops:
+                if j:  # refresh LRU order
+                    self._ops_cache.insert(0, self._ops_cache.pop(j))
+                return per
         per = self.prepare_ops(ops)
-        self._ops_cache = (ops, per)
+        self._ops_cache.insert(0, (ops, per))
+        del self._ops_cache[self._OPS_CACHE_SLOTS:]
         return per
 
     def _check_wal(self, wal) -> list:

@@ -147,3 +147,35 @@ def vote_resp_msg_type(t: MessageType) -> MessageType:
     if t == MessageType.MSG_PRE_VOTE:
         return MessageType.MSG_PRE_VOTE_RESP
     raise ValueError(f"not a vote message: {t}")
+
+
+def register_literal_enums(*enum_types: type) -> None:
+    """Teach jax to inline IntEnum members as jaxpr literals.
+
+    Enum members reach jax primitives as raw Python scalars (weak-type
+    promotion deliberately leaves them un-arrayed), but jax's literal check
+    is an exact-type test, so `int` *subclasses* are lifted to jaxpr
+    constants instead of inline literals. That is harmless under plain jit
+    (XLA folds them), but `pallas_call` rejects any kernel that captures
+    constants, which would bar the fused round from the pallas engine
+    (ops/pallas_round.py). Registering the enum types keeps every
+    `MT.MSG_NONE`-style scalar inline; values are unchanged either way.
+    """
+    try:
+        from jax._src.core import literalable_types
+    except Exception:  # pragma: no cover - jax internals moved
+        return
+    for t in enum_types:
+        literalable_types.add(t)
+
+
+register_literal_enums(
+    EntryType,
+    MessageType,
+    StateType,
+    ProgressState,
+    VoteState,
+    VoteResult,
+    ReadOnlyOption,
+    CampaignType,
+)

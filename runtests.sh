@@ -47,6 +47,7 @@ smokes() {
   # through run_bench like RAFT_TPU_COMPILE_CACHE)
   run_bench benches/metrics_smoke.py \
     && run_bench benches/dispatch_ab.py \
+    && run_bench benches/pallas_ab.py --smoke \
     && run_bench benches/chaos_soak.py --smoke
 }
 
@@ -87,6 +88,10 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
       tests/test_snapshot.py tests/test_status.py tests/test_transfer.py \
       tests/test_unstable.py tests/test_util_ports.py tests/test_vote_states.py \
       tests/test_wal.py
+    # the pallas interpret-mode engine smoke gets its own process: each of
+    # its kernel variants is one large interpreted scan program, and the
+    # CI-asserted bit-identity (pallas vs XLA trajectories) lives here
+    run_chunk tests/test_pallas_round.py
     run_chunk tests/test_sharded.py
     smokes
   fi

@@ -214,12 +214,12 @@ def test_blocked_ops_preslice_cache_and_list_binding():
     ops = c.ops(hup={0: True, 6: True})  # lane 6 lives in block 1
     per = c.prepare_ops(ops)
     assert len(per) == 2
-    # re-injecting the same object hits the identity cache
+    # re-injecting the same object hits the identity LRU (slot 0 = MRU)
     c.run(1, ops=ops, do_tick=False)
-    assert c._ops_cache is not None and c._ops_cache[0] is ops
-    cached = c._ops_cache[1]
+    assert c._ops_cache and c._ops_cache[0][0] is ops
+    cached = c._ops_cache[0][1]
     c.run(1, ops=ops, do_tick=False)
-    assert c._ops_cache[1] is cached
+    assert c._ops_cache[0][1] is cached
     # a prepare_ops list binds as-is; wrong length is rejected
     c.run(1, ops=per, do_tick=False)
     with pytest.raises(ValueError, match="per-block ops list"):

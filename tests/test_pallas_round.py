@@ -1,0 +1,339 @@
+"""The VMEM-resident pallas round engine (raft_tpu/ops/pallas_round.py).
+
+Interpret mode on the CPU test rig (the same kernel compiles for real via
+Mosaic on TPU). The acceptance bar from the promotion PR:
+
+1. Bit-identity: RAFT_TPU_ENGINE=pallas walks the exact slim_state
+   trajectory of the XLA engine — every field, >= 32 rounds, and the
+   metrics/chaos carries agree too (the per-tile partial reduction and
+   the lane-offset chaos PRNG reconstruction are exact, not approximate).
+2. Tile invariant: tile_lanes % v == 0 and tile_lanes | n, rejected with
+   a clear TileError that is never swallowed by the fallback.
+3. Graceful degradation: a lowering failure (forced here via
+   RAFT_TPU_PALLAS_FORCE_FAIL) logs once through the metrics host plane
+   and flips the cluster to the XLA engine with the carry intact.
+4. Donation composes: the donating pallas twin runs under the jax 0.4.37
+   persistent-cache fence (fused._no_persistent_cache), deletes the old
+   carry, and changes no value vs the copying twin.
+
+Plus the satellites: BlockedFusedCluster ops-cache LRU regression, the
+blocked/sharded engine passthrough, and the tile helper unit coverage.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.chaos.device import probability
+from raft_tpu.config import Shape
+from raft_tpu.metrics.host import ENGINE_EVENTS
+from raft_tpu.ops import fused
+from raft_tpu.ops import pallas_round as plr
+from raft_tpu.ops.fused import FusedCluster
+from raft_tpu.parallel.sharded import ShardedFusedCluster
+from raft_tpu.scheduler import BlockedFusedCluster
+
+V = 3
+G = 4
+N = G * V
+TILE = 2 * V  # 2 tiles over 4 groups: exercises the program_id lane offset
+
+
+def _shape(n_lanes=N):
+    return Shape(
+        n_lanes=n_lanes, max_peers=V, log_window=8, max_msg_entries=2,
+        max_inflight=2, max_read_index=2,
+    )
+
+
+def _assert_trees_equal(a, b, what):
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = jax.tree.leaves(b)
+    assert len(la) == len(lb), what
+    for (path, x), y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (what, path)
+
+
+def _fallbacks():
+    return ENGINE_EVENTS.get("engine_pallas_fallback")
+
+
+# -- 1. bit-identity -------------------------------------------------------
+
+
+def test_trajectory_bit_identity_with_metrics_and_chaos(monkeypatch):
+    """>= 32 rounds, 2 lane tiles, metrics AND chaos threaded through the
+    kernel: every slim_state/fabric field plus both carries bit-identical
+    to the XLA path (the chaos PRNG is a pure function of the GLOBAL lane
+    index, so per-tile reconstruction must not shift it)."""
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "1")
+    c = FusedCluster(G, V, seed=7, shape=_shape())
+    c.set_chaos(
+        drop_num=np.full((N, V), probability(0.2), np.int32),
+        tick_skew_num=np.full(N, probability(0.1), np.int32),
+        heal_round=7,
+    )
+    kw = dict(
+        v=V, n_rounds=33, do_tick=True, auto_propose=True,
+        auto_compact_lag=4, ops_first_round_only=True,
+        metrics=c.metrics, chaos=c.chaos,
+    )
+    ref = fused._fused_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute, straddle=None, **kw
+    )
+    got = plr._pallas_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute,
+        tile_lanes=TILE, interpret=True, **kw
+    )
+    assert len(ref) == len(got) == 4
+    for r, g, what in zip(ref, got, ("state", "fabric", "metrics", "chaos")):
+        _assert_trees_equal(r, g, what)
+
+
+def test_bit_identity_without_extras(monkeypatch):
+    """Metrics/chaos elision holds on the kernel path: with both planes
+    off, the pallas call takes no partials outputs and still matches."""
+    monkeypatch.setenv("RAFT_TPU_METRICS", "0")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    c = FusedCluster(G, V, seed=3, shape=_shape())
+    assert c.metrics is None and c.chaos is None
+    kw = dict(
+        v=V, n_rounds=8, do_tick=True, auto_propose=True,
+        auto_compact_lag=4, ops_first_round_only=True,
+        metrics=None, chaos=None,
+    )
+    ref = fused._fused_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute, straddle=None, **kw
+    )
+    got = plr._pallas_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute,
+        tile_lanes=TILE, interpret=True, **kw
+    )
+    assert len(ref) == len(got) == 2
+    _assert_trees_equal(ref[0], got[0], "state")
+    _assert_trees_equal(ref[1], got[1], "fabric")
+
+
+# -- 2. tile invariant -----------------------------------------------------
+
+
+def test_tile_invariants_rejected():
+    plr.check_tile(12, 3, 6)  # group-aligned divisor: fine
+    with pytest.raises(plr.TileError, match="multiple of v"):
+        plr.check_tile(12, 3, 4)
+    with pytest.raises(plr.TileError, match="does not divide"):
+        plr.check_tile(12, 3, 9)
+    with pytest.raises(plr.TileError, match=">= 1"):
+        plr.check_tile(12, 3, 0)
+    # TileError is a config error: the cluster raises it and does NOT
+    # fall back (the engine stays pallas, nothing is logged)
+    before = _fallbacks()
+    c = FusedCluster(G, V, seed=1, shape=_shape(), engine="pallas",
+                     tile_lanes=4)
+    with pytest.raises(plr.TileError, match="multiple of v"):
+        c.run(1)
+    assert c.engine == "pallas"
+    assert _fallbacks() == before
+
+
+def test_autotune_sweep_caches_winner():
+    """The TPU first-dispatch sweep, exercised with a fake timer: fastest
+    candidate wins, the winner lands in the (shape, backend) cache, and a
+    second sweep under the same key never re-times."""
+    n, v = 4096 * 3, 3
+    cands = plr.tile_candidates(n, v)
+    assert len(cands) > 1
+    want = cands[len(cands) // 2]
+    timed = []
+
+    def fake_time(t):
+        timed.append(t)
+        return 0.5 if t == want else 1.0 + t * 1e-6
+
+    key = ("test-autotune-sweep", "tpu")
+    assert plr.autotune_tile(n, v, key=key, time_fn=fake_time) == want
+    assert timed == cands
+    assert plr.cached_tile(key) == want
+    # warm cache: no timing at all on the second resolve
+    assert plr.autotune_tile(n, v, key=key, time_fn=fake_time) == want
+    assert timed == cands
+
+
+def test_tile_helpers():
+    assert plr.default_tile(N, V) == N  # tiny batch: whole-batch tile
+    cands = plr.tile_candidates(4096 * 3, 3)
+    assert cands and all(c % 3 == 0 and (4096 * 3) % c == 0 for c in cands)
+    assert 4096 * 3 in cands
+    key = ("test-tile-helpers", "cpu")
+    assert plr.cached_tile(key) is None
+    plr.remember_tile(key, 6)
+    assert plr.cached_tile(key) == 6
+
+
+# -- engine selection ------------------------------------------------------
+
+
+def test_engine_selection(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_ENGINE", raising=False)
+    assert plr.resolve_engine() == "xla"
+    assert plr.resolve_engine("pallas") == "pallas"
+    monkeypatch.setenv("RAFT_TPU_ENGINE", "pallas")
+    assert plr.resolve_engine() == "pallas"
+    assert plr.resolve_engine("xla") == "xla"  # kwarg beats env
+    assert FusedCluster(G, V, seed=1, shape=_shape()).engine == "pallas"
+    with pytest.raises(ValueError, match="unknown engine"):
+        plr.resolve_engine("bogus")
+    monkeypatch.setenv("RAFT_TPU_ENGINE", "bogus")
+    with pytest.raises(ValueError, match="unknown engine"):
+        FusedCluster(G, V, seed=1, shape=_shape())
+
+
+# -- 3. forced lowering failure -> fallback --------------------------------
+
+
+def test_forced_lowering_failure_falls_back(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_METRICS", "0")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    ref = FusedCluster(G, V, seed=5, shape=_shape())
+    ref.run(4, auto_propose=True)
+    before = _fallbacks()
+    monkeypatch.setenv("RAFT_TPU_PALLAS_FORCE_FAIL", "1")
+    c = FusedCluster(G, V, seed=5, shape=_shape(), engine="pallas",
+                     tile_lanes=TILE)
+    c.run(4, auto_propose=True)  # must not raise
+    assert c.engine == "xla"
+    assert _fallbacks() == before + 1
+    _assert_trees_equal(ref.state, c.state, "fallback redrive diverged")
+    # sticky: later runs go straight to XLA, no second fallback record
+    ref.run(4, auto_propose=True)
+    c.run(4, auto_propose=True)
+    assert _fallbacks() == before + 1
+    _assert_trees_equal(ref.state, c.state, "post-fallback run diverged")
+
+
+# -- 4. donation x pallas under the cache fence ----------------------------
+
+
+def test_donation_composes_with_pallas_under_fence(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_METRICS", "0")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    monkeypatch.setenv("RAFT_TPU_DONATE", "1")
+    cache_flag = jax.config.jax_enable_compilation_cache
+    c = FusedCluster(G, V, seed=9, shape=_shape(), engine="pallas",
+                     tile_lanes=TILE)
+    assert c._donate
+    st0, fab0 = c.state, c.fab
+    c.run(4, auto_propose=True)
+    assert c.engine == "pallas"  # really dispatched on the kernel path
+    # the donated carry died in place; the fence restored the cache flag
+    assert st0.term.is_deleted()
+    assert fab0.rep.kind.is_deleted()
+    assert jax.config.jax_enable_compilation_cache == cache_flag
+    c.run(4, auto_propose=True)
+
+    monkeypatch.setenv("RAFT_TPU_DONATE", "0")
+    d = FusedCluster(G, V, seed=9, shape=_shape(), engine="pallas",
+                     tile_lanes=TILE)
+    dst0 = d.state
+    d.run(4, auto_propose=True)
+    d.run(4, auto_propose=True)
+    assert not dst0.term.is_deleted()  # copying twin keeps inputs alive
+    _assert_trees_equal(c.state, d.state, "donation changed a value")
+    _assert_trees_equal(c.fab, d.fab, "donation changed the fabric")
+
+
+# -- satellite: BlockedFusedCluster ops-cache LRU --------------------------
+
+
+def test_blocked_ops_cache_survives_alternation():
+    """Regression: the old single-slot identity cache re-sliced K subtrees
+    on EVERY call when a driver alternated two prepared ops objects."""
+    c = BlockedFusedCluster(4, 3, block_groups=2, seed=4, shape=_shape(6))
+    calls = []
+    orig = c.prepare_ops
+    c.prepare_ops = lambda ops: (calls.append(ops), orig(ops))[1]
+    o1 = c.ops(hup={0: True})
+    o2 = c.ops(hup={7: True})  # lane 7 lives in block 1
+    p1, p2 = c._bind_ops(o1), c._bind_ops(o2)
+    assert np.asarray(p1[0].hup)[0] and np.asarray(p2[1].hup)[1]
+    for _ in range(3):  # the failing pattern: strict alternation
+        assert c._bind_ops(o1) is p1
+        assert c._bind_ops(o2) is p2
+    assert len(calls) == 2, "alternating ops objects re-sliced the cache"
+    # a third object evicts the least-recently-used (o1), keeps o2
+    o3 = c.ops(hup={3: True})
+    p3 = c._bind_ops(o3)
+    assert c._bind_ops(o2) is p2 and c._bind_ops(o3) is p3
+    assert len(calls) == 3
+    assert c._bind_ops(o1) is not p1  # evicted: rebuilt fresh
+    assert len(calls) == 4
+
+
+# -- satellite: blocked + sharded engine passthrough -----------------------
+
+
+def test_blocked_engine_passthrough_parity(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_METRICS", "0")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    bp = BlockedFusedCluster(4, 3, block_groups=2, seed=3, shape=_shape(6),
+                             engine="pallas", tile_lanes=6)
+    assert [b.engine for b in bp.blocks] == ["pallas", "pallas"]
+    bx = BlockedFusedCluster(4, 3, block_groups=2, seed=3, shape=_shape(6))
+    bp.run(4, auto_propose=True)
+    bx.run(4, auto_propose=True)
+    for p, x in zip(bp.blocks, bx.blocks):
+        assert p.engine == "pallas"
+        _assert_trees_equal(x.state, p.state, "blocked engine diverged")
+
+
+def test_sharded_engine_parity(monkeypatch):
+    # 2 shards x 6 lanes, tile 3: TWO pallas tiles inside EACH shard, so
+    # the kernel's lane offsets nest under shard_map's lane slicing
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    dev = jax.devices()[:2]
+    sx = ShardedFusedCluster(G, V, seed=7, shape=_shape(), engine="xla",
+                             devices=dev)
+    sp = ShardedFusedCluster(G, V, seed=7, shape=_shape(), engine="pallas",
+                             tile_lanes=V, devices=dev)
+    sx.run(8, auto_propose=True)
+    sp.run(8, auto_propose=True)
+    assert sp.inner.engine == "pallas"
+    _assert_trees_equal(sx.inner.state, sp.inner.state, "sharded state")
+    _assert_trees_equal(sx.inner.metrics, sp.inner.metrics, "sharded metrics")
+
+
+def test_sharded_straddle_vs_pallas(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    dev = jax.devices()[:2]
+    # explicit request is a hard error (the in-kernel router is tile-local)
+    with pytest.raises(ValueError, match="straddle"):
+        ShardedFusedCluster(G, V, seed=1, shape=_shape(), engine="pallas",
+                            straddle=True, devices=dev)
+    # env-selected pallas degrades to XLA with one host-plane record
+    before = _fallbacks()
+    monkeypatch.setenv("RAFT_TPU_ENGINE", "pallas")
+    s = ShardedFusedCluster(G, V, seed=1, shape=_shape(), straddle=True,
+                            devices=dev)
+    assert s.inner.engine == "xla"
+    assert _fallbacks() == before + 1
+
+
+def test_sharded_forced_failure_falls_back(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_METRICS", "0")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    dev = jax.devices()[:2]
+    ref = ShardedFusedCluster(G, V, seed=5, shape=_shape(), devices=dev)
+    ref.run(4, auto_propose=True)
+    before = _fallbacks()
+    monkeypatch.setenv("RAFT_TPU_PALLAS_FORCE_FAIL", "1")
+    s = ShardedFusedCluster(G, V, seed=5, shape=_shape(), engine="pallas",
+                            tile_lanes=V, devices=dev)
+    s.run(4, auto_propose=True)
+    assert s.inner.engine == "xla"
+    assert _fallbacks() == before + 1
+    _assert_trees_equal(ref.inner.state, s.inner.state, "sharded fallback")
