@@ -1,0 +1,165 @@
+"""Egress A/B serving smoke: scalar-poll vs batched-mask Ready serving.
+
+Runs the SAME multi-group serving workload twice in fresh subprocesses —
+RAFT_TPU_EGRESS=0 (per-lane scalar has_ready polls) then =1 (the batched
+ready-mask kernel, ops/ready_mask.py) — and asserts, per the ISSUE 5
+acceptance bar:
+
+  1. the two runs produce BIT-IDENTICAL Ready sequences (sha256 digest
+     over every (lane, Ready) consumed, in serving order): the mask path
+     is an optimization, never a behavior change, and
+  2. the mask path's host scans STRICTLY fewer lanes
+     (egress_lanes_scanned: N per poll scalar vs only the active set) —
+     the O(N) -> O(active) conversion, on a workload where only 1-2 of
+     the groups are active per iteration, and
+  3. on TPU only: mask-path host ms/round must not regress past
+     AB_EGRESS_TOL x the scalar path (CPU wall clocks in the 1-core
+     container are too noisy to gate on).
+
+Exit code 0 = pass, 1 = regression. Prints one JSON summary line with the
+lanes-scanned ratio + host ms/round extras.
+Env: AB_EGRESS_GROUPS, AB_EGRESS_ITERS, AB_EGRESS_TOL.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def child():
+    import time
+
+    import numpy as np
+
+    from raft_tpu.api.rawnode import RawNodeBatch
+    from raft_tpu.config import Shape
+    from raft_tpu.ops.ready_mask import egress_enabled
+
+    groups = int(os.environ.get("AB_EGRESS_GROUPS", 8))
+    iters = int(os.environ.get("AB_EGRESS_ITERS", 30))
+    voters = 3
+    n = groups * voters
+    shape = Shape(n_lanes=n, max_peers=4)
+    ids = list(np.tile(np.arange(1, voters + 1, dtype=np.int32), groups))
+    peers = np.zeros((n, shape.v), np.int32)
+    peers[:, :voters] = np.arange(1, voters + 1)
+    b = RawNodeBatch(shape, ids, peers, seed=11)
+
+    digest = hashlib.sha256()
+    polls = 0
+
+    def serve(max_sweeps=200):
+        # the ONE serving loop both modes run: ready_lanes() is the mask
+        # kernel when egress is on and the scalar sweep when off; the
+        # digest pins the consumed Ready sequence bit-identical across
+        # the two. An earlier lane's advance/step can flip a later
+        # lane's readiness, hence the has_ready re-check.
+        nonlocal polls
+        for _ in range(max_sweeps):
+            lanes = b.ready_lanes()
+            polls += 1
+            if not lanes:
+                return
+            for lane in lanes:
+                if not b.has_ready(lane):
+                    continue
+                rd = b.ready(lane)
+                digest.update(repr((lane, rd)).encode())
+                b.advance(lane)
+                base = (lane // voters) * voters
+                for m in rd.messages:
+                    if 1 <= m.to <= voters:
+                        b.step(base + m.to - 1, m)
+        raise RuntimeError("serving loop did not quiesce")
+
+    # elect every group's lane-0 member
+    for g in range(groups):
+        b.campaign(g * voters)
+    serve()
+
+    # sparse serving: only 1-2 groups take writes per iteration — the
+    # scalar path still pays an N-lane poll every sweep
+    t0 = time.perf_counter()
+    for i in range(iters):
+        b.propose((i % groups) * voters, b"op-%d" % i)
+        if i % 3 == 0:
+            b.propose(((i * 5 + 2) % groups) * voters, b"op2-%d" % i)
+        serve()
+    dt = time.perf_counter() - t0
+
+    import jax
+
+    print(json.dumps({
+        "egress": egress_enabled(),
+        "backend": jax.default_backend(),
+        "digest": digest.hexdigest(),
+        "lanes": n,
+        "polls": polls,
+        "lanes_scanned": b.metrics.get("egress_lanes_scanned"),
+        "lanes_active": b.metrics.get("egress_lanes_active"),
+        "host_ms_per_round": round(dt * 1000 / iters, 3),
+    }))
+
+
+def run_child(egress: str) -> dict:
+    env = dict(os.environ, RAFT_TPU_EGRESS=egress)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    tol = float(os.environ.get("AB_EGRESS_TOL", 1.5))
+    off = run_child("0")
+    on = run_child("1")
+    digest_ok = on["digest"] == off["digest"]
+    scan_ok = on["lanes_scanned"] < off["lanes_scanned"]
+    ratio = on["lanes_scanned"] / max(1, off["lanes_scanned"])
+    perf_ok = True
+    if on["backend"] == "tpu":
+        perf_ok = on["host_ms_per_round"] <= tol * off["host_ms_per_round"]
+    print(json.dumps({
+        "metric": "egress_ab",
+        "ok": digest_ok and scan_ok and perf_ok,
+        "digest_equal": digest_ok,
+        "lanes_scanned_on": on["lanes_scanned"],
+        "lanes_scanned_off": off["lanes_scanned"],
+        "lanes_scanned_ratio_on_over_off": round(ratio, 3),
+        "lanes_active": on["lanes_active"],
+        "host_ms_per_round_on": on["host_ms_per_round"],
+        "host_ms_per_round_off": off["host_ms_per_round"],
+        "tol": tol,
+    }))
+    if not digest_ok:
+        print(
+            "FAIL: mask-path Ready sequence diverged from the scalar path "
+            f"(digest {on['digest'][:16]} != {off['digest'][:16]})",
+            file=sys.stderr,
+        )
+    if not scan_ok:
+        print(
+            f"FAIL: mask path scanned {on['lanes_scanned']} lanes, not "
+            f"strictly fewer than scalar ({off['lanes_scanned']})",
+            file=sys.stderr,
+        )
+    if not perf_ok:
+        print(
+            f"FAIL: mask-path host ms/round {on['host_ms_per_round']} > "
+            f"{tol} x scalar {off['host_ms_per_round']}", file=sys.stderr,
+        )
+    sys.exit(0 if (digest_ok and scan_ok and perf_ok) else 1)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

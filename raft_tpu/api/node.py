@@ -113,16 +113,17 @@ class NodeHost:
                 op = None
             if op is not None:
                 self._handle(op)
-            # surface Readys for lanes that want them (readyc select arm)
-            for lane in range(self.batch.shape.n):
+            # surface Readys for lanes that want them (readyc select arm);
+            # ready_lanes is the batched egress mask — ONE device dispatch
+            # for all lanes instead of a scalar has_ready poll per lane
+            for lane in self.batch.ready_lanes():
                 if self._advance_pending[lane]:
                     continue
                 if not self._ready_q[lane].empty():
                     continue
-                if self.batch.has_ready(lane):
-                    rd = self.batch.ready(lane)
-                    self._advance_pending[lane] = True
-                    self._ready_q[lane].put(rd)
+                rd = self.batch.ready(lane)
+                self._advance_pending[lane] = True
+                self._ready_q[lane].put(rd)
 
     def _handle(self, op: _Op):
         b = self.batch

@@ -294,20 +294,31 @@ def _msg_to_row(msg: Message, e: int) -> dict:
 
 
 class _StateView:
-    """Cached numpy view of the device state, refreshed after kernel calls."""
+    """Cached numpy view of the device state, refreshed after kernel calls.
+
+    `version` stamps every refresh: between two refreshes each field is
+    pulled D2H at most once (the first access), so repeated has_ready /
+    ready calls between steps never re-transfer — consumers key derived
+    caches (the batched egress bundle) on it. `transfers` counts the
+    per-field D2H pulls; tests/test_egress.py asserts it stays flat across
+    repeated polls of an unchanged state."""
 
     def __init__(self):
         self._cache = None
         self._state = None
+        self.version = 0
+        self.transfers = 0
 
     def refresh(self, state: RaftState):
         self._state = state
         self._cache = {}
+        self.version += 1
 
     def __getattr__(self, name):
         if self._cache is None:
             raise AttributeError(name)
         if name not in self._cache:
+            self.transfers += 1
             self._cache[name] = np.asarray(getattr(self._state, name))
         return self._cache[name]
 
@@ -408,6 +419,19 @@ class RawNodeBatch:
         # monotonic: a released ticket is never reissued, so a live pending
         # request can't have its _ctx_rev entry clobbered by a later intern
         self._next_ctx_ticket = -2
+        # egress plane (raft_tpu/ops/ready_mask.py): RAFT_TPU_EGRESS is
+        # read at construction like the metrics plane; when off,
+        # ready_lanes() falls back to the scalar per-lane poll and the
+        # mask kernel is never traced. The cached bundle is keyed on
+        # (view.version, host epoch): the epoch covers readiness-relevant
+        # host mutations that don't touch device state (acceptReady's
+        # cursor updates, async-mode toggles).
+        from raft_tpu.ops import ready_mask as _rmask
+
+        self._egress_on = _rmask.egress_enabled()
+        self._bundle = None
+        self._bundle_key = None
+        self._host_epoch = 0
         e = shape.max_msg_entries
         (
             self._step_fn,
@@ -923,11 +947,104 @@ class RawNodeBatch:
 
     # -- Ready/Advance (reference: rawnode.go:141-200, 404-491) ------------
 
+    def _host_dirty(self):
+        """Invalidate the batched ready bundle after a host-only mutation
+        of readiness-relevant cursors (device-state mutations invalidate
+        via view.version instead)."""
+        self._host_epoch += 1
+
+    def _bundle_fresh(self) -> bool:
+        return (
+            self._bundle is not None
+            and self._bundle_key == (self.view.version, self._host_epoch)
+        )
+
+    def _refresh_bundle(self):
+        """Evaluate the batched ready predicate (ops/ready_mask.py) for all
+        N lanes — ONE device dispatch + one transfer — unless the cached
+        bundle still reflects (device state, host cursors)."""
+        if self._bundle_fresh():
+            return self._bundle
+        from raft_tpu.ops import ready_mask as _rmask
+
+        n = self.shape.n
+        key = (self.view.version, self._host_epoch)
+        host = _rmask.HostCursors(
+            prev_term=np.array([h.term for h in self._prev_hs], np.int32),
+            prev_vote=np.array([h.vote for h in self._prev_hs], np.int32),
+            prev_commit=np.array([h.commit for h in self._prev_hs], np.int32),
+            prev_lead=np.array([s.lead for s in self._prev_ss], np.int32),
+            prev_state=np.array(
+                [s.raft_state for s in self._prev_ss], np.int32
+            ),
+            host_pending=np.array(
+                [
+                    bool(
+                        self._after_append[lane]
+                        or self._msgs[lane]
+                        or self._read_states[lane]
+                    )
+                    for lane in range(n)
+                ],
+                bool,
+            ),
+            is_async=np.array(self._async, bool),
+            inprog=np.array(self._inprog, np.int32),
+            snap_inprog=np.array(self._snap_inprog, np.int32),
+            applying=np.array(self._applying, np.int32),
+        )
+        self._bundle = _rmask.compute_bundle(self.state, host)
+        self._bundle_key = key
+        self.metrics.inc(
+            "egress_bytes", sum(a.nbytes for a in self._bundle)
+        )
+        return self._bundle
+
+    def ready_lanes(self) -> list[int]:
+        """Lanes with a pending Ready, evaluated batched in-device: one
+        dispatch + one transfer instead of N scalar polls; the result is
+        the kernel's cumsum-scatter-compacted active prefix (ascending
+        lane order, like the scalar sweep). Falls back to the scalar
+        has_ready sweep when RAFT_TPU_EGRESS=0.
+
+        egress_lanes_scanned counts the lanes the HOST examined (N for the
+        scalar sweep, only the active set on the batched path — the
+        O(N) -> O(active) conversion the A/B bench asserts);
+        egress_lanes_active counts the lanes surfaced."""
+        n = self.shape.n
+        if not self._egress_on:
+            lanes = [lane for lane in range(n) if self.has_ready(lane)]
+            self.metrics.inc("egress_lanes_scanned", n)
+            self.metrics.inc("egress_lanes_active", len(lanes))
+            return lanes
+        bd = self._refresh_bundle()
+        k = int(bd.count)
+        self.metrics.inc("egress_lanes_scanned", k)
+        self.metrics.inc("egress_lanes_active", k)
+        return [int(x) for x in bd.active[:k]]
+
     def has_ready(self, lane: int) -> bool:
         """The reference's cheap predicate set (rawnode.go:450-472) — NOT a
         full Ready construction; this is the serving loop's poll and must
-        stay O(1). tests/test_rawnode.py::test_has_ready_matches_peek keeps
-        it equivalent to `ready(peek=True).contains_updates()`."""
+        stay O(1). Answers from the fresh batched bundle when one is cached
+        (ready_lanes), falling back to the scalar path only when state
+        mutated since the last refresh.
+        tests/test_rawnode.py::test_has_ready_matches_peek keeps it
+        equivalent to `ready(peek=True).contains_updates()`."""
+        if (
+            self._after_append[lane]
+            or self._msgs[lane]
+            or self._read_states[lane]
+        ):
+            return True
+        if self._egress_on and self._bundle_fresh():
+            return bool(self._bundle.ready[lane])
+        return self._has_ready_scalar(lane)
+
+    def _has_ready_scalar(self, lane: int) -> bool:
+        """Per-lane scalar evaluation of the predicate — the batched
+        kernel's twin (ops/ready_mask.py ready_bundle); the parity property
+        test in tests/test_egress.py holds the two together."""
         if (
             self._after_append[lane]
             or self._msgs[lane]
@@ -964,28 +1081,68 @@ class RawNodeBatch:
             hi = lo - 1  # the staged snapshot must apply first
         return hi >= lo
 
+    def _lane_cursors(self, lane: int):
+        """The scalar cursor set Ready construction needs: (term, vote,
+        commit, lead, state, last, stabled, ent_lo, raw_psi, psi, lo, hi).
+        Served from the fresh batched bundle when one is cached (no
+        per-field scalar reads), else re-derived from the view with the
+        exact same formulas."""
+        if self._egress_on and self._bundle_fresh():
+            bd = self._bundle
+            return (
+                int(bd.term[lane]), int(bd.vote[lane]), int(bd.commit[lane]),
+                int(bd.lead[lane]), int(bd.state[lane]),
+                int(bd.last[lane]), int(bd.stabled[lane]),
+                int(bd.ent_lo[lane]), int(bd.psi_raw[lane]),
+                int(bd.psi[lane]), int(bd.apply_lo[lane]),
+                int(bd.apply_hi[lane]),
+            )
+        v = self.view
+        is_async = self._async[lane]
+        term, vote, commit = (
+            int(v.term[lane]), int(v.vote[lane]), int(v.committed[lane])
+        )
+        lead, st = int(v.lead[lane]), int(v.state[lane])
+        last, stabled = int(v.last[lane]), int(v.stabled[lane])
+        ent_lo = (
+            max(stabled, min(self._inprog[lane], last)) if is_async else stabled
+        )
+        raw_psi = int(v.pending_snap_index[lane])
+        psi = (
+            0 if (is_async and self._snap_inprog[lane] == raw_psi) else raw_psi
+        )
+        if is_async:
+            lo = max(int(v.applied[lane]), self._applying[lane]) + 1
+            hi = min(commit, stabled)
+        else:
+            lo, hi = int(v.applied[lane]) + 1, commit
+        if raw_psi:
+            hi = lo - 1  # snapshot must be applied first
+        return (
+            term, vote, commit, lead, st, last, stabled, ent_lo, raw_psi,
+            psi, lo, hi,
+        )
+
     def ready(self, lane: int, peek: bool = False) -> Ready:
         v = self.view
         nid = self.id_of(lane)
         is_async = self._async[lane]
         rd = Ready()
-        term, vote, commit = (
-            int(v.term[lane]),
-            int(v.vote[lane]),
-            int(v.committed[lane]),
-        )
+        # one cursor read: the fresh batched bundle when cached (no scalar
+        # re-derivation), the view otherwise (_lane_cursors)
+        (
+            term, vote, commit, lead, st, last, stabled, ent_lo, raw_psi,
+            psi, lo, hi,
+        ) = self._lane_cursors(lane)
         hs = HardState(term, vote, commit)
         if hs != self._prev_hs[lane] and not hs.is_empty():
             rd.hard_state = hs
-        ss = SoftState(int(v.lead[lane]), int(v.state[lane]))
+        ss = SoftState(lead, st)
         if ss != self._prev_ss[lane]:
             rd.soft_state = ss
         w = self.shape.w
-        last = int(v.last[lane])
-        stabled = int(v.stabled[lane])
         # unstable entries not yet handed to storage (async: skip in-progress;
         # reference log_unstable.go nextEntries/offsetInProgress)
-        ent_lo = max(stabled, min(self._inprog[lane], last)) if is_async else stabled
         for i in range(ent_lo + 1, last + 1):
             t = int(v.log_term[lane, i & (w - 1)])
             etype, data = self.store.get(lane, i, t)
@@ -993,26 +1150,19 @@ class RawNodeBatch:
         # pending snapshot to persist (reference Ready.Snapshot); in async
         # mode one already accepted by the append thread is withheld until
         # acked (unstable.nextSnapshot, log_unstable.go:84-90)
-        raw_psi = int(v.pending_snap_index[lane])
-        psi = 0 if (is_async and self._snap_inprog[lane] == raw_psi) else raw_psi
         if psi:
             snap = self.store.snapshot(lane)
             rd.snapshot = snap if snap and snap.index == psi else Snapshot(
                 index=psi, term=int(v.pending_snap_term[lane])
             )
-        # committed entries, paginated by proto-encoding size with limitSize's
-        # never-empty rule (log.go:216-240, util.go:266). Sync mode applies
-        # from `applied`; async applies from the accepted `applying` cursor
-        # and never applies unstable entries (rawnode.go applyUnstableEntries)
+        # committed entries in [lo, hi], paginated by proto-encoding size
+        # with limitSize's never-empty rule (log.go:216-240, util.go:266).
+        # Sync mode applies from `applied`; async applies from the accepted
+        # `applying` cursor and never applies unstable entries
+        # (rawnode.go applyUnstableEntries); a staged snapshot empties the
+        # window (it must be applied first, even one whose persistence is
+        # still in flight on the append thread)
         budget = int(np.asarray(self.state.cfg.max_committed_size_per_ready[lane]))
-        if is_async:
-            lo = max(int(v.applied[lane]), self._applying[lane]) + 1
-            hi = min(commit, stabled)
-        else:
-            lo, hi = int(v.applied[lane]) + 1, commit
-        if raw_psi:
-            hi = lo - 1  # snapshot must be applied first (even one whose
-            # persistence is still in flight on the append thread)
         size = 0
         for i in range(lo, hi + 1):
             t = int(v.log_term[lane, i & (w - 1)])
@@ -1098,6 +1248,9 @@ class RawNodeBatch:
                 self.view.refresh(self.state)
             self._accepted = getattr(self, "_accepted", {})
             self._accepted[lane] = rd
+            # acceptReady moved host-side cursors the device never saw
+            # (prev hard/soft state, drained queues, in-progress marks)
+            self._host_dirty()
         return rd
 
     def _storage_append_msg(self, lane: int, rd: Ready, aa: list) -> Message:
@@ -1157,6 +1310,7 @@ class RawNodeBatch:
     def set_async_storage_writes(self, lane: int, on: bool = True):
         """reference: raft.go:160-185 Config.AsyncStorageWrites."""
         self._async[lane] = on
+        self._host_dirty()  # the Ready shape (and thus readiness) changed
 
     def advance(self, lane: int):
         """reference: rawnode.go:479-491 — ack storage, then deliver the

@@ -98,6 +98,27 @@ class CounterAccumulator:
         }
 
 
+# egress-plane counter families (host plane only — the serving loops count
+# them at the Ready surface, raft_tpu/ops/ready_mask.py):
+#   egress_lanes_scanned   lanes the HOST examined per poll (N on the
+#                          scalar sweep, only the active set on the
+#                          batched mask path — their ratio is the
+#                          O(N) -> O(active) win benches/egress_ab.py
+#                          asserts)
+#   egress_lanes_active    lanes surfaced as ready
+#   egress_bytes           ready-bundle bytes shipped D2H
+#   bridge_pump_truncated  HostBridge.pump stopped at its iteration cap
+#                          with lanes still ready (NOT quiescent)
+#   bridge_drain_truncated same for BridgeEndpoint.drain
+EGRESS_COUNTERS = (
+    "egress_lanes_scanned",
+    "egress_lanes_active",
+    "egress_bytes",
+    "bridge_pump_truncated",
+    "bridge_drain_truncated",
+)
+
+
 class HostCounters:
     """Plain host-side counter bag speaking the snapshot schema — the
     RawNodeBatch/bridge analog of the device counters (no histogram)."""

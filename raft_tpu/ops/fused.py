@@ -1743,10 +1743,11 @@ class FusedCluster:
         # ops is re-fed (never donated), so the all-zeros LocalOps for
         # ops-less rounds is built once, not per dispatch
         self._no_ops = no_ops(n)
-        # the WalStream we last pushed to, if its delta may still hold
-        # references to our (donatable) current state — resolved before the
-        # next dispatch invalidates those buffers
+        # the WalStream/EgressStream we last pushed to, if their deltas may
+        # still hold references to our (donatable) current state — resolved
+        # before the next dispatch invalidates those buffers
         self._wal_pending = None
+        self._egress_pending = None
         # metrics plane (raft_tpu/metrics/): RAFT_TPU_METRICS is read at
         # construction; metrics=None keeps every metrics op out of the jaxpr
         self.metrics = metmod.init_metrics(n) if metmod.metrics_enabled() else None
@@ -1777,14 +1778,21 @@ class FusedCluster:
         auto_compact_lag: int | None = None,
         ops_first_round_only: bool = True,
         wal=None,
+        egress=None,
     ):
         """wal: an optional runtime.wal.WalStream — after this block's
         dispatch its delta starts streaming to the host asynchronously
         while the next block computes (the AsyncStorageWrites=true shape
-        on the fused engine; reference doc.go:172-258)."""
+        on the fused engine; reference doc.go:172-258).
+
+        egress: an optional runtime.egress.EgressStream — the serving-plane
+        twin: the batched ready/delta bundle (ops/ready_mask.py) for this
+        block rides D2H while the next block computes, handing the consumer
+        a dense active-lane vector one block behind the live state."""
         if ops is None:
             ops = self._no_ops
         self._flush_pending_wal()
+        self._flush_pending_egress()
         res = None
         if self.engine == "pallas":
             res = self._run_pallas(
@@ -1842,6 +1850,10 @@ class FusedCluster:
             wal.push(self.state)
             if self._donate:
                 self._wal_pending = wal
+        if egress is not None:
+            egress.push(self.state)
+            if self._donate:
+                self._egress_pending = egress
 
     def _flush_pending_wal(self):
         """Resolve a WAL delta that still references this cluster's current
@@ -1851,6 +1863,14 @@ class FusedCluster:
         if self._wal_pending is not None:
             self._wal_pending.flush()
             self._wal_pending = None
+
+    def _flush_pending_egress(self):
+        """Same fence for the egress bundle: its cursor columns may alias
+        the (donatable) carry, so the pending bundle resolves before the
+        next donating dispatch invalidates those buffers."""
+        if self._egress_pending is not None:
+            self._egress_pending.flush()
+            self._egress_pending = None
 
     # -- pallas engine (ops/pallas_round.py) ------------------------------
 
@@ -2046,6 +2066,7 @@ class FusedCluster:
             return out
         dj = jnp.asarray(deltas)
         self._flush_pending_wal()
+        self._flush_pending_egress()
         if self._donate:
             with _no_persistent_cache():
                 self.state = slim_state(

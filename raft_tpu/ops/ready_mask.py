@@ -1,0 +1,262 @@
+"""Batched ready-predicate kernel: `has_ready` for all lanes in one program.
+
+The reference's cheap poll (rawnode.go:450-472) costs ~10 scalar device
+reads per lane from the host, and every serving loop (node.go:343-454's
+readyc arm, the bridge pumps) re-evaluates it for EVERY lane every
+iteration — the serial-host-loop antipattern the Podracer architectures
+split warns about. This module evaluates the full condition set for all N
+lanes in ONE jitted dispatch:
+
+  ready  [N] bool — the has_ready verdict per lane (hard/soft-state change
+                    vs. the acceptReady cursors, unstable tail, pending
+                    snapshot, applicable committed window, read states,
+                    host-queue backlog);
+  active [N] i32  — ready lane indexes compacted to a dense prefix via
+                    cumsum-scatter (position = inclusive-scan - 1, scatter
+                    with out-of-bounds drop — the ragged-extraction shape),
+                    inactive tail filled with the sentinel N;
+  cursors         — the per-lane scalars Ready construction needs (the
+                    HardState/SoftState columns, the `ent_lo..last`
+                    unstable window, the `apply_lo..apply_hi` committed
+                    window, the snapshot gate `psi`), so the host builds
+                    each Ready without re-deriving them one scalar pull at
+                    a time.
+
+Two kernels share the compaction:
+
+  ready_bundle  — the RawNodeBatch predicate (host cursors ride in as a
+                  HostCursors column set; exact twin of the scalar
+                  RawNodeBatch.has_ready, held together by the parity
+                  property test in tests/test_egress.py);
+  delta_bundle  — the fused-engine variant for runtime/egress.py: lanes
+                  whose externally visible cursors moved since the
+                  previous pushed block.
+
+RAFT_TPU_EGRESS=0 elides both the same way the metrics/chaos planes elide
+theirs: consumers read egress_enabled() at construction and never trace or
+dispatch a kernel when off (tests/test_egress.py asserts kernel_calls()
+stays flat and the scalar path serves alone).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+
+# kernel dispatch count; the elision tests assert it stays flat while
+# RAFT_TPU_EGRESS=0 (the jaxpr-level claim: no mask program ever exists)
+_KERNEL_CALLS = 0
+
+
+def egress_enabled() -> bool:
+    """Read RAFT_TPU_EGRESS lazily (default ON) so tests can toggle it;
+    the value is baked into each consumer at construction, like the
+    metrics plane (raft_tpu/metrics/device.py metrics_enabled)."""
+    return os.environ.get("RAFT_TPU_EGRESS", "1") not in ("0", "", "off")
+
+
+def kernel_calls() -> int:
+    return _KERNEL_CALLS
+
+
+class HostCursors(NamedTuple):
+    """Per-lane host-side inputs to the predicate: the acceptReady cursors
+    (previous Hard/SoftState), the async-storage bookkeeping mirrors, and
+    one bool folding the host queues (_msgs/_after_append/_read_states
+    non-empty)."""
+
+    prev_term: jax.Array  # [N] i32
+    prev_vote: jax.Array  # [N] i32
+    prev_commit: jax.Array  # [N] i32
+    prev_lead: jax.Array  # [N] i32
+    prev_state: jax.Array  # [N] i32
+    host_pending: jax.Array  # [N] bool
+    is_async: jax.Array  # [N] bool
+    inprog: jax.Array  # [N] i32  unstable offsetInProgress
+    snap_inprog: jax.Array  # [N] i32  snapshot handed to the append thread
+    applying: jax.Array  # [N] i32  accepted applying cursor
+
+
+class ReadyBundle(NamedTuple):
+    """The kernel's output: verdicts, the compacted active-lane prefix,
+    and the cursor columns Ready construction consumes."""
+
+    ready: jax.Array  # [N] bool
+    active: jax.Array  # [N] i32, dense prefix of ready lanes, tail = N
+    count: jax.Array  # [] i32
+    term: jax.Array  # [N] i32
+    vote: jax.Array  # [N] i32
+    commit: jax.Array  # [N] i32
+    lead: jax.Array  # [N] i32
+    state: jax.Array  # [N] i32
+    last: jax.Array  # [N] i32
+    stabled: jax.Array  # [N] i32
+    ent_lo: jax.Array  # [N] i32  unstable window starts at ent_lo+1
+    psi_raw: jax.Array  # [N] i32  pending_snap_index before the async gate
+    psi: jax.Array  # [N] i32  snapshot index Ready must surface (0 = none)
+    apply_lo: jax.Array  # [N] i32
+    apply_hi: jax.Array  # [N] i32
+    rs_count: jax.Array  # [N] i32
+
+
+class PrevCursors(NamedTuple):
+    """The fused-engine delta baseline: the previous pushed block's
+    externally visible cursor columns."""
+
+    term: jax.Array
+    lead: jax.Array
+    state: jax.Array
+    committed: jax.Array
+    applied: jax.Array
+    last: jax.Array
+
+
+class DeltaBundle(NamedTuple):
+    changed: jax.Array  # [N] bool — any cursor moved since the prev block
+    active: jax.Array  # [N] i32 dense prefix of changed lanes, tail = N
+    count: jax.Array  # [] i32
+    term: jax.Array
+    lead: jax.Array
+    state: jax.Array
+    committed: jax.Array
+    applied: jax.Array
+    last: jax.Array
+
+
+def compact_mask(ready: jax.Array):
+    """Cumsum-scatter compaction of a bool mask into a dense index prefix:
+    active[cumsum(ready)[l]-1] = l for ready lanes, inactive positions keep
+    the sentinel N (out-of-bounds scatter indexes drop)."""
+    n = ready.shape[0]
+    r32 = ready.astype(I32)
+    pos = jnp.cumsum(r32) - 1
+    idx = jnp.where(ready, pos, n)
+    active = jnp.full((n,), n, I32).at[idx].set(
+        jnp.arange(n, dtype=I32), mode="drop"
+    )
+    return active, jnp.sum(r32)
+
+
+def ready_bundle(state, host: HostCursors) -> ReadyBundle:
+    """The full rawnode.go:450-472 predicate, batched. Must stay the exact
+    twin of the scalar RawNodeBatch._has_ready_scalar / _lane_cursors —
+    tests/test_egress.py::test_batched_scalar_parity holds them together."""
+
+    def i32(x):
+        return x.astype(I32)
+
+    term, vote = i32(state.term), i32(state.vote)
+    commit = i32(state.committed)
+    lead, st = i32(state.lead), i32(state.state)
+    last, stabled = i32(state.last), i32(state.stabled)
+    applied = i32(state.applied)
+    raw_psi = i32(state.pending_snap_index)
+    rs_count = i32(state.rs_count)
+    is_async = host.is_async
+
+    # unstable tail: async skips entries already in progress on the append
+    # thread (log_unstable.go nextEntries/offsetInProgress)
+    ent_lo = jnp.where(
+        is_async, jnp.maximum(stabled, jnp.minimum(host.inprog, last)), stabled
+    )
+    # pending snapshot, withheld while the append thread owns it
+    # (unstable.nextSnapshot, log_unstable.go:84-90)
+    snap_withheld = is_async & (host.snap_inprog == raw_psi)
+    psi = jnp.where(snap_withheld, 0, raw_psi)
+    # applicable committed window; a pending snapshot (even one whose
+    # persistence is in flight) must apply before any entries
+    apply_lo = (
+        jnp.where(is_async, jnp.maximum(applied, host.applying), applied) + 1
+    )
+    apply_hi = jnp.where(is_async, jnp.minimum(commit, stabled), commit)
+    apply_hi = jnp.where(raw_psi != 0, apply_lo - 1, apply_hi)
+
+    ss_changed = (lead != host.prev_lead) | (st != host.prev_state)
+    hs_nonempty = (term != 0) | (vote != 0) | (commit != 0)
+    hs_changed = (
+        (term != host.prev_term)
+        | (vote != host.prev_vote)
+        | (commit != host.prev_commit)
+    ) & hs_nonempty
+
+    ready = (
+        host.host_pending
+        | (rs_count > 0)
+        | ss_changed
+        | hs_changed
+        | (last > ent_lo)
+        | ((raw_psi != 0) & ~snap_withheld)
+        | (apply_hi >= apply_lo)
+    )
+    active, count = compact_mask(ready)
+    return ReadyBundle(
+        ready=ready, active=active, count=count,
+        term=term, vote=vote, commit=commit, lead=lead, state=st,
+        last=last, stabled=stabled, ent_lo=ent_lo,
+        psi_raw=raw_psi, psi=psi, apply_lo=apply_lo, apply_hi=apply_hi,
+        rs_count=rs_count,
+    )
+
+
+def delta_bundle(state, prev: PrevCursors) -> DeltaBundle:
+    """Fused-engine egress predicate: a lane is active when any externally
+    visible cursor moved since the previous pushed block."""
+
+    def i32(x):
+        return x.astype(I32)
+
+    term, lead, st = i32(state.term), i32(state.lead), i32(state.state)
+    committed, applied = i32(state.committed), i32(state.applied)
+    last = i32(state.last)
+    changed = (
+        (term != prev.term)
+        | (lead != prev.lead)
+        | (st != prev.state)
+        | (committed != prev.committed)
+        | (applied != prev.applied)
+        | (last != prev.last)
+    )
+    active, count = compact_mask(changed)
+    return DeltaBundle(
+        changed=changed, active=active, count=count,
+        term=term, lead=lead, state=st,
+        committed=committed, applied=applied, last=last,
+    )
+
+
+_bundle_jit = jax.jit(ready_bundle)
+_delta_jit = jax.jit(delta_bundle)
+
+
+def compute_bundle(state, host: HostCursors) -> ReadyBundle:
+    """Dispatch the batched predicate and resolve it to host numpy: ONE
+    device program and one overlapped transfer set for all N lanes
+    (copy_to_host_async on every leaf before the first blocking read)."""
+    global _KERNEL_CALLS
+    _KERNEL_CALLS += 1
+    dev = _bundle_jit(
+        state, HostCursors(*(jnp.asarray(a) for a in host))
+    )
+    for a in dev:
+        a.copy_to_host_async()
+    return ReadyBundle(*(np.asarray(a) for a in dev))
+
+
+def compute_delta(state, prev: PrevCursors | None) -> DeltaBundle:
+    """Dispatch the fused-engine delta kernel; the result arrays stay on
+    device so the caller can start copy_to_host_async and resolve a block
+    later (runtime/egress.py EgressStream)."""
+    global _KERNEL_CALLS
+    _KERNEL_CALLS += 1
+    if prev is None:
+        z = np.zeros(state.term.shape, np.int32)
+        prev = PrevCursors(z, z, z, z, z, z)
+    return _delta_jit(
+        state, PrevCursors(*(jnp.asarray(np.asarray(a, np.int32)) for a in prev))
+    )

@@ -29,6 +29,20 @@ from raft_tpu.api.rawnode import ErrProposalDropped, Message, RawNodeBatch
 from raft_tpu.types import MessageType as MTY
 
 
+class PumpResult(int):
+    """`HostBridge.pump`'s return value: the int is the iteration count
+    (drop-in for the plain int callers compare/print), and `truncated` is
+    True when the pump stopped at `max_iters` with lanes still ready. A
+    truncated pump must NOT be read as quiescent — messages are still
+    pending; it is also counted in the bridge_pump_truncated metrics
+    counter."""
+
+    def __new__(cls, iters: int, truncated: bool = False):
+        r = super().__new__(cls, iters)
+        r.truncated = truncated
+        return r
+
+
 class HostBridge:
     """Synchronous bridge over any number of RawNodeBatch "hosts".
 
@@ -43,6 +57,7 @@ class HostBridge:
         self._route: dict[int, tuple[int, int]] = {}  # raft id -> (host, lane)
         self.delivered = 0
         self.dropped = 0
+        self.pump_truncated = 0
         self.wire = wire
         # committed entries surfaced by pump(), keyed (host, lane) — the
         # application's state-machine input; ready()/advance() page entries
@@ -104,17 +119,23 @@ class HostBridge:
                 [(self._route[m.to][1], m) for m in batch], on_drop=on_drop
             )
 
-    def pump(self, max_iters: int = 100, on_commit=None) -> int:
+    def pump(self, max_iters: int = 100, on_commit=None) -> PumpResult:
         """Drain every host's Ready output and deliver until quiescent (the
         multi-host analog of the reference tests' network fixture,
         raft_test.go:4844). Committed entries — which ready()/advance() page
         out exactly once — go to `on_commit(host, lane, entry)` when given,
         else accumulate in `self.committed[(host, lane)]`. Returns the
-        number of iterations used."""
+        number of iterations used as a PumpResult; `.truncated` is True
+        when the iteration cap stopped the pump with work still pending
+        (also recorded in the bridge_pump_truncated counter) — never read
+        a truncated pump as quiescent."""
         for it in range(max_iters):
             moved = False
             for h, b in enumerate(self._hosts):
-                for lane in range(b.shape.n):
+                # only the lanes the batched egress mask marks active; a
+                # lane can lose readiness mid-sweep (deliver() steps into
+                # this very host), so re-check before constructing
+                for lane in b.ready_lanes():
                     if not b.has_ready(lane):
                         continue
                     rd = b.ready(lane)
@@ -130,8 +151,9 @@ class HostBridge:
                     self.deliver(msgs)
                     moved = True
             if not moved:
-                return it
-        raise RuntimeError("bridge did not quiesce")
+                return PumpResult(it)
+        self.pump_truncated += 1
+        return PumpResult(max_iters, truncated=True)
 
     def tick_all(self):
         for b in self._hosts:
@@ -146,6 +168,7 @@ class HostBridge:
         snap = merge_snapshots(b.metrics.snapshot() for b in self._hosts)
         snap["counters"]["bridge_delivered"] = self.delivered
         snap["counters"]["bridge_dropped"] = self.dropped
+        snap["counters"]["bridge_pump_truncated"] = self.pump_truncated
         return snap
 
 
@@ -565,19 +588,28 @@ class BridgeEndpoint:
         self.codec = _codec
         self.delivered = 0
         self.dropped = 0
+        # True when the last drain() stopped at its iteration cap with
+        # lanes still ready (also counted in bridge_drain_truncated) —
+        # the caller must drain again rather than read it as quiescent
+        self.truncated = False
         self.committed: dict[int, list] = {}
 
-    def drain(self) -> dict:
+    def drain(self, max_iters: int = 100) -> dict:
         """Run the local Ready/advance loop to its fixed point; returns
         {host key: frame bytes} of outbound traffic. Committed entries
         accumulate in self.committed[lane] (persist-before-send holds: the
-        sync Ready only surfaces messages the persist already covers)."""
+        sync Ready only surfaces messages the persist already covers).
+        Sets self.truncated when the cap stopped the loop early."""
         out: dict[object, list] = {}
         b = self.batch
-        for _ in range(100):
+        self.truncated = True
+        for _ in range(max_iters):
             moved = False
             local_msgs = []
-            for lane in range(b.shape.n):
+            # only the lanes the batched egress mask marks active; an
+            # earlier lane's advance can flip a later lane's readiness,
+            # so re-check before constructing
+            for lane in b.ready_lanes():
                 if not b.has_ready(lane):
                     continue
                 rd = b.ready(lane)
@@ -595,7 +627,10 @@ class BridgeEndpoint:
             if local_msgs:
                 self._step_local(local_msgs)
             if not moved:
+                self.truncated = False
                 break
+        if self.truncated:
+            self.batch.metrics.inc("bridge_drain_truncated")
         return {h: self.codec.pack_frame(ms) for h, ms in out.items()}
 
     def receive(self, frame: bytes):

@@ -1,0 +1,86 @@
+"""Double-buffered async egress of the batched ready bundle.
+
+The serving-plane twin of runtime/wal.py: where WalStream ships each
+block's durability delta D2H while the next block computes, EgressStream
+ships the block's READINESS — the ops/ready_mask.py delta bundle (which
+lanes' externally visible cursors moved, compacted to a dense active-lane
+prefix, plus the cursor columns themselves) — so the host consumer learns
+"which lanes have output" one block behind the live state without ever
+scanning all N lanes or issuing per-lane scalar reads.
+
+Built into `FusedCluster.run(egress=...)` and the BlockedFusedCluster
+scheduler's `egress=` per-block list (next to `wal=`):
+
+  push(state):  resolve + sink the PREVIOUS block's bundle (its D2H copy
+                has had a whole block of compute to ride), dispatch the
+                delta kernel against that block's now-host-resident
+                cursors, and start the async D2H copy of the new bundle.
+  flush():      resolve the in-flight tail (call when the run stops; the
+                engine's donation fence calls it before any donating
+                dispatch could invalidate the bundle's buffers — the same
+                _wal_pending discipline fused.py applies to WalStream).
+
+The delta baseline rides HOST-side (the resolved previous bundle feeds the
+next dispatch as fresh device inputs), so donation can never invalidate
+it. RAFT_TPU_EGRESS=0 disables the stream at construction: push/flush are
+no-ops and the kernel is never traced (tests/test_egress.py).
+
+The sink contract mirrors WalStream's: `sink(block_id, DeltaBundle)` in
+block order, each bundle internally consistent (one atomic device state);
+`bundle.active[:bundle.count]` is the dense vector of lanes that changed
+since the previous block.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.ops import ready_mask
+
+
+class EgressStream:
+    def __init__(self, sink=None):
+        self.enabled = ready_mask.egress_enabled()
+        self._pending = None  # (block_id, device DeltaBundle)
+        self._prev = None  # resolved PrevCursors of the last pushed block
+        self.sink = sink
+        self.blocks = 0
+        self.lanes_scanned = 0  # N per pushed block (what a scalar poll pays)
+        self.lanes_active = 0  # sum of per-block active counts
+        self.bytes = 0  # resolved bundle bytes shipped D2H
+
+    def push(self, state):
+        if not self.enabled:
+            return
+        # the previous bundle is both this push's sink output and the next
+        # delta's baseline, so it resolves BEFORE the new dispatch (its
+        # transfer overlapped the whole block that just ran — a cache read,
+        # not a sync)
+        self._resolve_pending()
+        dev = ready_mask.compute_delta(state, self._prev)
+        for a in dev:
+            # start the D2H transfer now; it overlaps the next block's
+            # device execution (JAX async dispatch + async host copy)
+            a.copy_to_host_async()
+        self._pending = (self.blocks, dev)
+        self.blocks += 1
+
+    def flush(self):
+        self._resolve_pending()
+
+    def _resolve_pending(self):
+        if self._pending is None:
+            return
+        block_id, dev = self._pending
+        self._pending = None
+        bundle = ready_mask.DeltaBundle(*(np.asarray(a) for a in dev))
+        self._prev = ready_mask.PrevCursors(
+            term=bundle.term, lead=bundle.lead, state=bundle.state,
+            committed=bundle.committed, applied=bundle.applied,
+            last=bundle.last,
+        )
+        self.bytes += sum(a.nbytes for a in bundle)
+        self.lanes_scanned += int(bundle.changed.shape[0])
+        self.lanes_active += int(bundle.count)
+        if self.sink is not None:
+            self.sink(block_id, bundle)

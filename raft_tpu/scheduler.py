@@ -156,21 +156,24 @@ class BlockedFusedCluster:
         del self._ops_cache[self._OPS_CACHE_SLOTS:]
         return per
 
-    def _check_wal(self, wal) -> list:
+    def _check_streams(self, streams, what: str, kind: str) -> list:
         try:
-            k = len(wal)
+            k = len(streams)
         except TypeError:
             raise TypeError(
-                "wal must be a sequence of K WalStreams, one per resident "
+                f"{what} must be a sequence of K {kind}s, one per resident "
                 f"block (this scheduler holds K={self.k})"
             ) from None
         if k != self.k:
             raise ValueError(
-                f"wal must hold one stream per resident block: got {k} "
+                f"{what} must hold one stream per resident block: got {k} "
                 f"stream(s), expected K={self.k} "
                 f"({self.g} groups / {self.block_groups} per block)"
             )
-        return list(wal)
+        return list(streams)
+
+    def _check_wal(self, wal) -> list:
+        return self._check_streams(wal, "wal", "WalStream")
 
     def _throttle(self, b: FusedCluster):
         if self.pipeline_depth is None:
@@ -179,7 +182,7 @@ class BlockedFusedCluster:
         while len(self._inflight) > self.pipeline_depth:
             jax.block_until_ready(self._inflight.popleft())
 
-    def run(self, rounds: int = 1, ops=None, wal=None, **kw):
+    def run(self, rounds: int = 1, ops=None, wal=None, egress=None, **kw):
         """`rounds` fused rounds on every block, dispatched ROUND-MAJOR:
         each sweep enqueues `round_chunk` rounds of every block before
         advancing, so block b+1's round hides block b's host-side dispatch
@@ -188,9 +191,15 @@ class BlockedFusedCluster:
 
         ops: a global-lane LocalOps, or a K-list from prepare_ops.
         wal: optional list of K runtime.wal.WalStream, one per block
-        (each block's delta is pushed once, after its last round)."""
+        (each block's delta is pushed once, after its last round).
+        egress: optional list of K runtime.egress.EgressStream, same
+        per-block shape — each block's batched ready/delta bundle is
+        pushed once, after its last round, and rides D2H while the next
+        block computes."""
         if wal is not None:
             wal = self._check_wal(wal)
+        if egress is not None:
+            egress = self._check_streams(egress, "egress", "EgressStream")
         per_ops = self._bind_ops(ops)
         ops_first = kw.get("ops_first_round_only", True)
         if self.k == 1:
@@ -201,6 +210,7 @@ class BlockedFusedCluster:
                 rounds,
                 ops=None if per_ops is None else per_ops[0],
                 wal=None if wal is None else wal[0],
+                egress=None if egress is None else egress[0],
                 **kw,
             )
             self._throttle(b)
@@ -217,6 +227,9 @@ class BlockedFusedCluster:
                     step,
                     ops=o,
                     wal=wal[i] if (wal is not None and last) else None,
+                    egress=(
+                        egress[i] if (egress is not None and last) else None
+                    ),
                     **kw,
                 )
                 self._throttle(b)

@@ -42,11 +42,14 @@ run_bench() {
 smokes() {
   # device-metrics smoke + the donation A/B dispatch smoke (fails if
   # donation-on regresses throughput or stops lowering live buffers) +
-  # the chaos recovery-SLO smoke (two same-seed soaks must be
-  # bit-identical; RAFT_TPU_CHAOS / CHAOS_SEED / CHAOS_BUDGET inherit
+  # the egress A/B serving smoke (scalar-poll vs batched-mask Ready
+  # streams must be digest-identical while the mask path scans strictly
+  # fewer lanes) + the chaos recovery-SLO smoke (two same-seed soaks must
+  # be bit-identical; RAFT_TPU_CHAOS / CHAOS_SEED / CHAOS_BUDGET inherit
   # through run_bench like RAFT_TPU_COMPILE_CACHE)
   run_bench benches/metrics_smoke.py \
     && run_bench benches/dispatch_ab.py \
+    && run_bench benches/egress_ab.py \
     && run_bench benches/pallas_ab.py --smoke \
     && run_bench benches/chaos_soak.py --smoke
 }
@@ -69,6 +72,7 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
       tests/test_chaos.py tests/test_codec.py tests/test_confchange.py \
       tests/test_confchange_datadriven.py tests/test_confchange_scenarios.py
     run_chunk tests/test_donation.py tests/test_e2e.py \
+      tests/test_egress.py \
       tests/test_fast_log_rejection.py tests/test_flow_control.py \
       tests/test_fused.py tests/test_fused_confchange.py tests/test_fused_ids.py
     run_chunk tests/test_fused_invariants.py tests/test_fused_rebase.py \

@@ -1,0 +1,407 @@
+"""Egress plane tests (ISSUE 5): the batched ready-predicate kernel
+(ops/ready_mask.py), its RawNodeBatch/ready_lanes consumers, the fused
+engine's EgressStream (runtime/egress.py), the view-cache version stamp,
+and the bridge truncation surfaces.
+
+The load-bearing invariant is BIT-IDENTICAL serving: the batched mask must
+agree lane-for-lane with the scalar has_ready predicate, and a Ready built
+from the bundle's cursors must equal one re-derived from the view — across
+sync/async lanes, pending snapshots, paginated committed windows, and
+post-crash (restart_lane) states."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.api.rawnode import HardState, Message, Snapshot
+from raft_tpu.ops import ready_mask as rm
+from raft_tpu.storage import MemoryStorage
+from raft_tpu.types import MessageType as MT
+from tests.test_rawnode import drive, make_group
+
+
+def scalar_sweep(b):
+    return [lane for lane in range(b.shape.n) if b._has_ready_scalar(lane)]
+
+
+def assert_parity(b):
+    """Full batched-vs-scalar agreement at this instant: mask verdicts,
+    ready_lanes order, and bundle-cursor Ready == view-cursor Ready."""
+    n = b.shape.n
+    bd = b._refresh_bundle()
+    for lane in range(n):
+        assert bool(bd.ready[lane]) == b._has_ready_scalar(lane), lane
+    lanes = b.ready_lanes()
+    assert lanes == scalar_sweep(b)
+    k = int(bd.count)
+    assert sorted(set(lanes)) == lanes and k == len(lanes)
+    # inactive tail of the compacted vector holds the N sentinel
+    assert all(int(x) == n for x in bd.active[k:])
+    for lane in range(n):
+        rd_bundle = b.ready(lane, peek=True)
+        saved, b._bundle = b._bundle, None
+        rd_scalar = b.ready(lane, peek=True)
+        b._bundle = saved
+        assert rd_bundle == rd_scalar, lane
+
+
+# -- tentpole: batched mask --------------------------------------------------
+
+
+def test_ready_lanes_matches_scalar_sweep():
+    b = make_group(3)
+    assert b.ready_lanes() == []
+    b.campaign(0)
+    assert b.ready_lanes() == [0]
+    assert b.has_ready(0) and not b.has_ready(1)
+    drive(b)
+    assert b.ready_lanes() == []
+
+
+def test_batched_scalar_parity():
+    """Property test: parity through a mixed sync/async drive with
+    proposals, read-index traffic, a partition + compaction forcing a
+    pending snapshot, paginated committed windows (tiny
+    max_committed_size_per_ready), and a lane restart (post-crash)."""
+    b = make_group(
+        3,
+        shape_kw=dict(log_window=16),
+        max_committed_size_per_ready=48,  # forces pagination of commits
+    )
+    b.set_async_storage_writes(2, True)
+    assert_parity(b)
+    b.campaign(0)
+    assert_parity(b)
+
+    def pump(dead=(), iters=60):
+        for i in range(iters):
+            moved = False
+            for lane in range(3):
+                assert_parity(b)
+                if lane in dead or not b.has_ready(lane):
+                    continue
+                rd = b.ready(lane)
+                msgs = rd.messages
+                if lane != 2:
+                    b.advance(lane)
+                for m in msgs:
+                    if m.to in (1, 2, 3):
+                        if m.to - 1 not in dead:
+                            b.step(m.to - 1, m)
+                    elif m.to == -1:  # lane 2's append thread: the write
+                        # completed — deliver the acks to their targets
+                        # (self MsgStorageAppendResp AND the leader-bound
+                        # MsgAppResp, which quorum {0, 2} depends on)
+                        for r in m.responses:
+                            if r.to in (1, 2, 3) and r.to - 1 not in dead:
+                                b.step(r.to - 1, r)
+                    elif m.to == -2:  # apply thread ack
+                        b.step(2, Message(
+                            type=int(MT.MSG_STORAGE_APPLY_RESP), to=3,
+                            frm=-2, entries=list(m.entries),
+                        ))
+                moved = True
+            if not moved and i > 2:
+                return
+
+    pump()
+    # burst of proposals: the 48-byte budget pages the committed window
+    for i in range(4):
+        b.propose(0, b"payload-%d" % i)
+        pump()
+    b.read_index(0, 55)
+    pump()
+    # partition lane 1, commit past it, compact: healing delivers a
+    # snapshot (pending_snap_index exercises the psi terms of the kernel)
+    for i in range(5):
+        b.propose(0, b"gap-%d" % i)
+        pump(dead={1})
+    b.compact(0, int(b.view.committed[0]), data=b"snap-state")
+    assert_parity(b)
+    for _ in range(8):
+        b.tick(0)
+        assert_parity(b)
+    pump()
+    si = int(b.view.snap_index[1])
+    assert si > 0  # the snapshot really happened
+    # post-crash state: rebuild lane 1 from its persisted snapshot image
+    storage = MemoryStorage()
+    storage.apply_snapshot(
+        Snapshot(index=si, term=int(b.view.snap_term[1]), voters=(1, 2, 3))
+    )
+    storage.set_hard_state(HardState(
+        term=int(b.view.term[1]), vote=int(b.view.vote[1]), commit=si,
+    ))
+    b.restart_lane(1, storage, applied=si)
+    assert_parity(b)
+    for _ in range(8):
+        b.tick(0)
+        assert_parity(b)
+    pump()
+    b.propose(0, b"after-restart")
+    pump()
+    assert_parity(b)
+    assert int(b.view.committed[1]) == int(b.view.committed[0])
+
+
+def test_has_ready_answers_from_mask_then_falls_back():
+    b = make_group(3)
+    b.campaign(0)
+    calls0 = rm.kernel_calls()
+    b.ready_lanes()
+    assert rm.kernel_calls() == calls0 + 1
+    # fresh bundle: repeated polls answer from it, no new dispatch
+    for _ in range(4):
+        assert b.has_ready(0) and not b.has_ready(1)
+        assert b.ready_lanes() == [0]
+    assert rm.kernel_calls() == calls0 + 1
+    # state mutated since the refresh: has_ready falls back to the scalar
+    # path (no dispatch) and stays correct
+    b.ready(0)
+    assert not b._bundle_fresh()
+    calls1 = rm.kernel_calls()
+    assert b.has_ready(0) == b._has_ready_scalar(0)
+    assert rm.kernel_calls() == calls1
+
+
+# -- satellite: view version stamp / transfer counting -----------------------
+
+
+def test_view_cache_never_retransfers_between_steps():
+    b = make_group(3)
+    b.campaign(0)
+    drive(b)
+    b.ready_lanes()
+    v0, t0 = b.view.version, b.view.transfers
+    for _ in range(5):
+        for lane in range(3):
+            b.has_ready(lane)
+        b.ready_lanes()
+    assert b.view.version == v0
+    assert b.view.transfers == t0  # zero re-transfers across repeated polls
+    b.propose(0, b"x")  # a step refreshes the view exactly once
+    assert b.view.version > v0
+
+
+def test_view_cache_no_retransfer_scalar_path(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_EGRESS", "0")
+    b = make_group(3)
+    b.campaign(0)
+    for lane in range(3):
+        b.has_ready(lane)  # first sweep pulls each field once
+    t0 = b.view.transfers
+    for _ in range(5):
+        for lane in range(3):
+            b.has_ready(lane)
+    assert b.view.transfers == t0
+
+
+# -- elision (RAFT_TPU_EGRESS=0) ---------------------------------------------
+
+
+def test_egress_off_elides_mask_kernel(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_EGRESS", "0")
+    b = make_group(3)
+    assert not b._egress_on
+    calls = rm.kernel_calls()
+    b.campaign(0)
+    lanes = b.ready_lanes()
+    assert lanes == [0] == scalar_sweep(b)
+    drive(b)
+    assert b.ready_lanes() == []
+    # the mask kernel never traced or dispatched: no mask program exists
+    assert rm.kernel_calls() == calls
+    # the fused-engine stream is inert too
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.runtime.egress import EgressStream
+
+    eg = EgressStream(sink=lambda *a: pytest.fail("sink fired while off"))
+    assert not eg.enabled
+    c = FusedCluster(2, 3, seed=5)
+    c.run(4, auto_propose=True, egress=eg)
+    eg.flush()
+    assert eg.blocks == 0 and eg.bytes == 0
+    assert rm.kernel_calls() == calls
+    c.check_no_errors()
+
+
+def test_egress_on_mask_ops_in_jaxpr():
+    """The batched predicate really is one fused device program: its jaxpr
+    contains the cumsum-scatter compaction (and nothing host-side)."""
+    import jax
+
+    b = make_group(3)
+    n = 3
+    z = np.zeros((n,), np.int32)
+    host = rm.HostCursors(
+        prev_term=z, prev_vote=z, prev_commit=z, prev_lead=z, prev_state=z,
+        host_pending=np.zeros((n,), bool), is_async=np.zeros((n,), bool),
+        inprog=z, snap_inprog=z, applying=z,
+    )
+    jaxpr = str(jax.make_jaxpr(rm.ready_bundle)(b.state, host))
+    assert "cumsum" in jaxpr and "scatter" in jaxpr
+
+
+# -- EgressStream on the fused engine ----------------------------------------
+
+
+def test_egress_stream_one_block_behind_and_delta_masks():
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.runtime.egress import EgressStream
+
+    got = []
+    eg = EgressStream(sink=lambda bid, bundle: got.append((bid, bundle)))
+    c = FusedCluster(4, 3, seed=6)
+    c.run(8, auto_propose=True, auto_compact_lag=8, egress=eg)
+    # double-buffered: block 0 is in flight, not yet sunk
+    assert eg.blocks == 1 and got == []
+    for _ in range(4):
+        c.run(8, auto_propose=True, auto_compact_lag=8, egress=eg)
+    eg.flush()
+    assert [bid for bid, _ in got] == [0, 1, 2, 3, 4]
+    assert eg.lanes_scanned == 5 * 12
+    assert eg.bytes == sum(
+        sum(a.nbytes for a in bundle) for _, bundle in got
+    )
+    for _, bundle in got:
+        k = int(bundle.count)
+        # compaction invariants: dense ascending prefix, sentinel tail
+        active = [int(x) for x in bundle.active]
+        assert active[:k] == [i for i in range(12) if bundle.changed[i]]
+        assert all(x == 12 for x in active[k:])
+    # the final bundle IS the live state's cursor set
+    last = got[-1][1]
+    np.testing.assert_array_equal(
+        last.committed, np.asarray(c.state.committed)
+    )
+    np.testing.assert_array_equal(last.term, np.asarray(c.state.term))
+    # deltas chain: consecutive bundles mark exactly the moved cursors
+    for (_, a), (_, bb) in zip(got, got[1:]):
+        moved = (
+            (a.term != bb.term) | (a.lead != bb.lead) | (a.state != bb.state)
+            | (a.committed != bb.committed) | (a.applied != bb.applied)
+            | (a.last != bb.last)
+        )
+        np.testing.assert_array_equal(moved, bb.changed)
+    c.check_no_errors()
+
+
+def test_egress_stream_quiescent_rounds_go_inactive():
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.runtime.egress import EgressStream
+
+    counts = []
+    eg = EgressStream(sink=lambda bid, bundle: counts.append(int(bundle.count)))
+    c = FusedCluster(2, 3, seed=7)
+    # elect + settle without streaming
+    for _ in range(6):
+        c.run(8)
+    # no ops, no ticks: nothing moves after the first bundle (whose
+    # baseline is the zero cursors — it reports the full live state)
+    for _ in range(4):
+        c.run(1, do_tick=False, egress=eg)
+    eg.flush()
+    assert eg.blocks == 4
+    assert counts[0] == 6  # fresh stream: every lane differs from zero
+    assert counts[1:] == [0, 0, 0]  # O(active) means dark when quiescent
+    c.check_no_errors()
+
+
+def test_egress_composes_with_donation_off(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_DONATE", "0")
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.runtime.egress import EgressStream
+
+    eg = EgressStream()
+    c = FusedCluster(2, 3, seed=9)
+    assert not c._donate
+    for _ in range(3):
+        c.run(8, auto_propose=True, egress=eg)
+    eg.flush()
+    assert eg.blocks == 3 and eg.lanes_active > 0
+    c.check_no_errors()
+
+
+def test_blocked_scheduler_egress_validation():
+    from raft_tpu.runtime.egress import EgressStream
+    from raft_tpu.scheduler import BlockedFusedCluster
+
+    c = BlockedFusedCluster(4, 3, block_groups=2, seed=3)
+    with pytest.raises(ValueError, match="egress must hold one stream"):
+        c.run(1, egress=[EgressStream()])
+    with pytest.raises(TypeError, match="egress must be a sequence"):
+        c.run(1, egress=EgressStream())
+    egs = [EgressStream() for _ in range(c.k)]
+    for _ in range(3):
+        c.run(8, auto_propose=True, auto_compact_lag=8, egress=egs)
+    for e in egs:
+        e.flush()
+        assert e.blocks == 3 and e.bytes > 0
+    c.check_no_errors()
+
+
+# -- bridge truncation surfaces ----------------------------------------------
+
+
+def test_pump_truncation_is_surfaced():
+    from tests.test_bridge import make_spanning_group
+
+    bridge, hosts = make_spanning_group()
+    hosts[0].campaign(0)
+    res = bridge.pump(max_iters=1)  # cannot quiesce in one sweep
+    assert isinstance(res, int)
+    assert res == 1 and res.truncated
+    assert bridge.pump_truncated == 1
+    snap = bridge.metrics_snapshot()
+    assert snap["counters"]["bridge_pump_truncated"] == 1
+    res = bridge.pump()  # finish the election: a clean pump is not truncated
+    assert not res.truncated
+    assert bridge.pump_truncated == 1
+    assert hosts[0].basic_status(0)["raft_state"] == "LEADER"
+
+
+def test_drain_truncation_is_surfaced():
+    from raft_tpu.runtime.bridge import BridgeEndpoint
+
+    b = make_group(3)
+    ep = BridgeEndpoint(b, {1: 0, 2: 1, 3: 2}, {})
+    b.campaign(0)
+    ep.drain(max_iters=1)
+    assert ep.truncated
+    assert b.metrics.get("bridge_drain_truncated") == 1
+    ep.drain()
+    assert not ep.truncated
+    assert b.basic_status(0)["raft_state"] == "LEADER"
+
+
+# -- serving-loop counters ---------------------------------------------------
+
+
+def test_lanes_scanned_counters_scalar_vs_mask(monkeypatch):
+    def serve(b):
+        b.campaign(0)
+        for _ in range(40):
+            lanes = b.ready_lanes()
+            if not lanes:
+                break
+            for lane in lanes:
+                if not b.has_ready(lane):
+                    continue
+                rd = b.ready(lane)
+                msgs = rd.messages
+                b.advance(lane)
+                for m in msgs:
+                    if 0 <= m.to - 1 < b.shape.n:
+                        b.step(m.to - 1, m)
+        return (
+            b.metrics.get("egress_lanes_scanned"),
+            b.metrics.get("egress_lanes_active"),
+        )
+
+    scanned_mask, active_mask = serve(make_group(3))
+    monkeypatch.setenv("RAFT_TPU_EGRESS", "0")
+    scanned_scalar, active_scalar = serve(make_group(3))
+    # identical serving work surfaced...
+    assert active_mask == active_scalar
+    # ...but the mask path's host only touched the active lanes
+    assert scanned_mask == active_mask
+    assert scanned_mask < scanned_scalar

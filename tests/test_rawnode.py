@@ -264,6 +264,15 @@ def test_has_ready_matches_peek():
                 b._after_append[lane]
             )
             assert fast == slow, (lane, fast, slow)
+        # the batched mask (ISSUE 5 egress plane) must agree with the
+        # scalar predicate lane-for-lane at the same instants
+        if b._egress_on:
+            bd = b._refresh_bundle()
+            for lane in range(3):
+                assert bool(bd.ready[lane]) == b._has_ready_scalar(lane)
+            assert b.ready_lanes() == [
+                lane for lane in range(3) if b._has_ready_scalar(lane)
+            ]
 
     check()
     b.campaign(0)

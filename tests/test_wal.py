@@ -69,3 +69,24 @@ def test_blocked_cluster_wal_streams():
         wstream.flush()
         assert wstream.blocks == 3 and wstream.bytes > 0
     c.check_no_errors()
+
+
+def test_wal_flush_is_idempotent():
+    """Regression (ISSUE 5 satellite): flush() must resolve the in-flight
+    delta exactly once — a second flush (or a flush racing the next push)
+    must neither re-sink the same block nor lose one."""
+    got = []
+    wal = WalStream(sink=lambda bid, delta: got.append(bid))
+    c = FusedCluster(2, 3, seed=5)
+    c.run(4, auto_propose=True, wal=wal)
+    wal.flush()
+    assert got == [0]
+    wal.flush()  # no pending delta: must be a no-op, not a double-sink
+    assert got == [0]
+    # push after flush keeps the block sequence intact
+    c.run(4, auto_propose=True, wal=wal)
+    assert got == [0]  # block 1 still riding D2H
+    wal.flush()
+    assert got == [0, 1]
+    assert wal.blocks == 2
+    c.check_no_errors()
