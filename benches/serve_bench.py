@@ -1,0 +1,198 @@
+"""Serving-frontend bench: closed-loop latency + open-loop saturation on
+the multi-tenant KV frontend (raft_tpu/serve/ServeLoop).
+
+Two phases over one BlockedFusedCluster:
+
+  closed  M sessions, each keeping ONE put outstanding (submit on
+          notify): reports notify latency p50/p99 in device rounds and
+          committed ops/round — the interactive-client view.
+  open    every session submits a fixed burst per round regardless of
+          completions, deliberately past its token bucket: admission must
+          shed the excess as typed Rejected(reason) counts (NONZERO, no
+          deadlock) while every admitted proposal still resolves.
+
+Acceptance gates (exit 1 on violation, the ISSUE 6 bar):
+  - every admitted proposal notified exactly ONCE (all tickets done,
+    notify_violations == 0),
+  - sha256 digest of the committed KV == scalar-twin replay of the
+    ADMISSION-ordered client log (commit order = admission order per
+    group under stable leaders; dedup collapses retries),
+  - open loop: rejected > 0 and drain() completes (no committed-entry
+    loss, no deadlock).
+
+Prints one JSON summary line (the egress_ab shape). --smoke runs the
+CPU-sized config wired into runtests.sh; env knobs: SERVE_BENCH_GROUPS,
+SERVE_BENCH_BLOCK_GROUPS, SERVE_BENCH_SESSIONS, SERVE_BENCH_ROUNDS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def pct(xs, q):
+    return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    groups = int(os.environ.get("SERVE_BENCH_GROUPS", 16 if smoke else 64))
+    block_groups = int(
+        os.environ.get("SERVE_BENCH_BLOCK_GROUPS", 8 if smoke else 16)
+    )
+    n_sessions = int(
+        os.environ.get("SERVE_BENCH_SESSIONS", 12 if smoke else 64)
+    )
+    rounds = int(os.environ.get("SERVE_BENCH_ROUNDS", 48 if smoke else 256))
+
+    import jax
+
+    from raft_tpu.scheduler import BlockedFusedCluster
+    from raft_tpu.serve import Rejected, ServeLoop, replay
+
+    t0 = time.perf_counter()
+    cluster = BlockedFusedCluster(
+        groups, 3, block_groups=block_groups, seed=7
+    )
+    loop = ServeLoop(
+        cluster,
+        tenant_rate=4.0,
+        tenant_burst=16.0,
+        read_retry_rounds=8,
+    )
+    loop.bootstrap()
+    t_boot = time.perf_counter() - t0
+
+    sessions = [loop.open_session(f"tenant-{i}") for i in range(n_sessions)]
+    # the ADMISSION-ordered client log: what the scalar twin replays.
+    # Ticks are irrelevant to the digest for put/delete (no leases here),
+    # so the twin needs no knowledge of device apply timing.
+    admitted_log = []
+    all_tickets = []
+
+    def submit(s, i):
+        r = loop.put(s, f"{s.tenant}/k{i % 32}", f"{s.tenant}.{i}")
+        if isinstance(r, Rejected):
+            return None
+        admitted_log.append((s.group, r.cmd, 0))
+        all_tickets.append(r)
+        return r
+
+    # -- closed loop: one outstanding put per session ---------------------
+    outstanding = {}
+    seq = {s.id: 0 for s in sessions}
+    for s in sessions:
+        outstanding[s.id] = submit(s, seq[s.id])
+    lat = []
+    t1 = time.perf_counter()
+    for _ in range(rounds):
+        loop.step()
+        for s in sessions:
+            t = outstanding[s.id]
+            if t is None or t.done:
+                if t is not None and t.done:
+                    lat.append(t.latency_rounds)
+                seq[s.id] += 1
+                outstanding[s.id] = submit(s, seq[s.id])
+    closed_wall = time.perf_counter() - t1
+    closed_drained = loop.drain(256)
+    for t in outstanding.values():
+        if t is not None and t.done and t.latency_rounds is not None:
+            lat.append(t.latency_rounds)
+    closed_notified = loop.metrics_snapshot()["counters"].get(
+        "proposals_notified", 0
+    )
+
+    # -- open loop: burst past the bucket ---------------------------------
+    burst = 8  # vs rate 4/round: guaranteed shed
+    t2 = time.perf_counter()
+    for r in range(rounds):
+        for s in sessions:
+            seq[s.id] += 1
+            submit(s, seq[s.id])
+            if burst > 1 and r % 2 == 0:
+                for j in range(burst - 1):
+                    seq[s.id] += 1
+                    submit(s, seq[s.id])
+        loop.step()
+    open_wall = time.perf_counter() - t2
+    open_drained = loop.drain(512)
+
+    m = loop.metrics_snapshot()["counters"]
+    rejected = m.get("proposals_rejected", 0)
+    violations = m.get("notify_violations", 0)
+    admitted = m.get("proposals_admitted", 0)
+    notified = m.get("proposals_notified", 0)
+
+    exactly_once = (
+        violations == 0
+        and all(t.done for t in all_tickets)
+        and notified == admitted == len(all_tickets)
+    )
+    digest = loop.digest()
+    twin = replay(groups, admitted_log, loop.round)
+    digest_ok = digest == twin
+    open_ok = rejected > 0 and open_drained
+
+    ok = exactly_once and digest_ok and closed_drained and open_ok
+    print(json.dumps({
+        "metric": "serve_bench",
+        "ok": ok,
+        "backend": jax.default_backend(),
+        "groups": groups,
+        "blocks": groups // block_groups,
+        "sessions": n_sessions,
+        "rounds_total": loop.round,
+        "bootstrap_s": round(t_boot, 2),
+        "closed": {
+            "notified": closed_notified,
+            "p50_rounds": round(pct(lat, 50), 2),
+            "p99_rounds": round(pct(lat, 99), 2),
+            "ops_per_round": round(len(lat) / max(1, rounds), 2),
+            "wall_ms_per_round": round(closed_wall * 1000 / rounds, 2),
+        },
+        "open": {
+            "admitted": admitted,
+            "rejected": rejected,
+            "rejected_tenant_rate": m.get("rejected_tenant_rate", 0),
+            "rejected_queue_full": m.get("rejected_queue_full", 0),
+            "wall_ms_per_round": round(open_wall * 1000 / rounds, 2),
+        },
+        "exactly_once": exactly_once,
+        "notify_violations": violations,
+        "digest_equal_twin": digest_ok,
+        "digest": digest[:16],
+    }))
+    if not exactly_once:
+        print(
+            f"FAIL: exactly-once violated (violations={violations}, "
+            f"admitted={admitted}, notified={notified}, "
+            f"undone={sum(not t.done for t in all_tickets)})",
+            file=sys.stderr,
+        )
+    if not digest_ok:
+        print(
+            f"FAIL: committed KV digest {digest[:16]} != admission-ordered "
+            f"scalar twin {twin[:16]}",
+            file=sys.stderr,
+        )
+    if not open_ok:
+        print(
+            f"FAIL: open loop rejected={rejected} drained={open_drained} "
+            "(want nonzero rejections and a clean drain)",
+            file=sys.stderr,
+        )
+    if not closed_drained:
+        print("FAIL: closed loop failed to drain", file=sys.stderr)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -50,10 +50,27 @@ from raft_tpu.types import (
 I32 = jnp.int32
 
 
+# Typed refusal taxonomy: every reference drop path test_backpressure.py
+# audits, named so callers (and the serving frontend's admission layer,
+# raft_tpu/serve/admission.py, which mirrors these as Rejected(reason))
+# can react per-cause instead of string-matching a message.
+DROP_NO_LEADER = "no_leader"  # raft.go:1671-1675
+DROP_CANDIDATE = "candidate"  # raft.go:1636-1642
+DROP_TRANSFERRING = "transferring"  # raft.go:1256-1258
+DROP_FORWARDING_DISABLED = "forwarding_disabled"  # raft.go:1676-1679
+DROP_WINDOW_FULL = "window_full"  # device log window (engine static bound)
+DROP_UNCOMMITTED_FULL = "uncommitted_full"  # raft.go:2033-2047
+DROP_UNKNOWN = "dropped"
+
+
 class ErrProposalDropped(Exception):
     """The proposal was not appended or forwarded — retry later (reference:
     raft.go:30 ErrProposalDropped; returned by Step/Propose so the caller
-    can react, node.go:469)."""
+    can react, node.go:469). `reason` carries the DROP_* cause."""
+
+    def __init__(self, reason: str = DROP_UNKNOWN):
+        super().__init__(reason)
+        self.reason = reason
 
 
 # --------------------------------------------------------------------------
@@ -895,7 +912,40 @@ class RawNodeBatch:
             self.metrics.inc("proposals")
             return
         self.metrics.inc("proposals_dropped")
-        raise ErrProposalDropped()
+        raise ErrProposalDropped(self._drop_reason(lane, msg))
+
+    def _drop_reason(self, lane: int, msg: Message) -> str:
+        """Classify a refused proposal against the reference's drop paths
+        (the test_backpressure.py audit set). Diagnosed from the post-step
+        view — a dropped MsgProp leaves the lane's state untouched, so the
+        gates that refused it still hold."""
+        v = self.view
+        st = int(v.state[lane])
+        if st in (int(StateType.CANDIDATE), int(StateType.PRE_CANDIDATE)):
+            return DROP_CANDIDATE
+        if st == int(StateType.FOLLOWER):
+            if int(v.lead[lane]) == 0:
+                return DROP_NO_LEADER
+            if bool(
+                np.asarray(self.state.cfg.disable_proposal_forwarding)[lane]
+            ):
+                return DROP_FORWARDING_DISABLED
+            return DROP_UNKNOWN
+        if int(v.lead_transferee[lane]) != 0:
+            return DROP_TRANSFERRING
+        n_ents = max(1, len(msg.entries))
+        if (
+            int(v.last[lane]) + n_ents - int(v.snap_index[lane])
+            > self.shape.w
+        ):
+            return DROP_WINDOW_FULL
+        us = int(v.uncommitted_size[lane])
+        sz = sum(len(e.data) for e in msg.entries)
+        if us > 0 and sz > 0 and us + sz > int(
+            np.asarray(self.state.cfg.max_uncommitted_size)[lane]
+        ):
+            return DROP_UNCOMMITTED_FULL
+        return DROP_UNKNOWN
 
     def transfer_leadership(self, lane: int, transferee: int):
         self._run_step(

@@ -118,6 +118,35 @@ EGRESS_COUNTERS = (
     "bridge_drain_truncated",
 )
 
+# serving-frontend counter families (host plane — counted at the
+# raft_tpu/serve/ surfaces, exported under the raft_tpu_serve prefix with
+# the notify-latency histogram; see serve/http.py):
+#   proposals_admitted     client puts/deletes/lease-grants past admission
+#   proposals_rejected     typed Rejected(reason) results (never silent —
+#                          per-reason breakdown rides rejected_<reason>)
+#   reads_admitted         linearizable GETs accepted into a ReadIndex batch
+#   reads_served           GETs answered after quorum release + apply
+#   reads_retried          ReadIndex tickets re-injected after a release
+#                          timeout (dropped beat, ring overflow, pre-commit)
+#   proposals_notified     futures resolved propose -> commit -> notify
+#   epoch_resyncs          groups re-attached after a leader/term change
+#                          (in-flight tickets re-proposed, dedup collapses)
+#   sessions_active        open client sessions (gauge: set, not inc)
+#   notify_violations      a future completed more than once (must stay 0;
+#                          the exactly-once bar benches/serve_bench.py gates)
+# plus one `rejected_<reason>` family per admission.py REJECT_* reason.
+SERVE_COUNTERS = (
+    "proposals_admitted",
+    "proposals_rejected",
+    "proposals_notified",
+    "reads_admitted",
+    "reads_served",
+    "reads_retried",
+    "epoch_resyncs",
+    "sessions_active",
+    "notify_violations",
+)
+
 
 class HostCounters:
     """Plain host-side counter bag speaking the snapshot schema — the
@@ -129,6 +158,11 @@ class HostCounters:
     def inc(self, name: str, n: int = 1):
         self.counts[name] = self.counts.get(name, 0) + n
 
+    def set(self, name: str, value: int):
+        """Gauge write (e.g. sessions_active): the exported value is the
+        level itself, not an accumulation."""
+        self.counts[name] = int(value)
+
     def get(self, name: str) -> int:
         return self.counts.get(name, 0)
 
@@ -137,6 +171,37 @@ class HostCounters:
         for name, v in self.counts.items():
             snap["counters"][name] = snap["counters"].get(name, 0) + v
         return snap
+
+
+class HostHistogram:
+    """Host-side le-bucket histogram speaking the snapshot "hist" schema —
+    the serving plane's notify-latency (propose -> commit -> notify, in
+    device rounds) uses the device plane's round edges so host and device
+    latency panels share an x-axis. NOT merged into a device snapshot:
+    merge_snapshots sums hists blindly, so serve snapshots live in their
+    own registry/prefix (serve/http.py renders both)."""
+
+    def __init__(self, edges=HIST_EDGES):
+        self.edges = tuple(edges)
+        self.buckets = [0] * (len(self.edges) + 1)
+        self.sum = 0
+
+    def observe(self, value: int, n: int = 1):
+        b = len(self.edges)
+        for i, e in enumerate(self.edges):
+            if value <= e:
+                b = i
+                break
+        self.buckets[b] += n
+        self.sum += int(value) * n
+
+    def snapshot(self) -> dict:
+        return {
+            "edges": list(self.edges),
+            "buckets": list(self.buckets),
+            "sum": int(self.sum),
+            "count": int(sum(self.buckets)),
+        }
 
 
 def merge_snapshots(snaps) -> dict:
@@ -201,8 +266,14 @@ class MetricsRegistry:
         return out
 
 
-def prometheus_text(snap: dict, prefix: str = "raft_tpu") -> str:
-    """Render a snapshot in the Prometheus text exposition format."""
+def prometheus_text(
+    snap: dict,
+    prefix: str = "raft_tpu",
+    hist_name: str = "commit_latency_rounds",
+) -> str:
+    """Render a snapshot in the Prometheus text exposition format.
+    hist_name labels the snapshot's single histogram family — the engine
+    plane's is commit latency, the serving plane's is notify latency."""
     lines = []
     for name, v in sorted(snap["counters"].items()):
         fam = f"{prefix}_{name}_total"
@@ -210,7 +281,7 @@ def prometheus_text(snap: dict, prefix: str = "raft_tpu") -> str:
         lines.append(f"{fam} {int(v)}")
     h = snap.get("hist")
     if h is not None:
-        fam = f"{prefix}_commit_latency_rounds"
+        fam = f"{prefix}_{hist_name}"
         lines.append(f"# TYPE {fam} histogram")
         cum = 0
         for edge, count in zip(h["edges"], h["buckets"]):

@@ -2160,6 +2160,52 @@ class FusedCluster:
     def lanes_of_group(self, g: int):
         return slice(g * self.v, (g + 1) * self.v)
 
+    def state_columns(self, *names) -> dict:
+        """Host-resident numpy copies of the named [N]-leading state leaves
+        (e.g. "state", "lead", "term", "committed", "last") — the serving
+        frontend's synchronous bootstrap/resync pull. One overlapped
+        transfer set: copy_to_host_async on every leaf before the first
+        blocking read (the compute_bundle discipline, ops/ready_mask.py)."""
+        import numpy as np
+
+        leaves = [getattr(self.state, name) for name in names]
+        for x in leaves:
+            if hasattr(x, "copy_to_host_async"):
+                x.copy_to_host_async()
+        return {name: np.asarray(x) for name, x in zip(names, leaves)}
+
+    def drain_read_states(self) -> dict:
+        """Consume released ReadIndex results host-side: returns
+        {lane: [(ctx, index), ...]} for every lane with rs_count > 0 and
+        zeroes the device rs_* ring (reference: raft.go:371 readStates,
+        drained by Ready — here by the serving loop, raft_tpu/serve/).
+
+        The zeroing writes one DISTINCT fresh buffer per field: the carry
+        is donated on the next dispatch, and two leaves sharing a buffer
+        trip XLA's donate-same-buffer-twice check (the lockstep harness's
+        _drain_reads discipline, testing/lockstep.py)."""
+        import numpy as np
+
+        cnt = np.asarray(self.state.rs_count)
+        if not cnt.any():
+            return {}
+        ctx = np.asarray(self.state.rs_ctx)
+        idx = np.asarray(self.state.rs_index)
+        out = {
+            int(lane): [
+                (int(ctx[lane, k]), int(idx[lane, k]))
+                for k in range(int(cnt[lane]))
+            ]
+            for lane in np.nonzero(cnt > 0)[0]
+        }
+        self.state = dataclasses.replace(
+            self.state,
+            rs_ctx=jnp.zeros_like(self.state.rs_ctx),
+            rs_index=jnp.zeros_like(self.state.rs_index),
+            rs_count=jnp.zeros_like(self.state.rs_count),
+        )
+        return out
+
     def check_no_errors(self):
         import numpy as np
 

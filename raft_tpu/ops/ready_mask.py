@@ -127,6 +127,11 @@ class DeltaBundle(NamedTuple):
     committed: jax.Array
     applied: jax.Array
     last: jax.Array
+    # undrained ReadIndex results (state.rs_count): a lane with pending
+    # ReadStates stays active every block until the host drains them
+    # (FusedCluster.drain_read_states) — the serving frontend's wake-up
+    # signal for the linearizable-read path (raft_tpu/serve/router.py)
+    rs_count: jax.Array  # [N] i32
 
 
 def compact_mask(ready: jax.Array):
@@ -214,6 +219,7 @@ def delta_bundle(state, prev: PrevCursors) -> DeltaBundle:
     term, lead, st = i32(state.term), i32(state.lead), i32(state.state)
     committed, applied = i32(state.committed), i32(state.applied)
     last = i32(state.last)
+    rs_count = i32(state.rs_count)
     changed = (
         (term != prev.term)
         | (lead != prev.lead)
@@ -221,12 +227,16 @@ def delta_bundle(state, prev: PrevCursors) -> DeltaBundle:
         | (committed != prev.committed)
         | (applied != prev.applied)
         | (last != prev.last)
+        # absolute, not a delta: pending ReadStates need service no matter
+        # which block released them, and they only clear on a host drain
+        | (rs_count > 0)
     )
     active, count = compact_mask(changed)
     return DeltaBundle(
         changed=changed, active=active, count=count,
         term=term, lead=lead, state=st,
         committed=committed, applied=applied, last=last,
+        rs_count=rs_count,
     )
 
 

@@ -28,7 +28,16 @@ no-ops and the kernel is never traced (tests/test_egress.py).
 The sink contract mirrors WalStream's: `sink(block_id, DeltaBundle)` in
 block order, each bundle internally consistent (one atomic device state);
 `bundle.active[:bundle.count]` is the dense vector of lanes that changed
-since the previous block.
+since the previous block. `bundle.rs_count` marks lanes holding undrained
+ReadIndex results — such lanes stay active every block until the host
+drains them (FusedCluster.drain_read_states).
+
+The first-class consumer is the serving frontend (raft_tpu/serve/): the
+CompletionRouter registers as the sink, maps active lanes back to raft
+groups, advances per-group commit watermarks, applies committed commands
+to the host KV materialization, and resolves client futures
+(propose -> commit -> notify) — the production loop ROADMAP item 3 asks
+for, with the O(active) sweep this stream was built to feed.
 """
 
 from __future__ import annotations

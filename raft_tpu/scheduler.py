@@ -337,6 +337,33 @@ class BlockedFusedCluster:
             snaps.append(b._metrics_acc.snapshot())
         return merge_snapshots(snaps)
 
+    def state_columns(self, *names) -> dict:
+        """Aggregate FusedCluster.state_columns over all K resident blocks:
+        each named [N_block]-leading leaf is concatenated in GLOBAL lane
+        order (block i owns lanes [i*B*V, (i+1)*B*V)). Async host copies
+        start on every block's leaves before the first blocking read."""
+        leaves = [
+            [getattr(b.state, name) for name in names] for b in self.blocks
+        ]
+        for row in leaves:
+            for x in row:
+                if hasattr(x, "copy_to_host_async"):
+                    x.copy_to_host_async()
+        return {
+            name: np.concatenate([np.asarray(row[j]) for row in leaves])
+            for j, name in enumerate(names)
+        }
+
+    def drain_read_states(self) -> dict:
+        """Merge per-block FusedCluster.drain_read_states into one
+        {global_lane: [(ctx, index), ...]} map."""
+        out = {}
+        for i, b in enumerate(self.blocks):
+            lo = i * self.lanes_per_block
+            for lane, rs in b.drain_read_states().items():
+                out[lo + lane] = rs
+        return out
+
     def total_committed(self) -> int:
         return int(sum(int(jnp.sum(b.state.committed)) for b in self.blocks))
 

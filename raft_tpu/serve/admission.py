@@ -1,0 +1,115 @@
+"""Admission control: per-tenant token buckets + global in-flight cap,
+with explicit typed backpressure instead of unbounded queueing.
+
+Every refusal surfaces as a `Rejected(reason)` RESULT — the PR 5
+PumpResult.truncated pattern: backpressure is data the caller routes on,
+never an exception and never a silent drop. The reason taxonomy extends
+the reference's ErrProposalDropped causes (api/rawnode.py DROP_*, the
+tests/test_backpressure.py audit set) with the frontend-only causes a
+multi-tenant service adds (rate limits, queue caps, in-flight caps):
+
+  tenant_rate    the tenant's token bucket is empty this round
+  inflight_cap   the global admitted-but-unnotified cap is reached
+  queue_full     the target group's coalescer queue is at capacity
+  read_batch_full  the group's ReadIndex batch window is saturated
+  no_leader      the target group has no attached leader (mirrors
+                 DROP_NO_LEADER one layer up — refused before the device
+                 would drop it)
+  session_closed the issuing session was closed
+
+Buckets refill once per device round (the serving loop's clock), so a
+rate of r with burst b means "at most b at once, r/round sustained" — at
+64k+ groups the per-round refill sweep only touches tenants that actually
+queued (lazy bucket creation, O(active tenants)).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from raft_tpu.api.rawnode import DROP_NO_LEADER
+
+REJECT_TENANT_RATE = "tenant_rate"
+REJECT_INFLIGHT_CAP = "inflight_cap"
+REJECT_QUEUE_FULL = "queue_full"
+REJECT_READ_BATCH_FULL = "read_batch_full"
+REJECT_NO_LEADER = DROP_NO_LEADER
+REJECT_SESSION_CLOSED = "session_closed"
+
+
+class Rejected(NamedTuple):
+    """Typed backpressure result. Falsy, so `if not res:` routes it."""
+
+    reason: str
+    detail: str = ""
+
+    def __bool__(self) -> bool:
+        return False
+
+
+class TokenBucket:
+    __slots__ = ("capacity", "refill", "tokens")
+
+    def __init__(self, rate: float, burst: float):
+        self.capacity = float(burst)
+        self.refill = float(rate)
+        self.tokens = float(burst)
+
+    def take(self, n: float = 1.0) -> bool:
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+    def tick(self) -> None:
+        self.tokens = min(self.capacity, self.tokens + self.refill)
+
+
+class AdmissionController:
+    """Gatekeeper in front of the coalescer queues.
+
+    `admit()` spends a token and a slot; the serving loop calls
+    `release()` once per notified proposal so the in-flight gauge tracks
+    admitted-but-unnotified work (propose -> commit -> notify), the
+    quantity the global cap bounds."""
+
+    def __init__(
+        self,
+        *,
+        tenant_rate: float = 64.0,
+        tenant_burst: float = 256.0,
+        inflight_cap: int = 1 << 16,
+    ):
+        self.tenant_rate = tenant_rate
+        self.tenant_burst = tenant_burst
+        self.inflight_cap = inflight_cap
+        self.inflight = 0
+        self._buckets: dict[str, TokenBucket] = {}
+
+    def bucket(self, tenant: str) -> TokenBucket:
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = self._buckets[tenant] = TokenBucket(
+                self.tenant_rate, self.tenant_burst
+            )
+        return b
+
+    def admit(self, tenant: str, cost: float = 1.0) -> Rejected | None:
+        """None = admitted; Rejected(reason) = backpressure (typed, never
+        raised). The bucket is charged only on success."""
+        if self.inflight >= self.inflight_cap:
+            return Rejected(
+                REJECT_INFLIGHT_CAP, f"inflight={self.inflight}"
+            )
+        if not self.bucket(tenant).take(cost):
+            return Rejected(REJECT_TENANT_RATE, tenant)
+        self.inflight += 1
+        return None
+
+    def release(self, n: int = 1) -> None:
+        self.inflight = max(0, self.inflight - n)
+
+    def tick(self) -> None:
+        """One device round elapsed: refill every live bucket."""
+        for b in self._buckets.values():
+            b.tick()

@@ -1,0 +1,266 @@
+"""Proposal coalescer: everything clients submitted between rounds becomes
+ONE batched LocalOps injection per round (per block, via the blocked
+scheduler's prepare_ops path).
+
+The Podracer shape (PAPERS.md, arxiv 2104.06272): the device runs rounds
+back-to-back; the host's only hot-path job is to fold the client queues
+into the next round's [N] op columns. Per group, per round, the coalescer
+injects at most
+
+  min(queue depth, Shape.max_msg_entries, window budget)
+
+entries at the group's leader lane. max_msg_entries is a KERNEL cap — the
+fused round clamps prop_n to E (ops/fused.py `pn = min(prop_n, e)`), so
+injecting more would silently truncate; the window budget keeps the
+device log window from refusing the append (append_entry's fits gate,
+ops/step.py) by accounting resident entries host-side against
+log_window - auto_compact_lag. Neither limit ever drops work: commands
+past the per-round cap simply wait in the (bounded) queue, and the bound
+surfaces as a typed Rejected(queue_full) at admission.
+
+Linearizable GETs batch harder: all reads for a group waiting at round r
+share ONE ReadIndex ticket (the [N] read_ctx column carries one ctx per
+lane per round — the etcd read-batching shape). A batch whose release
+never arrives (dropped beat under chaos, ro-ring overflow, leader not yet
+committed in its term) is re-injected with the SAME ctx after
+read_retry_rounds; reads are idempotent, so a double release is ignored
+by the router.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+from raft_tpu.serve.admission import (
+    REJECT_QUEUE_FULL,
+    REJECT_READ_BATCH_FULL,
+    Rejected,
+)
+from raft_tpu.serve.kv import Command
+
+
+class ProposeTicket:
+    """One admitted mutation's future: propose -> commit -> notify."""
+
+    __slots__ = (
+        "cmd", "group", "index", "submit_round", "inject_round",
+        "commit_round", "notify_round", "done", "applied",
+    )
+
+    def __init__(self, cmd: Command, group: int, submit_round: int):
+        self.cmd = cmd
+        self.group = group
+        self.index = None  # log index, assigned at injection
+        self.submit_round = submit_round
+        self.inject_round = None
+        self.commit_round = None
+        self.notify_round = None
+        self.done = False
+        self.applied = None  # True = mutated KV, False = dedup collapsed
+
+    @property
+    def latency_rounds(self) -> int | None:
+        if self.notify_round is None:
+            return None
+        return self.notify_round - self.submit_round
+
+
+class ReadTicket:
+    """One admitted linearizable GET's future."""
+
+    __slots__ = (
+        "session", "group", "key", "submit_round", "notify_round",
+        "done", "value", "index",
+    )
+
+    def __init__(self, session: int, group: int, key: str, submit_round: int):
+        self.session = session
+        self.group = group
+        self.key = key
+        self.submit_round = submit_round
+        self.notify_round = None
+        self.done = False
+        self.value = None
+        self.index = None  # the ReadIndex the answer reflects
+
+
+class ReadBatch:
+    """All GETs of one group sharing one ReadIndex ctx ticket."""
+
+    __slots__ = ("ctx", "group", "tickets", "inject_round", "retries")
+
+    def __init__(self, ctx: int, group: int, tickets: list, round_id: int):
+        self.ctx = ctx
+        self.group = group
+        self.tickets = tickets
+        self.inject_round = round_id
+        self.retries = 0
+
+
+class ProposalCoalescer:
+    def __init__(
+        self,
+        n_groups: int,
+        n_voters: int,
+        *,
+        max_entries_per_round: int,
+        log_window: int,
+        compact_lag: int,
+        max_read_batches: int,
+        queue_cap: int = 1024,
+        cmd_bytes: int = 64,
+        read_retry_rounds: int = 8,
+    ):
+        self.g, self.v = n_groups, n_voters
+        self.n = n_groups * n_voters
+        self.max_per_round = max_entries_per_round
+        # resident-entry budget: the device window holds W entries above
+        # the compaction point (snap_index ~ applied - lag once
+        # auto_compact_lag engages); 2 slots of margin absorb election
+        # empty entries so append_entry's fits gate never refuses us
+        self.window_budget = max(1, log_window - compact_lag - 2)
+        self.max_read_batches = max_read_batches
+        self.queue_cap = queue_cap
+        self.cmd_bytes = cmd_bytes
+        self.read_retry_rounds = read_retry_rounds
+        self.pending: list[deque] = [deque() for _ in range(n_groups)]
+        self.read_wait: list[list] = [[] for _ in range(n_groups)]
+        self.read_batches: dict[int, ReadBatch] = {}  # ctx -> batch
+        self._batches_of: list[set] = [set() for _ in range(n_groups)]
+        self._next_ctx = 1
+        self.on_read_retry = None  # optional hook (ServeLoop -> metrics)
+
+    # -- intake -----------------------------------------------------------
+
+    def queue_depth(self, group: int) -> int:
+        return len(self.pending[group]) + len(self.read_wait[group])
+
+    def enqueue(self, ticket: ProposeTicket) -> Rejected | None:
+        g = ticket.group
+        if self.queue_depth(g) >= self.queue_cap:
+            return Rejected(REJECT_QUEUE_FULL, f"group={g}")
+        self.pending[g].append(ticket)
+        return None
+
+    def requeue_front(self, group: int, tickets: list) -> None:
+        """Epoch resync: put re-proposed tickets back at the queue head in
+        original order (dedup makes the re-commit exactly-once)."""
+        self.pending[group].extendleft(reversed(tickets))
+
+    def enqueue_read(self, ticket: ReadTicket) -> Rejected | None:
+        g = ticket.group
+        # the more specific reason first: the ReadIndex batch window is
+        # saturated AND the wait queue is at capacity behind it
+        if (
+            len(self._batches_of[g]) >= self.max_read_batches
+            and len(self.read_wait[g]) >= self.queue_cap
+        ):
+            return Rejected(REJECT_READ_BATCH_FULL, f"group={g}")
+        if self.queue_depth(g) >= self.queue_cap:
+            return Rejected(REJECT_QUEUE_FULL, f"group={g}")
+        self.read_wait[g].append(ticket)
+        return None
+
+    def take_batch(self, ctx: int) -> ReadBatch | None:
+        b = self.read_batches.pop(ctx, None)
+        if b is not None:
+            self._batches_of[b.group].discard(ctx)
+        return b
+
+    @property
+    def outstanding_reads(self) -> int:
+        return len(self.read_batches)
+
+    def drop_group_reads(self, group: int) -> list:
+        """Epoch resync: cancel the group's unreleased batches and return
+        every waiting ticket for re-admission-free re-batching."""
+        tickets = []
+        for ctx in sorted(self._batches_of[group]):
+            b = self.read_batches.pop(ctx)
+            tickets.extend(b.tickets)
+        self._batches_of[group].clear()
+        tickets.extend(self.read_wait[group])
+        self.read_wait[group] = []
+        return tickets
+
+    # -- the per-round batched injection ----------------------------------
+
+    def build(self, views, round_id: int):
+        """Fold the queues into one round's LocalOps columns.
+
+        views: router.GroupView list (leader lane + next_index + commit
+        watermark per group); next_index advances here, at assignment.
+        Returns (LocalOps | None, injections) where injections is the
+        [(view, [ProposeTicket, ...]), ...] the router must record before
+        the round's commits can resolve. None means a zero-op round (the
+        engine's cached no_ops fast path).
+        """
+        prop_n = None  # allocated lazily: zero-op rounds build nothing
+        injections = []
+        for g in range(self.g):
+            view = views[g]
+            if view.leader_lane < 0:
+                continue
+            room = self.window_budget - (view.next_index - 1 - view.floor())
+            m = min(len(self.pending[g]), self.max_per_round, max(0, room))
+            if m > 0:
+                if prop_n is None:
+                    prop_n = np.zeros((self.n,), np.int32)
+                    prop_bytes = np.zeros((self.n,), np.int32)
+                    read_ctx = np.zeros((self.n,), np.int32)
+                batch = [self.pending[g].popleft() for _ in range(m)]
+                for t in batch:
+                    t.index = view.next_index
+                    t.inject_round = round_id
+                    view.next_index += 1
+                prop_n[view.leader_lane] = m
+                prop_bytes[view.leader_lane] = self.cmd_bytes
+                injections.append((view, batch))
+            ctx = self._pick_read_ctx(g, view, round_id)
+            if ctx:
+                if prop_n is None:
+                    prop_n = np.zeros((self.n,), np.int32)
+                    prop_bytes = np.zeros((self.n,), np.int32)
+                    read_ctx = np.zeros((self.n,), np.int32)
+                read_ctx[view.leader_lane] = ctx
+        if prop_n is None:
+            return None, injections
+        from raft_tpu.ops.fused import make_local_ops
+
+        ops = make_local_ops(
+            self.n, prop_n=prop_n, prop_bytes=prop_bytes, read_ctx=read_ctx
+        )
+        return ops, injections
+
+    def _pick_read_ctx(self, g: int, view, round_id: int) -> int:
+        """One read_ctx slot per lane per round: a due retry of the oldest
+        unreleased batch wins over opening a new batch."""
+        due = [
+            self.read_batches[c]
+            for c in self._batches_of[g]
+            if round_id - self.read_batches[c].inject_round
+            >= self.read_retry_rounds * (self.read_batches[c].retries + 1)
+        ]
+        if due:
+            b = min(due, key=lambda b: b.inject_round)
+            b.retries += 1
+            if self.on_read_retry is not None:
+                self.on_read_retry()
+            return b.ctx
+        if (
+            self.read_wait[g]
+            and len(self._batches_of[g]) < self.max_read_batches
+        ):
+            ctx = self._next_ctx
+            # i32, nonzero, wraps long before the ro ring could still hold
+            # a colliding live ticket
+            self._next_ctx = 1 if self._next_ctx >= (1 << 30) else ctx + 1
+            b = ReadBatch(ctx, g, self.read_wait[g], round_id)
+            self.read_wait[g] = []
+            self.read_batches[ctx] = b
+            self._batches_of[g].add(ctx)
+            return ctx
+        return 0

@@ -1,0 +1,93 @@
+"""Prometheus scrape endpoint for the serving frontend — stdlib-only
+(http.server on a daemon thread; the container bakes no web framework and
+the exporter needs none).
+
+GET /metrics renders every registered source through
+metrics/host.py prometheus_text, concatenated: the serving plane
+(raft_tpu_serve prefix, notify-latency histogram) and the engine plane
+(raft_tpu prefix, commit-latency histogram) stay SEPARATE families in one
+exposition body — never merged, because merge_snapshots would sum the two
+histograms into nonsense. GET /healthz answers 200 "ok" for liveness.
+
+    srv = MetricsHTTPServer()
+    srv.add_source("raft_tpu_serve", "notify_latency_rounds",
+                   loop.metrics_snapshot)
+    srv.add_source("raft_tpu", "commit_latency_rounds",
+                   loop.engine_snapshot)
+    srv.start()           # binds 127.0.0.1:<port> (port=0 -> ephemeral)
+    ... scrape http://127.0.0.1:{srv.port}/metrics ...
+    srv.stop()
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from raft_tpu.metrics.host import prometheus_text
+
+
+class MetricsHTTPServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host, self._port = host, port
+        self._sources: list = []  # (prefix, hist_name, snapshot_callable)
+        self._httpd = None
+        self._thread = None
+
+    def add_source(self, prefix: str, hist_name: str, snapshot) -> None:
+        """snapshot: zero-arg callable returning a snapshot dict (or None
+        while that plane is disabled — skipped in the rendering)."""
+        self._sources.append((prefix, hist_name, snapshot))
+
+    def render(self) -> str:
+        parts = []
+        for prefix, hist_name, snapshot in self._sources:
+            snap = snapshot()
+            if snap is None:
+                continue
+            parts.append(prometheus_text(snap, prefix, hist_name))
+        return "".join(parts)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "MetricsHTTPServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = outer.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/healthz":
+                    body, ctype = b"ok\n", "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # no stderr spam per scrape
+                pass
+
+        self._httpd = HTTPServer((self._host, self._port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("server not started")
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=5)
+            self._httpd = self._thread = None

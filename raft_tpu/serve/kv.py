@@ -1,0 +1,160 @@
+"""Host-side applied-KV materialization + the client command model.
+
+The device log carries entry *shapes* (term/type/bytes — ops/fused.py
+LocalOps.prop_n/prop_bytes); payload CONTENT stays host-side, exactly like
+the reference keeps application state above raft. The serving frontend
+therefore keeps, per raft group, the materialized state machine the
+committed prefix of that group's log produces:
+
+  - a key -> Entry map (puts/deletes),
+  - a lease table (lease grants carry a ttl in device ticks; expiry is
+    driven by the tick plane — one fused round with do_tick=True is one
+    tick, so leases die at an absolute round number),
+  - per-session dedup cursors (`last_seq`): a session retries a timed-out
+    proposal with the SAME seq, and apply() skips any (session, seq) at or
+    below the cursor — committed-twice never applies twice (the reference
+    app-level contract etcd's KV apply loop implements the same way).
+
+`digest()` is the acceptance oracle: a sha256 over the full materialized
+state (live keys, live leases, dedup cursors). `replay()` rebuilds a
+fresh store from an admission-ordered command log — the scalar twin
+benches/serve_bench.py and tests/test_serve.py compare against, proving
+the pipelined serving path (coalescer -> device rounds -> egress bundles
+-> router applies) applied exactly the committed commands, exactly once,
+in commit order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, NamedTuple
+
+OP_PUT = 1
+OP_DELETE = 2
+OP_LEASE = 3  # put + ttl: the entry expires `ttl` ticks after it applies
+
+_OP_NAMES = {OP_PUT: "put", OP_DELETE: "delete", OP_LEASE: "lease"}
+
+
+class Command(NamedTuple):
+    """One client mutation, host-side payload of one device log entry."""
+
+    op: int  # OP_PUT / OP_DELETE / OP_LEASE
+    tenant: str
+    session: int  # issuing session id (dedup scope)
+    seq: int  # per-session sequence — retries reuse it
+    key: str
+    value: Any = None
+    ttl: int = 0  # OP_LEASE: lifetime in device ticks
+    nbytes: int = 0  # accounted payload size (admission/uncommitted gates)
+
+
+@dataclasses.dataclass
+class KVEntry:
+    value: Any
+    session: int
+    seq: int
+    expires: int | None = None  # absolute tick, None = no lease
+
+
+class GroupStore:
+    """Materialized state machine of ONE raft group's committed prefix."""
+
+    def __init__(self):
+        self.data: dict[str, KVEntry] = {}
+        self.last_seq: dict[int, int] = {}  # session -> highest applied seq
+        self.applied_cmds = 0
+        self.deduped_cmds = 0
+
+    def apply(self, cmd: Command, now: int) -> bool:
+        """Apply one committed command; returns False when the dedup
+        cursor already covers (session, seq) — the retried-duplicate path."""
+        if cmd.seq <= self.last_seq.get(cmd.session, 0):
+            self.deduped_cmds += 1
+            return False
+        self.last_seq[cmd.session] = cmd.seq
+        self.applied_cmds += 1
+        if cmd.op == OP_DELETE:
+            self.data.pop(cmd.key, None)
+        elif cmd.op == OP_LEASE:
+            self.data[cmd.key] = KVEntry(
+                cmd.value, cmd.session, cmd.seq, expires=now + cmd.ttl
+            )
+        else:
+            self.data[cmd.key] = KVEntry(cmd.value, cmd.session, cmd.seq)
+        return True
+
+    def get(self, key: str, now: int):
+        """Read one key; expired leases read as absent (lazy expiry — the
+        sweep in expire() keeps the digest surface identical)."""
+        e = self.data.get(key)
+        if e is None:
+            return None
+        if e.expires is not None and now >= e.expires:
+            return None
+        return e.value
+
+    def expire(self, now: int) -> int:
+        """Drop leases whose ttl elapsed; returns how many died. get()
+        treats them as absent lazily, so the sweep cadence is invisible to
+        readers — it only bounds the table size."""
+        dead = [
+            k
+            for k, e in self.data.items()
+            if e.expires is not None and now >= e.expires
+        ]
+        for k in dead:
+            del self.data[k]
+        return len(dead)
+
+
+class KVStore:
+    """The frontend's full materialization: one GroupStore per raft group."""
+
+    def __init__(self, n_groups: int):
+        self.groups = [GroupStore() for _ in range(n_groups)]
+
+    def apply(self, group: int, cmd: Command, now: int) -> bool:
+        return self.groups[group].apply(cmd, now)
+
+    def get(self, group: int, key: str, now: int):
+        return self.groups[group].get(key, now)
+
+    def expire(self, now: int) -> int:
+        return sum(g.expire(now) for g in self.groups)
+
+    def digest(self, now: int) -> str:
+        """sha256 over the complete live state in canonical order: per
+        group, the surviving (key, value, owner session/seq, remaining
+        lease) tuples plus the dedup cursor table."""
+        h = hashlib.sha256()
+        for gi, g in enumerate(self.groups):
+            h.update(b"G%d" % gi)
+            for k in sorted(g.data):
+                e = g.data[k]
+                if e.expires is not None and now >= e.expires:
+                    continue
+                exp = -1 if e.expires is None else e.expires
+                h.update(
+                    f"|{k}={e.value!r}@{e.session}.{e.seq}^{exp}".encode()
+                )
+            h.update(b"#")
+            for s in sorted(g.last_seq):
+                h.update(f"|{s}:{g.last_seq[s]}".encode())
+        return h.hexdigest()
+
+
+def replay(n_groups: int, log, end_tick: int) -> str:
+    """The scalar twin: rebuild a KVStore from an apply-ordered command log
+    `[(group, Command, apply_tick), ...]` and digest it at `end_tick`.
+
+    Feeding it the ADMISSION-ordered log (retries included) instead checks
+    the stronger claim: per group, commit order equals admission order
+    under a stable leader, and dedup collapses retries — if the serving
+    path reordered, dropped, or double-applied anything, the digests part.
+    """
+    store = KVStore(n_groups)
+    for group, cmd, tick in log:
+        store.apply(group, cmd, tick)
+    return store.digest(end_tick)

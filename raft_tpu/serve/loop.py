@@ -1,0 +1,362 @@
+"""ServeLoop: the multi-tenant serving frontend wired onto the fused
+fabric — ROADMAP item 3's production loop.
+
+One ServeLoop owns one FusedCluster or BlockedFusedCluster and runs the
+whole propose -> commit -> notify pipeline per device round:
+
+    round += 1; admission buckets refill
+    coalescer folds the client queues into ONE LocalOps injection
+      (per block, through the scheduler's prepare_ops path)
+    cluster.run(1, ops, egress=streams, auto_compact_lag=lag)
+      - the push resolves the PREVIOUS round's egress bundle while this
+        round computes; the CompletionRouter (the sink) advances commit
+        watermarks, applies committed commands to the host KV, and
+        resolves client futures
+    if linearizable reads are outstanding: drain the rs_* ring
+    if a leader/term change voided attribution: synchronous epoch resync
+
+The host never scans all N lanes and never issues per-lane scalar reads
+on the hot path: commit discovery rides the O(active) egress bundles, and
+the only synchronous pulls are the read drain (gated on outstanding
+reads) and epoch resyncs (gated on observed leader changes).
+
+Clock: rounds ARE ticks (do_tick=True — the engine's 1-round = 1-tick
+contract), so `self.round` is simultaneously the latency clock, the lease
+clock, and the device election clock. Bootstrap rounds count.
+
+Admission rejections come back as typed `Rejected(reason)` values, falsy
+and never raised — callers route on them; every one is counted under
+`rejected_<reason>` plus the aggregate `proposals_rejected`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.metrics.host import (
+    HostCounters,
+    HostHistogram,
+    prometheus_text,
+)
+from raft_tpu.ops import ready_mask
+from raft_tpu.runtime.egress import EgressStream
+from raft_tpu.serve.admission import (
+    REJECT_NO_LEADER,
+    REJECT_SESSION_CLOSED,
+    AdmissionController,
+    Rejected,
+)
+from raft_tpu.serve.coalescer import (
+    ProposalCoalescer,
+    ProposeTicket,
+    ReadTicket,
+)
+from raft_tpu.serve.kv import (
+    OP_DELETE,
+    OP_LEASE,
+    OP_PUT,
+    Command,
+    KVStore,
+)
+from raft_tpu.serve.router import CompletionRouter
+from raft_tpu.serve.session import Session, SessionManager
+
+
+class ServeMetrics:
+    """The serving plane's own registry: host counters + the notify
+    latency histogram (device-round edges). Deliberately NOT merged into
+    the engine snapshot — merge_snapshots sums histograms blindly, and
+    notify latency must never fold into device commit latency. The HTTP
+    endpoint (serve/http.py) renders both planes under distinct prefixes."""
+
+    def __init__(self):
+        self.counters = HostCounters()
+        self.hist = HostHistogram()
+        self.rounds = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "counters": dict(self.counters.counts),
+            "hist": self.hist.snapshot(),
+            "rounds": int(self.rounds),
+        }
+
+    def prometheus(self) -> str:
+        return prometheus_text(
+            self.snapshot(),
+            prefix="raft_tpu_serve",
+            hist_name="notify_latency_rounds",
+        )
+
+
+class ServeLoop:
+    def __init__(
+        self,
+        cluster,
+        *,
+        tenant_rate: float = 64.0,
+        tenant_burst: float = 256.0,
+        inflight_cap: int = 1 << 16,
+        queue_cap: int = 1024,
+        cmd_bytes: int = 64,
+        auto_compact_lag: int | None = None,
+        read_retry_rounds: int = 8,
+        expire_every: int = 16,
+    ):
+        if not ready_mask.egress_enabled():
+            raise RuntimeError(
+                "serving frontend needs the egress plane: commit discovery "
+                "rides the DeltaBundle sink (unset RAFT_TPU_EGRESS=0)"
+            )
+        self.cluster = cluster
+        self.blocked = hasattr(cluster, "blocks")  # BlockedFusedCluster
+        base = cluster.blocks[0] if self.blocked else cluster
+        self.g, self.v = cluster.g, cluster.v
+        self.n = self.g * self.v
+        self.shape = base.shape
+        self.k = cluster.k if self.blocked else 1
+        self.lanes_per_block = (
+            cluster.lanes_per_block if self.blocked else self.n
+        )
+        self.compact_lag = (
+            self.shape.log_window // 4
+            if auto_compact_lag is None
+            else auto_compact_lag
+        )
+        self.expire_every = expire_every
+        self.round = 0
+
+        self.metrics = ServeMetrics()
+        self.sessions = SessionManager(self.g)
+        self.kv = KVStore(self.g)
+        self.admission = AdmissionController(
+            tenant_rate=tenant_rate,
+            tenant_burst=tenant_burst,
+            inflight_cap=inflight_cap,
+        )
+        self.coalescer = ProposalCoalescer(
+            self.g,
+            self.v,
+            max_entries_per_round=self.shape.max_msg_entries,
+            log_window=self.shape.log_window,
+            compact_lag=self.compact_lag,
+            # leave one ro-ring slot of headroom per lane so a retried ctx
+            # plus the live window never overflow max_read_index
+            max_read_batches=max(1, self.shape.max_read_index - 1),
+            queue_cap=queue_cap,
+            cmd_bytes=cmd_bytes,
+            read_retry_rounds=read_retry_rounds,
+        )
+        self.coalescer.on_read_retry = lambda: self.metrics.counters.inc(
+            "reads_retried"
+        )
+        self.router = CompletionRouter(
+            self.g,
+            self.v,
+            self.lanes_per_block,
+            self.kv,
+            self.metrics,
+            self.admission,
+            self.coalescer,
+            compact_lag=self.compact_lag,
+        )
+        # one egress stream per resident block; the sink closure pins the
+        # SCHEDULER block index (the stream's own push counter is a
+        # sequence number, not lane addressing)
+        self.streams = [
+            EgressStream(
+                sink=lambda seq, bundle, bi=i: self.router.on_bundle(
+                    bi, seq, bundle
+                )
+            )
+            for i in range(self.k)
+        ]
+        self._egress_arg = self.streams if self.blocked else self.streams[0]
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def bootstrap(self, max_rounds: int = 512) -> None:
+        """Run election rounds until every group has exactly one leader,
+        then attach the router's group views from one synchronous column
+        pull (initial attach rides the epoch-resync machinery on empty
+        queues)."""
+        self.router.needs_resync.update(range(self.g))
+        spent = 0
+        while self.router.needs_resync and spent < max_rounds:
+            self.cluster.run(8, auto_compact_lag=self.compact_lag)
+            self.round += 8
+            spent += 8
+            self.router.round = self.round
+            self.router.resync(self._columns())
+        if self.router.needs_resync:
+            raise RuntimeError(
+                f"bootstrap: {len(self.router.needs_resync)} group(s) still "
+                f"electing after {spent} rounds"
+            )
+
+    def _columns(self) -> dict:
+        return self.cluster.state_columns(
+            "state", "term", "committed", "last"
+        )
+
+    # -- client surface ----------------------------------------------------
+
+    def open_session(self, tenant: str) -> Session:
+        s = self.sessions.open(tenant)
+        return s
+
+    def close_session(self, session: Session) -> None:
+        self.sessions.close(session)
+
+    def put(self, session, key, value, nbytes: int = 0):
+        return self._submit(session, OP_PUT, key, value, 0, nbytes)
+
+    def delete(self, session, key):
+        return self._submit(session, OP_DELETE, key, None, 0, 0)
+
+    def lease(self, session, key, value, ttl: int):
+        """Put with a lifetime: the entry expires `ttl` device ticks after
+        it APPLIES (the tick plane is the lease clock)."""
+        return self._submit(session, OP_LEASE, key, value, ttl, 0)
+
+    def _submit(self, session, op, key, value, ttl, nbytes):
+        gate = self._gate(session)
+        if gate is not None:
+            return gate
+        cmd = Command(
+            op, session.tenant, session.id, session.next_seq(),
+            key, value, ttl, nbytes,
+        )
+        return self._enqueue_cmd(session, cmd)
+
+    def resubmit(self, session, ticket: ProposeTicket):
+        """Client retry of a timed-out proposal: SAME command, SAME seq —
+        the (session, seq) dedup cursor collapses a double commit into one
+        apply, turning at-least-once delivery into exactly-once apply."""
+        gate = self._gate(session)
+        if gate is not None:
+            return gate
+        return self._enqueue_cmd(session, ticket.cmd)
+
+    def _enqueue_cmd(self, session, cmd: Command):
+        rej = self.admission.admit(session.tenant)
+        if rej is not None:
+            return self._rejected(rej)
+        t = ProposeTicket(cmd, session.group, self.round)
+        rej = self.coalescer.enqueue(t)
+        if rej is not None:
+            self.admission.release()
+            return self._rejected(rej)
+        self.metrics.counters.inc("proposals_admitted")
+        return t
+
+    def get(self, session, key):
+        """Linearizable GET: batches through the ReadIndex plane (all of a
+        group's waiting reads share one ctx ticket per round) and answers
+        from the applied KV once the group's watermark covers the released
+        ReadIndex."""
+        gate = self._gate(session)
+        if gate is not None:
+            return gate
+        rej = self.admission.admit(session.tenant)
+        if rej is not None:
+            return self._rejected(rej, read=True)
+        rt = ReadTicket(session.id, session.group, key, self.round)
+        rej = self.coalescer.enqueue_read(rt)
+        if rej is not None:
+            self.admission.release()
+            return self._rejected(rej, read=True)
+        self.metrics.counters.inc("reads_admitted")
+        return rt
+
+    def _gate(self, session) -> Rejected | None:
+        if not session.open:
+            return self._rejected(Rejected(REJECT_SESSION_CLOSED))
+        if not self.router.views[session.group].attached:
+            return self._rejected(
+                Rejected(REJECT_NO_LEADER, f"group={session.group}")
+            )
+        return None
+
+    def _rejected(self, rej: Rejected, read: bool = False) -> Rejected:
+        self.metrics.counters.inc("proposals_rejected")
+        self.metrics.counters.inc(f"rejected_{rej.reason}")
+        return rej
+
+    # -- the round loop ----------------------------------------------------
+
+    def step(self, rounds: int = 1) -> None:
+        for _ in range(rounds):
+            self._step_one()
+
+    def _step_one(self) -> None:
+        self.round += 1
+        self.metrics.rounds = self.round
+        self.router.round = self.round
+        self.admission.tick()
+        ops, injections = self.coalescer.build(self.router.views, self.round)
+        self.router.record_injections(injections)
+        if ops is not None and self.blocked:
+            # slice once, explicitly — the scheduler's identity LRU cannot
+            # hit on a fresh per-round ops object
+            ops = self.cluster.prepare_ops(ops)
+        self.cluster.run(
+            1,
+            ops=ops,
+            egress=self._egress_arg,
+            auto_compact_lag=self.compact_lag,
+        )
+        if self.coalescer.outstanding_reads:
+            drained = self.cluster.drain_read_states()
+            for glane, rss in drained.items():
+                for ctx, index in rss:
+                    self.router.on_read_release(glane, ctx, index)
+        if self.router.needs_resync:
+            self.router.resync(self._columns())
+        if self.expire_every and self.round % self.expire_every == 0:
+            self.kv.expire(self.round)
+        self.metrics.counters.set("sessions_active", self.sessions.active)
+
+    def flush(self) -> None:
+        """Resolve the in-flight egress tail: the double-buffered push
+        resolves bundles one round behind, so the final round's commits
+        only notify after a flush."""
+        for s in self.streams:
+            s.flush()
+        self.router.round = self.round
+
+    @property
+    def outstanding(self) -> int:
+        """Admitted-but-unnotified work (proposals + reads)."""
+        return self.admission.inflight
+
+    def drain(self, max_rounds: int = 256) -> bool:
+        """Step (with per-round flushes, killing the one-round notify lag)
+        until every admitted future resolved; False if max_rounds elapsed
+        with work still outstanding."""
+        spent = 0
+        self.flush()
+        while self.outstanding and spent < max_rounds:
+            self._step_one()
+            self.flush()
+            spent += 1
+        return self.outstanding == 0
+
+    # -- oracles / export --------------------------------------------------
+
+    def digest(self) -> str:
+        """sha256 of the full applied KV materialization at `round`."""
+        return self.kv.digest(self.round)
+
+    def twin_digest(self) -> str:
+        """Replay the router's apply-ordered command log through a fresh
+        scalar KVStore — the acceptance oracle the digests must match."""
+        from raft_tpu.serve.kv import replay
+
+        return replay(self.g, self.router.applied_log, self.round)
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    def engine_snapshot(self) -> dict | None:
+        return self.cluster.metrics_snapshot()

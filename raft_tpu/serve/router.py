@@ -1,0 +1,263 @@
+"""Completion router: egress bundles in, resolved client futures out.
+
+Registered as the EgressStream sink, the router is the only consumer of
+the device's outbound plane on the serving path. Each resolved
+DeltaBundle gives it, for every ACTIVE lane, the current term / lead /
+state / committed cursors; from the leader lane of each group it learns
+how far that group's log has committed and resolves, in log order, every
+attributed proposal at or below the watermark:
+
+  propose -> commit -> notify
+  (coalescer assigns the log index at injection; commit is observed via
+  the bundle's committed column; notify completes the ProposeTicket and
+  applies the command to the host KV materialization, dedup included.)
+
+Index attribution is exact under a stable leader: the fused round appends
+injected entries at last+1.. for the leader lane, and the router
+initializes next_index from the leader's `last` at attach. When the
+bundle shows the leader lane's term moved or its state left LEADER, the
+attribution is void — the router flags the group for EPOCH RESYNC: the
+serving loop re-pulls that group's columns synchronously, re-attaches to
+the new leader, and RE-PROPOSES every in-flight ticket (front of queue,
+original order). Commands may then commit twice in the log; the
+(session, seq) dedup cursor collapses the second apply, so the client
+contract stays exactly-once. Unreleased read batches of the group are
+cancelled back to the wait queue the same way (a ReadIndex from a
+deposed leader must not serve).
+
+Exactly-once notification is AUDITED, not assumed: completing a ticket
+that is already done increments the notify_violations counter instead of
+silently double-firing — the bench's acceptance gate asserts it stays 0.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.serve.kv import KVStore
+from raft_tpu.types import StateType
+
+_LEADER = int(StateType.LEADER)
+
+
+class GroupView:
+    """The router's model of one raft group: who leads, at what term, how
+    far its log has committed, and where the next injected entry lands."""
+
+    __slots__ = (
+        "gid", "leader_lane", "term", "watermark", "next_index",
+        "attached", "epoch",
+    )
+
+    def __init__(self, gid: int):
+        self.gid = gid
+        self.leader_lane = -1  # global lane index, -1 = not attached
+        self.term = 0
+        self.watermark = 0  # highest committed index applied to the KV
+        self.next_index = 1  # next log slot an injection takes
+        self.attached = False
+        self.epoch = 0  # bumps on every resync
+
+    def floor(self) -> int:
+        """Estimated device compaction point (snap_index) for the window
+        budget: auto-compaction keeps `lag` entries below applied, and
+        applied == committed at the end of every fused round."""
+        return self.watermark
+
+    def attach(self, leader_lane: int, term: int, committed: int, last: int):
+        self.leader_lane = leader_lane
+        self.term = term
+        self.watermark = max(self.watermark, committed)
+        self.next_index = last + 1
+        self.attached = True
+        self.epoch += 1
+
+    def detach(self):
+        self.leader_lane = -1
+        self.attached = False
+
+
+class CompletionRouter:
+    def __init__(
+        self,
+        n_groups: int,
+        n_voters: int,
+        lanes_per_block: int,
+        kv: KVStore,
+        metrics,
+        admission,
+        coalescer,
+        *,
+        compact_lag: int = 0,
+    ):
+        self.g, self.v = n_groups, n_voters
+        self.lanes_per_block = lanes_per_block
+        self.kv = kv
+        self.metrics = metrics
+        self.admission = admission
+        self.coalescer = coalescer
+        self.compact_lag = compact_lag
+        self.views = [GroupView(g) for g in range(n_groups)]
+        # per group: log index -> ProposeTicket (ours), in ascending order
+        self.cmd_log: list[dict] = [{} for _ in range(n_groups)]
+        self.needs_resync: set[int] = set()
+        self.round = 0  # the serving loop's clock, stamped before each run
+        # apply-ordered (group, Command, tick) log for the scalar twin
+        self.applied_log: list = []
+        self._served_batches: list = []  # released batches awaiting watermark
+
+    # -- injection bookkeeping -------------------------------------------
+
+    def record_injections(self, injections) -> None:
+        """Called right after coalescer.build: indexes were assigned, make
+        them resolvable before the round's commits arrive."""
+        for view, batch in injections:
+            log = self.cmd_log[view.gid]
+            for t in batch:
+                log[t.index] = t
+
+    @property
+    def inflight_cmds(self) -> int:
+        return sum(len(d) for d in self.cmd_log)
+
+    # -- the egress sink --------------------------------------------------
+
+    def on_bundle(self, block_id: int, seq: int, bundle) -> None:
+        """EgressStream sink for scheduler block `block_id` (the stream's
+        own push counter `seq` is not lane-addressing — each resident
+        block owns its own stream)."""
+        lo = block_id * self.lanes_per_block
+        count = int(bundle.count)
+        active = np.asarray(bundle.active)
+        state = np.asarray(bundle.state)
+        term = np.asarray(bundle.term)
+        committed = np.asarray(bundle.committed)
+        for j in range(count):
+            lane_local = int(active[j])
+            glane = lo + lane_local
+            view = self.views[glane // self.v]
+            if glane != view.leader_lane:
+                continue
+            if (
+                int(state[lane_local]) != _LEADER
+                or int(term[lane_local]) != view.term
+            ):
+                # deposed / re-elected: attribution void, resync the group
+                view.detach()
+                self.needs_resync.add(view.gid)
+                continue
+            c = int(committed[lane_local])
+            if c > view.watermark:
+                self._advance(view, c)
+        if self._served_batches:
+            self._serve_ready_batches()
+
+    def _advance(self, view: GroupView, committed: int) -> None:
+        """Resolve every attributed index in (watermark, committed]."""
+        log = self.cmd_log[view.gid]
+        for idx in range(view.watermark + 1, committed + 1):
+            t = log.pop(idx, None)
+            if t is None:
+                continue  # not ours (election empty entry, pre-attach)
+            t.commit_round = self.round
+            applied = self.kv.apply(view.gid, t.cmd, self.round)
+            self.applied_log.append((view.gid, t.cmd, self.round))
+            self._complete(t, applied)
+        view.watermark = committed
+
+    def _complete(self, t, applied: bool) -> None:
+        if t.done:
+            self.metrics.counters.inc("notify_violations")
+            return
+        t.applied = applied
+        t.notify_round = self.round
+        t.done = True
+        self.admission.release()
+        self.metrics.counters.inc("proposals_notified")
+        self.metrics.hist.observe(self.round - t.submit_round)
+
+    # -- the linearizable read path --------------------------------------
+
+    def on_read_release(self, glane: int, ctx: int, index: int) -> None:
+        """One drained ReadState: the device released ctx at ReadIndex
+        `index` (quorum-confirmed leadership, or lease/single-voter fast
+        path). Stale releases (retried ctx already taken) are ignored —
+        reads are idempotent."""
+        batch = self.coalescer.take_batch(ctx)
+        if batch is None:
+            return
+        view = self.views[batch.group]
+        if glane != view.leader_lane:
+            # released by a lane we no longer trust; re-batch the tickets
+            self.coalescer.read_wait[batch.group].extend(batch.tickets)
+            return
+        self._served_batches.append((batch, index))
+        self._serve_ready_batches()
+
+    def _serve_ready_batches(self) -> None:
+        still = []
+        for batch, index in self._served_batches:
+            view = self.views[batch.group]
+            if view.watermark >= index:
+                for rt in batch.tickets:
+                    self._finish_read(rt, index)
+            else:
+                still.append((batch, index))  # wait for the apply wavefront
+        self._served_batches = still
+
+    def _finish_read(self, rt, index: int) -> None:
+        if rt.done:
+            self.metrics.counters.inc("notify_violations")
+            return
+        rt.value = self.kv.get(rt.group, rt.key, self.round)
+        rt.index = index
+        rt.notify_round = self.round
+        rt.done = True
+        self.admission.release()
+        self.metrics.counters.inc("reads_served")
+
+    @property
+    def reads_waiting_apply(self) -> int:
+        return len(self._served_batches)
+
+    # -- epoch resync -----------------------------------------------------
+
+    def resync(self, columns: dict) -> int:
+        """Re-attach every flagged group from a fresh synchronous column
+        pull ({state, lead, term, committed, last} as [N] numpy). In-flight
+        tickets re-propose at the queue head; unreleased read batches
+        cancel back to the wait queue. Returns how many groups reattached
+        (a group still electing stays detached and is retried next call)."""
+        state, term = columns["state"], columns["term"]
+        committed, last = columns["committed"], columns["last"]
+        reattached = 0
+        for gid in sorted(self.needs_resync):
+            view = self.views[gid]
+            lanes = range(gid * self.v, (gid + 1) * self.v)
+            leaders = [l for l in lanes if int(state[l]) == _LEADER]
+            if len(leaders) != 1:
+                continue  # mid-election; keep the flag, retry next round
+            lead = leaders[0]
+            was_attached = view.epoch > 0
+            view.attach(
+                lead, int(term[lead]), int(committed[lead]), int(last[lead])
+            )
+            # Indexes committed while detached are NOT resolved from the old
+            # attribution — a leader change may have replaced the entry at
+            # an attributed index. Every in-flight ticket re-proposes; a
+            # command whose first copy did commit commits twice in the log
+            # and the (session, seq) cursor collapses the second apply.
+            survivors = [
+                self.cmd_log[gid].pop(i) for i in sorted(self.cmd_log[gid])
+            ]
+            for t in survivors:
+                t.index = None
+                t.inject_round = None
+            self.coalescer.requeue_front(gid, survivors)
+            for rt in self.coalescer.drop_group_reads(gid):
+                self.coalescer.read_wait[gid].append(rt)
+            if was_attached:  # the initial bootstrap attach is not a resync
+                self.metrics.counters.inc("epoch_resyncs")
+            self.needs_resync.discard(gid)
+            reattached += 1
+        return reattached

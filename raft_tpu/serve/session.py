@@ -1,0 +1,69 @@
+"""Client session layer: per-tenant sessions with dedup ids, mapped onto
+raft groups by static hash placement.
+
+The reference hosts "multiple raft group" per process (raft.go:244-246)
+and leaves tenancy to the application; at millions-of-users scale the
+frontend must pin each tenant's keyspace to ONE group so its commands
+serialize through one log (linearizable per tenant) and the coalescer can
+batch them into that group's per-round injection. Placement is a static
+hash (crc32 — stable across processes and PYTHONHASHSEED, unlike
+hash()); consistent-hash rebalancing and live migration ride later
+ROADMAP items (item 5's group migration is the backing primitive).
+
+A session is the dedup scope: it owns a monotonically increasing `seq`,
+stamps every command with (session_id, seq), and RETRIES reuse the seq —
+the KV apply layer (serve/kv.py GroupStore.last_seq) collapses duplicates
+so at-least-once delivery from the client becomes exactly-once apply.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+
+def place(tenant: str, n_groups: int) -> int:
+    """Static hash placement: tenant -> raft group."""
+    return zlib.crc32(tenant.encode()) % n_groups
+
+
+class Session:
+    __slots__ = ("id", "tenant", "group", "_next_seq", "open")
+
+    def __init__(self, sid: int, tenant: str, group: int):
+        self.id = sid
+        self.tenant = tenant
+        self.group = group
+        self._next_seq = 1
+        self.open = True
+
+    def next_seq(self) -> int:
+        s = self._next_seq
+        self._next_seq += 1
+        return s
+
+
+class SessionManager:
+    """Open/close/look-up sessions; the serving loop reads
+    `active` into the sessions_active gauge every round."""
+
+    def __init__(self, n_groups: int):
+        self.n_groups = n_groups
+        self._next_id = 1
+        self.sessions: dict[int, Session] = {}
+
+    def open(self, tenant: str) -> Session:
+        s = Session(self._next_id, tenant, place(tenant, self.n_groups))
+        self._next_id += 1
+        self.sessions[s.id] = s
+        return s
+
+    def close(self, session: Session) -> None:
+        session.open = False
+        self.sessions.pop(session.id, None)
+
+    def get(self, sid: int) -> Session | None:
+        return self.sessions.get(sid)
+
+    @property
+    def active(self) -> int:
+        return len(self.sessions)

@@ -46,12 +46,16 @@ smokes() {
   # streams must be digest-identical while the mask path scans strictly
   # fewer lanes) + the chaos recovery-SLO smoke (two same-seed soaks must
   # be bit-identical; RAFT_TPU_CHAOS / CHAOS_SEED / CHAOS_BUDGET inherit
-  # through run_bench like RAFT_TPU_COMPILE_CACHE)
+  # through run_bench like RAFT_TPU_COMPILE_CACHE) + the serving-frontend
+  # smoke (closed-loop p50/p99 + open-loop saturation: exactly-once
+  # notify, digest == admission-ordered scalar twin, typed rejections
+  # under overload with no deadlock)
   run_bench benches/metrics_smoke.py \
     && run_bench benches/dispatch_ab.py \
     && run_bench benches/egress_ab.py \
     && run_bench benches/pallas_ab.py --smoke \
-    && run_bench benches/chaos_soak.py --smoke
+    && run_bench benches/chaos_soak.py --smoke \
+    && run_bench benches/serve_bench.py --smoke
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
@@ -92,6 +96,9 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
       tests/test_snapshot.py tests/test_status.py tests/test_transfer.py \
       tests/test_unstable.py tests/test_util_ports.py tests/test_vote_states.py \
       tests/test_wal.py
+    # the serving frontend gets its own process: its module-scoped
+    # ServeLoop fixtures compile fused programs for two cluster shapes
+    run_chunk tests/test_serve.py
     # the pallas interpret-mode engine smoke gets its own process: each of
     # its kernel variants is one large interpreted scan program, and the
     # CI-asserted bit-identity (pallas vs XLA trajectories) lives here

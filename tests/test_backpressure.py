@@ -2,11 +2,24 @@
 silent loss (reference: raft.go:30 ErrProposalDropped, node.go:469;
 raft.go:1244-1302 stepLeader, 1671-1680 stepFollower, 2033-2047
 uncommitted-size gate; the device log window is this engine's additional
-static bound)."""
+static bound).
+
+Every drop is TYPED: ErrProposalDropped.reason carries the classified
+cause (api/rawnode.py DROP_*), the taxonomy the serving frontend's
+admission layer extends one level up (raft_tpu/serve/admission.py
+Rejected(reason) — backpressure as routable data, the audit this module
+pins)."""
 
 import pytest
 
-from raft_tpu.api.rawnode import ErrProposalDropped
+from raft_tpu.api.rawnode import (
+    DROP_CANDIDATE,
+    DROP_FORWARDING_DISABLED,
+    DROP_NO_LEADER,
+    DROP_TRANSFERRING,
+    DROP_WINDOW_FULL,
+    ErrProposalDropped,
+)
 from raft_tpu.types import MessageType as MT
 
 from tests.test_rawnode import drive, make_group
@@ -23,15 +36,17 @@ def test_window_exhaustion_no_silent_loss():
 
     # replication disabled: entries pile into the leader's window
     accepted = 0
-    dropped = 0
+    drop_reasons = []
     for i in range(2 * w):
         try:
             b.propose(0, b"p%d" % i)
             accepted += 1
-        except ErrProposalDropped:
-            dropped += 1
+        except ErrProposalDropped as e:
+            drop_reasons.append(e.reason)
         b._msgs[0] = []
-    assert dropped > 0, "window exhaustion must surface, not drop silently"
+    assert drop_reasons, "window exhaustion must surface, not drop silently"
+    # every drop on this path is classified as the device window bound
+    assert set(drop_reasons) == {DROP_WINDOW_FULL}
     # every accepted proposal is really in the log (no silent loss)
     assert int(b.view.last[0]) == 1 + accepted  # 1 = election empty entry
     assert int(b.view.last[0]) - int(b.view.snap_index[0]) <= w
@@ -54,8 +69,9 @@ def test_window_exhaustion_no_silent_loss():
 def test_follower_without_leader_drops():
     """reference: raft.go:1671-1675 — no leader known, proposal dropped."""
     b = make_group(3)
-    with pytest.raises(ErrProposalDropped):
+    with pytest.raises(ErrProposalDropped) as ei:
         b.propose(1, b"x")
+    assert ei.value.reason == DROP_NO_LEADER
 
 
 def test_candidate_drops():
@@ -63,8 +79,9 @@ def test_candidate_drops():
     b = make_group(3)
     b.campaign(0)  # candidate until responses are delivered
     assert b.basic_status(0)["raft_state"] == "CANDIDATE"
-    with pytest.raises(ErrProposalDropped):
+    with pytest.raises(ErrProposalDropped) as ei:
         b.propose(0, b"x")
+    assert ei.value.reason == DROP_CANDIDATE
 
 
 def test_follower_forwarding_accepted():
@@ -82,8 +99,9 @@ def test_disable_proposal_forwarding_drops():
     b = make_group(3, disable_proposal_forwarding=True)
     b.campaign(0)
     drive(b)
-    with pytest.raises(ErrProposalDropped):
+    with pytest.raises(ErrProposalDropped) as ei:
         b.propose(2, b"x")
+    assert ei.value.reason == DROP_FORWARDING_DISABLED
 
 
 def test_transferring_leader_drops():
@@ -95,5 +113,6 @@ def test_transferring_leader_drops():
     # start a transfer but do not deliver the TimeoutNow
     b.transfer_leadership(0, 2)
     assert int(b.view.lead_transferee[0]) == 2
-    with pytest.raises(ErrProposalDropped):
+    with pytest.raises(ErrProposalDropped) as ei:
         b.propose(0, b"x")
+    assert ei.value.reason == DROP_TRANSFERRING
