@@ -1,11 +1,17 @@
-"""Aggregate a jax.profiler xplane capture into per-HLO-category device time.
+"""Aggregate a profile capture into per-category time.
 
-Usage: python -m benches.profile_analyze [xplane.pb path | profile dir]
+Usage: python -m benches.profile_analyze [xplane.pb | profile dir | trace.json]
 
-Walks the device plane's "XLA Ops" line and groups event durations by the
-op's hlo_category stat (falling back to a name prefix), printing a table of
-total device-time share — the tool that found round 4's 73%-retile
-bottleneck, now committed so every round can re-measure what binds.
+Two input flavors:
+  - a jax.profiler xplane capture (.pb path / capture dir): walks the
+    device plane's "XLA Ops" line and groups event durations by the op's
+    hlo_category stat (falling back to a name prefix), printing a table of
+    total device-time share — the tool that found round 4's 73%-retile
+    bottleneck, now committed so every round can re-measure what binds;
+  - a Chrome/Perfetto trace JSON (path ends in .json — the output of
+    `python -m raft_tpu.trace.assemble`): aggregates "X" slices by name
+    per process track and counts "i" instants (the flight recorder's lane
+    events) by kind name.
 
 Requires PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python when the installed
 protobuf runtime rejects TF's generated descriptors (set automatically
@@ -20,6 +26,42 @@ import os
 import sys
 
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def analyze_json(path: str, top: int = 25):
+    """Aggregate an assembled Perfetto/Chrome trace (trace/assemble.py):
+    per-process "X" slice time by name, plus instant-event counts."""
+    import json
+
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    pnames = {
+        e["pid"]: e["args"]["name"]
+        for e in evs
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    slices = collections.defaultdict(collections.Counter)
+    counts = collections.defaultdict(collections.Counter)
+    instants = collections.Counter()
+    for e in evs:
+        if e.get("ph") == "X":
+            slices[e.get("pid", 0)][e["name"]] += e.get("dur", 0)
+            counts[e.get("pid", 0)][e["name"]] += 1
+        elif e.get("ph") == "i":
+            instants[e["name"]] += 1
+    for pid in sorted(slices):
+        total = sum(slices[pid].values()) or 1
+        print(f"\n-- {pnames.get(pid, f'pid {pid}')} (X slices, us) --")
+        for name, us in slices[pid].most_common(top):
+            print(
+                f"{us/1e3:9.2f} ms  {100*us/total:5.1f}%  "
+                f"x{counts[pid][name]:<6d} {name}"
+            )
+    if instants:
+        print("\n-- instant events (flight recorder) --")
+        for name, n in instants.most_common(top):
+            print(f"{n:9d}  {name}")
 
 
 def find_xplane(path: str) -> str:
@@ -94,4 +136,8 @@ def analyze(path: str, top: int = 25):
 
 
 if __name__ == "__main__":
-    analyze(sys.argv[1] if len(sys.argv) > 1 else "/tmp/raft_prof")
+    _path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/raft_prof"
+    if _path.endswith(".json"):
+        analyze_json(_path)
+    else:
+        analyze(_path)

@@ -1,0 +1,185 @@
+"""Trace A/B smoke: the flight recorder must observe without disturbing.
+
+Runs the SAME fused workload twice in fresh subprocesses —
+RAFT_TPU_TRACELOG=0 (the default: plane fully elided) then =1 (device
+rings + TraceStream drain) — and asserts the trace-plane acceptance bar:
+
+  1. BIT-IDENTICAL trajectories: a sha256 over every dispatched chunk's
+     full Ready-visible state columns (state/term/committed/last, plus
+     the vote column) matches across the two runs — recording is an
+     observer, never a behavior change;
+  2. zero cost when off: the =0 run traces ZERO recorder call sites
+     (trace/device.py kernel_calls() == 0) and drains zero events;
+  3. the recorded events are RIGHT: the =1 child re-derives the expected
+     leader/term/vote transition stream from a scalar state_columns poll
+     of a same-seed twin cluster stepped round-by-round, and the drained
+     ring events (those kinds) must equal it exactly, with exact drop
+     accounting (events_total == kept + dropped);
+  4. on TPU only: traced wall time <= AB_TRACE_TOL x untraced (default
+     1.05 — the <=5% overhead gate; CPU wall clocks in the 1-core
+     container are too noisy to gate on and are reported only).
+
+Exit code 0 = pass, 1 = regression. Prints one JSON summary line.
+Env: AB_TRACE_GROUPS, AB_TRACE_ROUNDS, AB_TRACE_TOL, AB_TRACE_RING.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_COLS = ("state", "term", "vote", "committed", "last")
+
+
+def child():
+    import time
+
+    import numpy as np
+
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.runtime.trace import TraceStream
+    from raft_tpu.trace import device as trdev
+
+    groups = int(os.environ.get("AB_TRACE_GROUPS", 8))
+    rounds = int(os.environ.get("AB_TRACE_ROUNDS", 96))
+    seed = 11
+    chunk = 8
+
+    on = trdev.tracelog_enabled()
+    fc = FusedCluster(groups, 3, seed=seed)
+    ts = TraceStream()
+    digest = hashlib.sha256()
+
+    # warm the compile outside the timed loop (both sides pay it equally,
+    # but the 1-core CPU compile dwarfs the dispatch signal)
+    fc.run(chunk, trace=ts)
+    t0 = time.perf_counter()
+    for _ in range(rounds // chunk - 1):
+        fc.run(chunk, trace=ts)
+    wall = time.perf_counter() - t0
+    ts.flush()
+    cols = fc.state_columns(*_COLS)
+    for name in _COLS:
+        digest.update(name.encode())
+        digest.update(np.ascontiguousarray(cols[name]).tobytes())
+
+    twin_ok = None
+    if on:
+        # scalar twin: same seed, stepped 1 round at a time, transitions
+        # derived from host-side column diffs — the events the recorder
+        # MUST have seen (election-family kinds; stall/chaos/snapshot
+        # paths have their own unit oracles in tests/test_trace.py)
+        tw = FusedCluster(groups, 3, seed=seed)
+        prev = tw.state_columns(*_COLS)
+        expect = []
+        for rnd in range(1, rounds + 1):
+            tw.run(1)
+            cur = tw.state_columns(*_COLS)
+            for lane in range(groups * 3):
+                l0 = int(prev["state"][lane]) == trdev._LEADER
+                l1 = int(cur["state"][lane]) == trdev._LEADER
+                if l1 and not l0:
+                    expect.append((rnd, lane, trdev.LEADER_ELECTED,
+                                   int(cur["term"][lane])))
+                if l0 and not l1:
+                    expect.append((rnd, lane, trdev.LEADERSHIP_LOST,
+                                   int(cur["term"][lane])))
+                if int(cur["term"][lane]) > int(prev["term"][lane]):
+                    expect.append((rnd, lane, trdev.TERM_BUMP,
+                                   int(cur["term"][lane])))
+                if int(cur["vote"][lane]) != int(prev["vote"][lane]) and (
+                    int(cur["vote"][lane]) > 0
+                ):
+                    expect.append((rnd, lane, trdev.VOTE_GRANTED,
+                                   int(cur["vote"][lane])))
+            prev = cur
+        got = [tuple(e) for e in ts.events.tolist()]
+        twin_ok = got == expect and ts.events_total == len(got) + ts.dropped
+
+    import jax
+
+    print(json.dumps({
+        "trace": on,
+        "backend": jax.default_backend(),
+        "digest": digest.hexdigest(),
+        "rounds": rounds,
+        "events": int(ts.events.shape[0]),
+        "dropped": int(ts.dropped),
+        "kernel_calls": trdev.kernel_calls(),
+        "twin_ok": twin_ok,
+        "wall_s": round(wall, 4),
+    }))
+
+
+def run_child(tracelog: str) -> dict:
+    env = dict(os.environ, RAFT_TPU_TRACELOG=tracelog)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    tol = float(os.environ.get("AB_TRACE_TOL", 1.05))
+    off = run_child("0")
+    on = run_child("1")
+    digest_ok = on["digest"] == off["digest"]
+    elided_ok = off["kernel_calls"] == 0 and off["events"] == 0
+    recorded_ok = on["kernel_calls"] > 0 and on["events"] > 0
+    twin_ok = bool(on["twin_ok"])
+    perf_ok = True
+    overhead = on["wall_s"] / max(off["wall_s"], 1e-9)
+    if on["backend"] == "tpu":
+        perf_ok = overhead <= tol
+    ok = digest_ok and elided_ok and recorded_ok and twin_ok and perf_ok
+    print(json.dumps({
+        "metric": "trace_ab",
+        "ok": ok,
+        "digest_equal": digest_ok,
+        "off_kernel_calls": off["kernel_calls"],
+        "on_events": on["events"],
+        "on_dropped": on["dropped"],
+        "twin_ok": twin_ok,
+        "wall_s_on": on["wall_s"],
+        "wall_s_off": off["wall_s"],
+        "overhead_ratio": round(overhead, 3),
+        "tol": tol,
+        "backend": on["backend"],
+    }))
+    if not digest_ok:
+        print(
+            "FAIL: traced run's state trajectory diverged from untraced "
+            f"({on['digest'][:16]} != {off['digest'][:16]})",
+            file=sys.stderr,
+        )
+    if not elided_ok:
+        print(
+            f"FAIL: TRACELOG=0 still traced {off['kernel_calls']} recorder "
+            f"sites / drained {off['events']} events", file=sys.stderr,
+        )
+    if not recorded_ok:
+        print("FAIL: TRACELOG=1 recorded nothing", file=sys.stderr)
+    if not twin_ok:
+        print(
+            "FAIL: drained events != scalar-twin transition stream",
+            file=sys.stderr,
+        )
+    if not perf_ok:
+        print(
+            f"FAIL: trace overhead {overhead:.3f}x exceeds {tol}x",
+            file=sys.stderr,
+        )
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -26,6 +26,7 @@ from raft_tpu.types import (
     LOCAL_MSGS,
     MessageType as MT,
 )
+from raft_tpu.utils.profiling import StepStats
 
 
 class ErrStopped(Exception):
@@ -86,6 +87,10 @@ class NodeHost:
 
     def __init__(self, batch: RawNodeBatch):
         self.batch = batch
+        # per-op-kind wall timings on the loop thread (the reference's
+        # callStats analog): step_<kind>_count / step_<kind>_micros via
+        # stats.snapshot(), registerable with metrics.host.MetricsRegistry
+        self.stats = StepStats()
         self._ops: queue.Queue[_Op] = queue.Queue()
         self._ready_q: list[queue.Queue[Ready]] = [
             queue.Queue(maxsize=1) for _ in range(batch.shape.n)
@@ -116,12 +121,15 @@ class NodeHost:
             # surface Readys for lanes that want them (readyc select arm);
             # ready_lanes is the batched egress mask — ONE device dispatch
             # for all lanes instead of a scalar has_ready poll per lane
-            for lane in self.batch.ready_lanes():
+            with self.stats.timed("ready_poll"):
+                lanes = self.batch.ready_lanes()
+            for lane in lanes:
                 if self._advance_pending[lane]:
                     continue
                 if not self._ready_q[lane].empty():
                     continue
-                rd = self.batch.ready(lane)
+                with self.stats.timed("ready_build"):
+                    rd = self.batch.ready(lane)
                 self._advance_pending[lane] = True
                 self._ready_q[lane].put(rd)
 
@@ -142,41 +150,50 @@ class NodeHost:
                 op.done.set()
             return
         try:
-            if op.kind == "tick":
-                b.tick(op.lane)
-            elif op.kind == "propose":
-                b.propose(op.lane, op.payload)
-            elif op.kind == "propose_cc":
-                data, v2 = op.payload
-                b.propose_conf_change(op.lane, data, v2=v2)
-            elif op.kind == "step":
-                b.step(op.lane, op.payload)
-            elif op.kind == "advance":
-                b.advance(op.lane)
-                self._advance_pending[op.lane] = False
-            elif op.kind == "campaign":
-                b.campaign(op.lane)
-            elif op.kind == "apply_cc":
-                op.result = b.apply_conf_change(op.lane, op.payload)
-            elif op.kind == "transfer":
-                b.transfer_leadership(op.lane, op.payload)
-            elif op.kind == "read_index":
-                b.read_index(op.lane, op.payload)
-            elif op.kind == "report_unreachable":
-                b.report_unreachable(op.lane, op.payload)
-            elif op.kind == "report_snapshot":
-                peer, ok = op.payload
-                b.report_snapshot(op.lane, peer, ok)
-            elif op.kind == "status":
-                op.result = b.status(op.lane)
-            elif op.kind == "compact":
-                idx, data = op.payload
-                b.compact(op.lane, idx, data)
+            with self.stats.timed(op.kind):
+                self._execute(op, b)
         except Exception as e:  # surface to caller when waiting
             op.error = e
         finally:
             if op.done is not None:
                 op.done.set()
+
+    def _execute(self, op: _Op, b: RawNodeBatch):
+        if op.kind == "tick":
+            b.tick(op.lane)
+        elif op.kind == "propose":
+            b.propose(op.lane, op.payload)
+        elif op.kind == "propose_cc":
+            data, v2 = op.payload
+            b.propose_conf_change(op.lane, data, v2=v2)
+        elif op.kind == "step":
+            b.step(op.lane, op.payload)
+        elif op.kind == "advance":
+            b.advance(op.lane)
+            self._advance_pending[op.lane] = False
+        elif op.kind == "campaign":
+            b.campaign(op.lane)
+        elif op.kind == "apply_cc":
+            op.result = b.apply_conf_change(op.lane, op.payload)
+        elif op.kind == "transfer":
+            b.transfer_leadership(op.lane, op.payload)
+        elif op.kind == "read_index":
+            b.read_index(op.lane, op.payload)
+        elif op.kind == "report_unreachable":
+            b.report_unreachable(op.lane, op.payload)
+        elif op.kind == "report_snapshot":
+            peer, ok = op.payload
+            b.report_snapshot(op.lane, peer, ok)
+        elif op.kind == "status":
+            op.result = b.status(op.lane)
+        elif op.kind == "compact":
+            idx, data = op.payload
+            b.compact(op.lane, idx, data)
+
+    def metrics_snapshot(self) -> dict:
+        """Loop-thread op timings in the snapshot schema (no histogram) —
+        register with a MetricsRegistry next to the engine/serve planes."""
+        return self.stats.snapshot()
 
     def _submit(
         self, kind, lane, payload=None, wait=False, timeout=None, cancel=None

@@ -63,3 +63,29 @@ def set_logger(l: Logger) -> None:
 
 def get_logger() -> Logger:
     return _logger
+
+
+# -- rate-limited warnings ---------------------------------------------------
+
+_last_warn: dict[str, float] = {}
+
+
+def warn_rate_limited(key: str, interval_s: float, msg: str, *args) -> None:
+    """Emit `msg` through the current logger's warning(), at most once per
+    `interval_s` seconds per `key`. For hot-path conditions that would spam
+    per event (bridge pump/drain truncation fires once per truncated sweep)
+    but must not stay counter-only invisible. Keys are process-global;
+    interval 0 logs every call."""
+    import time as _time
+
+    now = _time.monotonic()
+    last = _last_warn.get(key)
+    if last is not None and now - last < interval_s:
+        return
+    _last_warn[key] = now
+    _logger.warning(msg, *args)
+
+
+def reset_warn_rate_limits() -> None:
+    """Test hook: forget every key's last-warn stamp."""
+    _last_warn.clear()

@@ -11,8 +11,20 @@ Snapshot schema (every producer in the tree speaks it):
         "sum": int,                             # sum of observed latencies
         "count": int,                           # == sum(buckets)
       },
+      "hist_name": str,                         # optional: the family name
+                                                #   of "hist" (defaults to
+                                                #   commit_latency_rounds)
+      "hists": {name: hist, ...},               # optional: named families
       "rounds": int,                            # device rounds stepped
     }
+
+Histograms are namespaced BY NAME when merged: merge_snapshots collects
+each source's histogram under its family name ("hists" entries plus the
+legacy "hist" keyed by "hist_name"), summing only same-named families and
+raising only when one NAME carries conflicting edges. The serve registry
+(notify_latency_rounds) and the engine plane (commit_latency_rounds) can
+therefore merge into one scrape without silent bucket collisions — the
+hazard serve/http.py used to work around by keeping sources separate.
 
 Exporters: `prometheus_text` renders the standard exposition format
 (counter `_total` families + one cumulative-bucket histogram), and
@@ -147,6 +159,17 @@ SERVE_COUNTERS = (
     "notify_violations",
 )
 
+# trace-plane counter families (host plane — counted by runtime/trace.py
+# TraceStream as it resolves ring copies):
+#   trace_events           flight-recorder events drained from device rings
+#   trace_events_dropped   ring-overflow drops (oldest-first; exact, from
+#                          the monotone device write cursor vs the host
+#                          read cursor — trace/device.py module doc)
+TRACE_COUNTERS = (
+    "trace_events",
+    "trace_events_dropped",
+)
+
 
 class HostCounters:
     """Plain host-side counter bag speaking the snapshot schema — the
@@ -177,9 +200,9 @@ class HostHistogram:
     """Host-side le-bucket histogram speaking the snapshot "hist" schema —
     the serving plane's notify-latency (propose -> commit -> notify, in
     device rounds) uses the device plane's round edges so host and device
-    latency panels share an x-axis. NOT merged into a device snapshot:
-    merge_snapshots sums hists blindly, so serve snapshots live in their
-    own registry/prefix (serve/http.py renders both)."""
+    latency panels share an x-axis. Safe to merge with device snapshots
+    as long as the producer stamps a distinct "hist_name" (serve/loop.py
+    does): merge_snapshots namespaces families by name."""
 
     def __init__(self, edges=HIST_EDGES):
         self.edges = tuple(edges)
@@ -204,24 +227,60 @@ class HostHistogram:
         }
 
 
-def merge_snapshots(snaps) -> dict:
-    """Sum snapshots from several sources (blocks, hosts) into one."""
+DEFAULT_HIST_NAME = "commit_latency_rounds"
+
+
+def merge_snapshots(snaps, default_hist_name: str = DEFAULT_HIST_NAME) -> dict:
+    """Sum snapshots from several sources (blocks, hosts) into one.
+
+    Histograms merge BY FAMILY NAME: a source's "hists" entries plus its
+    legacy "hist" (keyed by its "hist_name", default_hist_name when
+    absent). Same-named families sum bucketwise and must agree on edges
+    (ValueError otherwise); differently-named families coexist in the
+    output's "hists". The merged "hist"/"hist_name" keys keep the legacy
+    single-histogram view when exactly one family (or the default-named
+    one) is present, so pre-namespacing consumers read what they always
+    did."""
     out = empty_snapshot()
+    hists: dict[str, dict] = {}
     for s in snaps:
         if s is None:
             continue
         for name, v in s.get("counters", {}).items():
             out["counters"][name] = out["counters"].get(name, 0) + int(v)
+        named = dict(s.get("hists") or {})
         h = s.get("hist")
         if h and h.get("buckets"):
-            if list(h["edges"]) != out["hist"]["edges"]:
-                raise ValueError("cannot merge histograms with different edges")
-            out["hist"]["buckets"] = [
-                a + int(b) for a, b in zip(out["hist"]["buckets"], h["buckets"])
-            ]
-            out["hist"]["sum"] += int(h.get("sum", 0))
-            out["hist"]["count"] += int(h.get("count", 0))
+            named.setdefault(str(s.get("hist_name", default_hist_name)), h)
+        for hname, h in named.items():
+            cur = hists.get(hname)
+            if cur is None:
+                hists[hname] = {
+                    "edges": list(h["edges"]),
+                    "buckets": [int(b) for b in h["buckets"]],
+                    "sum": int(h.get("sum", 0)),
+                    "count": int(h.get("count", 0)),
+                }
+            else:
+                if list(h["edges"]) != cur["edges"]:
+                    raise ValueError(
+                        f"cannot merge histograms named {hname!r} "
+                        "with different edges"
+                    )
+                cur["buckets"] = [
+                    a + int(b) for a, b in zip(cur["buckets"], h["buckets"])
+                ]
+                cur["sum"] += int(h.get("sum", 0))
+                cur["count"] += int(h.get("count", 0))
         out["rounds"] = max(out["rounds"], int(s.get("rounds", 0)))
+    if hists:
+        out["hists"] = hists
+        if len(hists) == 1:
+            ((only_name, only_hist),) = hists.items()
+            out["hist"] = dict(only_hist)
+            out["hist_name"] = only_name
+        elif default_hist_name in hists:
+            out["hist"] = dict(hists[default_hist_name])
     return out
 
 
@@ -266,31 +325,43 @@ class MetricsRegistry:
         return out
 
 
+def _render_hist(lines: list, prefix: str, hist_name: str, h: dict) -> None:
+    fam = f"{prefix}_{hist_name}"
+    lines.append(f"# TYPE {fam} histogram")
+    cum = 0
+    for edge, count in zip(h["edges"], h["buckets"]):
+        cum += int(count)
+        lines.append(f'{fam}_bucket{{le="{edge}"}} {cum}')
+    cum += int(h["buckets"][-1])
+    lines.append(f'{fam}_bucket{{le="+Inf"}} {cum}')
+    lines.append(f"{fam}_sum {int(h['sum'])}")
+    lines.append(f"{fam}_count {int(h['count'])}")
+
+
 def prometheus_text(
     snap: dict,
     prefix: str = "raft_tpu",
-    hist_name: str = "commit_latency_rounds",
+    hist_name: str = DEFAULT_HIST_NAME,
 ) -> str:
     """Render a snapshot in the Prometheus text exposition format.
-    hist_name labels the snapshot's single histogram family — the engine
-    plane's is commit latency, the serving plane's is notify latency."""
+
+    A snapshot with named families ("hists", the merge_snapshots output)
+    renders every family under its own name; a legacy single-"hist"
+    snapshot renders under hist_name (the engine plane's commit latency,
+    the serving plane's notify latency)."""
     lines = []
     for name, v in sorted(snap["counters"].items()):
         fam = f"{prefix}_{name}_total"
         lines.append(f"# TYPE {fam} counter")
         lines.append(f"{fam} {int(v)}")
-    h = snap.get("hist")
-    if h is not None:
-        fam = f"{prefix}_{hist_name}"
-        lines.append(f"# TYPE {fam} histogram")
-        cum = 0
-        for edge, count in zip(h["edges"], h["buckets"]):
-            cum += int(count)
-            lines.append(f'{fam}_bucket{{le="{edge}"}} {cum}')
-        cum += int(h["buckets"][-1])
-        lines.append(f'{fam}_bucket{{le="+Inf"}} {cum}')
-        lines.append(f"{fam}_sum {int(h['sum'])}")
-        lines.append(f"{fam}_count {int(h['count'])}")
+    hs = snap.get("hists")
+    if hs:
+        for hname in sorted(hs):
+            _render_hist(lines, prefix, hname, hs[hname])
+    else:
+        h = snap.get("hist")
+        if h is not None:
+            _render_hist(lines, prefix, snap.get("hist_name", hist_name), h)
     return "\n".join(lines) + "\n"
 
 

@@ -65,6 +65,7 @@ import jax.numpy as jnp
 
 from raft_tpu.chaos import device as chmod
 from raft_tpu.metrics import device as metmod
+from raft_tpu.trace import device as trmod
 from raft_tpu.ops import log as lg
 from raft_tpu.ops import onehot as ohm
 from raft_tpu.ops import progress as pg
@@ -1551,6 +1552,8 @@ def fused_rounds(
     straddle: StraddleSpec | None = None,
     metrics: "metmod.MetricsState | None" = None,
     chaos: "chmod.ChaosState | None" = None,
+    trace: "trmod.TraceState | None" = None,
+    trace_lane_offset=None,
 ):
     """n_rounds fused rounds in one dispatch. `ops` applies to the first
     round only (one-shot injections) unless ops_first_round_only=False.
@@ -1572,7 +1575,13 @@ def fused_rounds(
     around every round (drops/partitions/crashes before the step,
     duplicates + recovery probing after) and the carry is appended to the
     return tuple. None keeps every fault op out of the trace, like
-    metrics=None. Requires group-aligned lanes (no straddle)."""
+    metrics=None. Requires group-aligned lanes (no straddle).
+
+    trace: optional flight-recorder carry (raft_tpu/trace/); when set each
+    round's per-lane transitions are detected from the (pre, post) fat
+    state diff and ring-appended (trace/device.py record_round), and the
+    carry is appended to the return tuple. trace_lane_offset (a traced
+    scalar, sharded dispatch) globalizes the event lane stamps."""
     from raft_tpu.state import fat_state, slim_state
 
     if chaos is not None and straddle is not None:
@@ -1593,7 +1602,7 @@ def fused_rounds(
             peer_mute = aligned_peer_mute(mute, v)
 
     def body(carry, i):
-        st, f, met, ch = carry
+        st, f, met, ch, tr = carry
         o = ops
         if ops_first_round_only:
             first = i == 0
@@ -1605,6 +1614,11 @@ def fused_rounds(
             )
         st_fat = fat_state(st)
         f_fat = fat_fabric(f)
+        # flight recorder: the pre-round state is captured BEFORE chaos
+        # begin_round, so a crash wipe diffs like any leadership loss (and
+        # the pre-round chaos carry marks the fault edge itself)
+        st_pre = st_fat if tr is not None else None
+        ch_pre = ch
         if straddle is None:
             inb = route_fabric(f_fat, v, mute, peer_mute=peer_mute)
         else:
@@ -1633,13 +1647,17 @@ def fused_rounds(
             # post-step faults: duplicate redelivery (re-injects last
             # round's outbox cells), recovery probing, round advance
             ch, f2 = chmod.end_round(ch, st, f_fat, f2, v)
-        return (slim_state(st), slim_fabric(f2), met, ch), None
+        if tr is not None:
+            tr = trmod.record_round(
+                tr, st_pre, st, chaos=ch_pre, lane_offset=trace_lane_offset
+            )
+        return (slim_state(st), slim_fabric(f2), met, ch, tr), None
 
-    # a None metrics/chaos slot is an empty pytree: the scan carry shape
-    # is unchanged when a plane is off
-    (state, fab, metrics, chaos), _ = jax.lax.scan(
+    # a None metrics/chaos/trace slot is an empty pytree: the scan carry
+    # shape is unchanged when a plane is off
+    (state, fab, metrics, chaos, trace), _ = jax.lax.scan(
         body,
-        (state, fab, metrics, chaos),
+        (state, fab, metrics, chaos, trace),
         jnp.arange(n_rounds, dtype=I32),
         unroll=min(_SCAN_UNROLL, n_rounds),
     )
@@ -1648,6 +1666,8 @@ def fused_rounds(
         res += (metrics,)
     if chaos is not None:
         res += (chaos,)
+    if trace is not None:
+        res += (trace,)
     return res
 
 
@@ -1671,7 +1691,7 @@ _fused_rounds_jit = jax.jit(
     fused_rounds,
     static_argnames=_FUSED_STATIC,
     donate_argnums=(0, 1),
-    donate_argnames=("metrics", "chaos"),
+    donate_argnames=("metrics", "chaos", "trace"),
 )
 
 # copying twin: inputs survive the dispatch (stale host references stay
@@ -1748,6 +1768,7 @@ class FusedCluster:
         # before the next dispatch invalidates those buffers
         self._wal_pending = None
         self._egress_pending = None
+        self._trace_pending = None
         # metrics plane (raft_tpu/metrics/): RAFT_TPU_METRICS is read at
         # construction; metrics=None keeps every metrics op out of the jaxpr
         self.metrics = metmod.init_metrics(n) if metmod.metrics_enabled() else None
@@ -1766,6 +1787,10 @@ class FusedCluster:
             if chmod.chaos_enabled()
             else None
         )
+        # trace plane (raft_tpu/trace/): RAFT_TPU_TRACELOG is read at
+        # construction (default OFF); trace=None keeps the whole flight
+        # recorder out of the jaxpr — asserted by tests/test_trace.py
+        self.trace = trmod.init_trace(n) if trmod.tracelog_enabled() else None
 
     # -- driving ----------------------------------------------------------
 
@@ -1779,6 +1804,7 @@ class FusedCluster:
         ops_first_round_only: bool = True,
         wal=None,
         egress=None,
+        trace=None,
     ):
         """wal: an optional runtime.wal.WalStream — after this block's
         dispatch its delta starts streaming to the host asynchronously
@@ -1788,11 +1814,16 @@ class FusedCluster:
         egress: an optional runtime.egress.EgressStream — the serving-plane
         twin: the batched ready/delta bundle (ops/ready_mask.py) for this
         block rides D2H while the next block computes, handing the consumer
-        a dense active-lane vector one block behind the live state."""
+        a dense active-lane vector one block behind the live state.
+
+        trace: an optional runtime.trace.TraceStream — the flight-recorder
+        ring's D2H drain rides the same double-buffer discipline; a no-op
+        while RAFT_TPU_TRACELOG=0 (self.trace is None)."""
         if ops is None:
             ops = self._no_ops
         self._flush_pending_wal()
         self._flush_pending_egress()
+        self._flush_pending_trace()
         res = None
         if self.engine == "pallas":
             res = self._run_pallas(
@@ -1823,6 +1854,7 @@ class FusedCluster:
                     ops_first_round_only=ops_first_round_only,
                     metrics=self.metrics,
                     chaos=self.chaos,
+                    trace=self.trace,
                 )
         else:
             res = _fused_rounds_nodonate_jit(
@@ -1838,6 +1870,7 @@ class FusedCluster:
                 ops_first_round_only=ops_first_round_only,
                 metrics=self.metrics,
                 chaos=self.chaos,
+                trace=self.trace,
             )
         self.state, self.fab = res[0], res[1]
         i = 2
@@ -1846,6 +1879,9 @@ class FusedCluster:
             i += 1
         if self.chaos is not None:
             self.chaos = res[i]
+            i += 1
+        if self.trace is not None:
+            self.trace = res[i]
         if wal is not None:
             wal.push(self.state)
             if self._donate:
@@ -1854,6 +1890,10 @@ class FusedCluster:
             egress.push(self.state)
             if self._donate:
                 self._egress_pending = egress
+        if trace is not None:
+            trace.push(self.trace)
+            if self._donate:
+                self._trace_pending = trace
 
     def _flush_pending_wal(self):
         """Resolve a WAL delta that still references this cluster's current
@@ -1871,6 +1911,14 @@ class FusedCluster:
         if self._egress_pending is not None:
             self._egress_pending.flush()
             self._egress_pending = None
+
+    def _flush_pending_trace(self):
+        """Same fence for the flight-recorder ring: the TraceStream's
+        in-flight copy references the (donatable) trace carry's buffers, so
+        it resolves before the next donating dispatch invalidates them."""
+        if self._trace_pending is not None:
+            self._trace_pending.flush()
+            self._trace_pending = None
 
     # -- pallas engine (ops/pallas_round.py) ------------------------------
 
@@ -1907,6 +1955,7 @@ class FusedCluster:
             interpret=self._pallas_interpret,
             metrics=self.metrics,
             chaos=self.chaos,
+            trace=self.trace,
         )
         try:
             plr.maybe_force_fail()
@@ -2067,6 +2116,7 @@ class FusedCluster:
         dj = jnp.asarray(deltas)
         self._flush_pending_wal()
         self._flush_pending_egress()
+        self._flush_pending_trace()
         if self._donate:
             with _no_persistent_cache():
                 self.state = slim_state(
@@ -2090,6 +2140,10 @@ class FusedCluster:
             # the recovery baseline holds absolute committed values — it
             # shifts with its lanes like the latency samples above
             self.chaos = chmod.rebase(self.chaos, jnp.asarray(mask), dj)
+        if self.trace is not None:
+            # recorded events whose arg column carries a log index shift
+            # with their lanes so explain() output stays in the live space
+            self.trace = trmod.rebase(self.trace, jnp.asarray(mask), dj)
         return out
 
     @classmethod

@@ -72,6 +72,7 @@ from raft_tpu.chaos import device as chmod
 from raft_tpu.metrics import device as metmod
 from raft_tpu.ops import fused as fmod
 from raft_tpu.state import fat_state, slim_state
+from raft_tpu.trace import device as trmod
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -244,10 +245,18 @@ def pallas_rounds(
     interpret: bool = False,
     metrics=None,
     chaos=None,
+    trace=None,
+    trace_lane_offset=None,
 ):
     """n_rounds fused rounds, each as ONE pallas_call over group-aligned
     lane tiles. Same contract and bit-identical trajectories as
-    ops/fused.py fused_rounds (minus straddle support) — see module doc."""
+    ops/fused.py fused_rounds (minus straddle support) — see module doc.
+
+    trace: the flight-recorder carry rides the scan OUTSIDE the kernel —
+    transition detection diffs the (pre, post) fat states the kernel
+    already exchanges with the scan body (trace/device.py record_round),
+    so the kernel itself is unchanged (no VMEM growth) and the event
+    stream is bit-identical to the XLA engine's by construction."""
     maybe_force_fail()
     state = slim_state(state)
     fab = fmod.slim_fabric(fab)
@@ -411,7 +420,13 @@ def pallas_rounds(
 
     # -- scan over rounds ---------------------------------------------------
     def body(carry, i):
-        fs, ff, met, ch = carry
+        fs, ff, met, ch, tr = carry
+        # pre-round captures for the flight recorder: the carry state
+        # before the kernel, the chaos carry before its round advance
+        st_pre = (
+            fat_state(jax.tree.unflatten(tree_s, fs)) if tr is not None else None
+        )
+        ch_pre = ch
         o_leaves = flat_o
         if ops_first_round_only:
             first = i == 0
@@ -474,11 +489,16 @@ def pallas_rounds(
                     n_recommitted=parts[ch_off + 1],
                     round=ch.round + 1,
                 )
-        return (new_fs, new_ff, met, ch), None
+        if tr is not None:
+            st_post = fat_state(jax.tree.unflatten(tree_s, new_fs))
+            tr = trmod.record_round(
+                tr, st_pre, st_post, chaos=ch_pre, lane_offset=trace_lane_offset
+            )
+        return (new_fs, new_ff, met, ch, tr), None
 
-    (flat_s, flat_f, metrics, chaos), _ = jax.lax.scan(
+    (flat_s, flat_f, metrics, chaos, trace), _ = jax.lax.scan(
         body,
-        (flat_s, flat_f, metrics, chaos),
+        (flat_s, flat_f, metrics, chaos, trace),
         jnp.arange(n_rounds, dtype=I32),
     )
     res = (
@@ -489,6 +509,8 @@ def pallas_rounds(
         res += (metrics,)
     if chaos is not None:
         res += (chaos,)
+    if trace is not None:
+        res += (trace,)
     return res
 
 
@@ -510,7 +532,7 @@ _pallas_rounds_jit = jax.jit(
     pallas_rounds,
     static_argnames=_PALLAS_STATIC,
     donate_argnums=(0, 1),
-    donate_argnames=("metrics", "chaos"),
+    donate_argnames=("metrics", "chaos", "trace"),
 )
 _pallas_rounds_nodonate_jit = jax.jit(
     pallas_rounds, static_argnames=_PALLAS_STATIC

@@ -409,6 +409,34 @@ class ShardedFusedCluster:
             # fault mask columns shard with their lanes; the seed/round/
             # heal scalars and recovery tallies replicate
             self.inner.chaos = jax.tree.map(shard_lanes, self.inner.chaos)
+        if self.inner.trace is not None:
+            # per-shard event rings: the monolithic [R] ring becomes a
+            # stacked [S, R] column sharded over "groups" (each shard
+            # appends to its own window with globally stamped lanes); the
+            # per-lane stall column shards with its lanes, the round clock
+            # replicates. Distinct zeros per leaf — donated carries must
+            # never alias buffers.
+            from raft_tpu.trace import device as trdev
+
+            tr = self.inner.trace
+            s_, r_ = self.n_shards, tr.ring_round.shape[0]
+            repl = NamedSharding(self.mesh, P())
+
+            def ring_col():
+                return jax.device_put(
+                    jnp.zeros((s_, r_), I32), self.lane_sharding
+                )
+
+            self.inner.trace = trdev.TraceState(
+                ring_round=ring_col(),
+                ring_lane=ring_col(),
+                ring_kind=ring_col(),
+                ring_arg=ring_col(),
+                wr=jax.device_put(jnp.zeros((s_,), I32), self.lane_sharding),
+                round=jax.device_put(tr.round, repl),
+                stall=shard_lanes(tr.stall),
+            )
+        self._trace_pending = None
         self._no_ops = jax.tree.map(shard_lanes, no_ops(n))
         self._shard_lanes = shard_lanes
         self._cache = {}
@@ -436,9 +464,14 @@ class ShardedFusedCluster:
         return t
 
     def run(self, rounds: int = 1, ops=None, do_tick: bool = True,
-            auto_propose: bool = False, auto_compact_lag=None):
+            auto_propose: bool = False, auto_compact_lag=None, trace=None):
+        """trace: an optional runtime.trace.TraceStream — the stacked
+        per-shard rings push after the dispatch (one host drain sees every
+        shard's events, merged round-sorted by the stream); flushed before
+        the next donating dispatch like the FusedCluster fence."""
         from raft_tpu.ops.fused import fused_rounds
         from raft_tpu.ops import pallas_round as plr
+        from raft_tpu.trace.device import TraceState
 
         ops = (
             self._no_ops
@@ -447,10 +480,15 @@ class ShardedFusedCluster:
                 lambda x: self._shard_lanes(jnp.asarray(x)), ops
             )
         )
+        if self._trace_pending is not None:
+            self._trace_pending.flush()
+            self._trace_pending = None
         met = self.inner.metrics
         ch = self.inner.chaos
+        tr = self.inner.trace
         has_met, has_ch = met is not None, ch is not None
-        extras = [x for x in (met, ch) if x is not None]
+        has_tr = tr is not None
+        extras = [x for x in (met, ch, tr) if x is not None]
         engine = self.inner.engine
         tile = interp = None
         if engine == "pallas":
@@ -471,6 +509,22 @@ class ShardedFusedCluster:
             def stepper(st, f, o, m, *ex):
                 mt = ex[0] if has_met else None
                 c = ex[int(has_met)] if has_ch else None
+                t = ex[int(has_met) + int(has_ch)] if has_tr else None
+                t_loc = lane_off = None
+                if has_tr:
+                    # the shard sees a [1, R] slice of the stacked ring
+                    # columns: collapse to the engines' monolithic [R] view
+                    # and record with the shard's global lane offset so
+                    # event lanes are cluster-global, not shard-local
+                    t_loc = TraceState(
+                        ring_round=t.ring_round[0], ring_lane=t.ring_lane[0],
+                        ring_kind=t.ring_kind[0], ring_arg=t.ring_arg[0],
+                        wr=t.wr[0], round=t.round, stall=t.stall,
+                    )
+                    lane_off = (
+                        jax.lax.axis_index("groups")
+                        * jnp.int32(self.lanes_per_shard)
+                    )
                 if engine == "pallas":
                     res = plr.pallas_rounds(
                         st, f, o, m,
@@ -478,6 +532,7 @@ class ShardedFusedCluster:
                         do_tick=do_tick, auto_propose=auto_propose,
                         auto_compact_lag=auto_compact_lag,
                         interpret=interp, metrics=mt, chaos=c,
+                        trace=t_loc, trace_lane_offset=lane_off,
                     )
                 else:
                     res = fused_rounds(
@@ -486,6 +541,7 @@ class ShardedFusedCluster:
                         auto_propose=auto_propose,
                         auto_compact_lag=auto_compact_lag,
                         straddle=self._spec, metrics=mt, chaos=c,
+                        trace=t_loc, trace_lane_offset=lane_off,
                     )
                 out = [res[0], res[1]]
                 j = 2
@@ -523,6 +579,19 @@ class ShardedFusedCluster:
                         ),
                     )
                     out.append(c2)
+                    j += 1
+                if has_tr:
+                    t2 = res[j]
+                    # re-stack the shard's [R] ring back into its [1, R]
+                    # row of the stacked column (round stays replicated —
+                    # every shard steps the same count)
+                    out.append(TraceState(
+                        ring_round=t2.ring_round[None],
+                        ring_lane=t2.ring_lane[None],
+                        ring_kind=t2.ring_kind[None],
+                        ring_arg=t2.ring_arg[None],
+                        wr=t2.wr[None], round=t2.round, stall=t2.stall,
+                    ))
                 return tuple(out)
 
             in_specs = [
@@ -559,6 +628,14 @@ class ShardedFusedCluster:
                 )
                 in_specs.append(ch_specs)
                 out_specs.append(ch_specs)
+            if has_tr:
+                tr_specs = TraceState(
+                    ring_round=P("groups"), ring_lane=P("groups"),
+                    ring_kind=P("groups"), ring_arg=P("groups"),
+                    wr=P("groups"), round=P(), stall=P("groups"),
+                )
+                in_specs.append(tr_specs)
+                out_specs.append(tr_specs)
             fn = shard_map(
                 stepper,
                 mesh=self.mesh,
@@ -586,7 +663,7 @@ class ShardedFusedCluster:
             return self.run(
                 rounds, ops=ops, do_tick=do_tick,
                 auto_propose=auto_propose,
-                auto_compact_lag=auto_compact_lag,
+                auto_compact_lag=auto_compact_lag, trace=trace,
             )
         self.inner.state, self.inner.fab = res[0], res[1]
         j = 2
@@ -595,6 +672,16 @@ class ShardedFusedCluster:
             j += 1
         if has_ch:
             self.inner.chaos = res[j]
+            j += 1
+        if has_tr:
+            self.inner.trace = res[j]
+            if trace is not None:
+                trace.push(self.inner.trace)
+                if self._donate:
+                    # same fence as FusedCluster: the async host copies
+                    # must land before the next donating dispatch frees
+                    # the ring buffers
+                    self._trace_pending = trace
 
     def _fall_back(self, err):
         """Log the pallas -> XLA engine fallback once via the metrics host

@@ -26,6 +26,7 @@ election + failover.
 from __future__ import annotations
 
 from raft_tpu.api.rawnode import ErrProposalDropped, Message, RawNodeBatch
+from raft_tpu.logging import warn_rate_limited
 from raft_tpu.types import MessageType as MTY
 
 
@@ -153,6 +154,14 @@ class HostBridge:
             if not moved:
                 return PumpResult(it)
         self.pump_truncated += 1
+        warn_rate_limited(
+            "bridge_pump_truncated",
+            10.0,
+            "HostBridge.pump truncated at %s iterations with lanes still "
+            "ready (%s total truncations) — not quiescent, pump again",
+            max_iters,
+            self.pump_truncated,
+        )
         return PumpResult(max_iters, truncated=True)
 
     def tick_all(self):
@@ -631,6 +640,14 @@ class BridgeEndpoint:
                 break
         if self.truncated:
             self.batch.metrics.inc("bridge_drain_truncated")
+            warn_rate_limited(
+                "bridge_drain_truncated",
+                10.0,
+                "BridgeEndpoint.drain truncated at %s iterations with lanes "
+                "still ready (%s total truncations) — drain again",
+                max_iters,
+                self.batch.metrics.get("bridge_drain_truncated"),
+            )
         return {h: self.codec.pack_frame(ms) for h, ms in out.items()}
 
     def receive(self, frame: bytes):

@@ -1,0 +1,127 @@
+"""Double-buffered async drain of the flight-recorder ring.
+
+The trace-plane twin of runtime/egress.py: where EgressStream ships the
+readiness delta bundle D2H while the next block computes, TraceStream
+ships the TraceState ring columns (trace/device.py) and turns them back
+into host event tuples `(round, lane, kind, arg)`:
+
+  push(trace):  resolve + sink the PREVIOUS push's ring copy (its D2H
+                transfer has had a whole block of compute to ride), then
+                start the async D2H copy of the new ring.
+  flush():      resolve the in-flight tail. The engine's donation fence
+                calls it before any donating dispatch could invalidate the
+                copied buffers (fused.py _trace_pending, the same
+                discipline as _wal_pending/_egress_pending).
+
+The drain baseline is HOST-side: a plain python read cursor per shard
+(`wr` as of the last resolve), so donation can never invalidate it. From
+(wr, rd, ring depth R) the drop accounting is exact:
+
+  new     = wr - rd          events appended since the last drain
+  dropped = max(0, new - R)  oldest overwritten before we could read
+  kept    = new - dropped    live in slots [(wr-kept) .. wr-1] mod R
+
+Dropped events bump the `trace_events_dropped` counter in the metrics
+host plane (pass counters=HostCounters). Sharded rings arrive stacked
+([S, R] columns, [S] write cursors, one read cursor per shard held
+host-side); resolved events from all shards merge round-sorted, so the
+sink sees one globally ordered stream — the "gathered across shards"
+contract of the trace plane.
+
+RAFT_TPU_TRACELOG=0 disables the stream at construction: push/flush are
+no-ops (and the engine never built a TraceState to push anyway).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.trace import device as trdev
+
+# columns of every resolved event row, in order
+EVENT_COLUMNS = ("round", "lane", "kind", "arg")
+
+
+class TraceStream:
+    def __init__(self, sink=None, counters=None):
+        self.enabled = trdev.tracelog_enabled()
+        self._pending = None  # (seq, ring_round, ring_lane, ring_kind, ring_arg, wr)
+        self._rd: dict[int, int] = {}  # per-shard host read cursor
+        self.sink = sink  # sink(seq, events [M,4] i64) in push order
+        self.counters = counters  # metrics/host.py HostCounters or None
+        self.blocks = 0
+        self.events_total = 0
+        self.dropped = 0
+        self._batches: list[np.ndarray] = []
+        self._counted_dropped = 0
+
+    def push(self, trace) -> None:
+        if not self.enabled or trace is None:
+            return
+        self._resolve_pending()
+        dev = (
+            trace.ring_round,
+            trace.ring_lane,
+            trace.ring_kind,
+            trace.ring_arg,
+            trace.wr,
+        )
+        for a in dev:
+            a.copy_to_host_async()
+        self._pending = (self.blocks,) + dev
+        self.blocks += 1
+
+    def flush(self) -> None:
+        self._resolve_pending()
+
+    @property
+    def events(self) -> np.ndarray:
+        """All events resolved so far, one [M, 4] int64 array in global
+        (round-sorted, then shard/append) order; columns = EVENT_COLUMNS."""
+        if not self._batches:
+            return np.zeros((0, 4), np.int64)
+        return np.concatenate(self._batches, axis=0)
+
+    def _resolve_pending(self) -> None:
+        if self._pending is None:
+            return
+        seq, *dev = self._pending
+        self._pending = None
+        ring_round, ring_lane, ring_kind, ring_arg, wr = (
+            np.asarray(a) for a in dev
+        )
+        # normalize [R]/[] (single block) to the stacked [S, R]/[S] layout
+        rings = [np.atleast_2d(c) for c in (ring_round, ring_lane, ring_kind, ring_arg)]
+        wrs = np.atleast_1d(wr)
+        r = rings[0].shape[1]
+        parts = []
+        for s in range(wrs.shape[0]):
+            w = int(wrs[s])
+            rd = self._rd.get(s, 0)
+            new = w - rd
+            dropped = max(0, new - r)
+            kept = new - dropped
+            self._rd[s] = w
+            self.dropped += dropped
+            self.events_total += new
+            if kept <= 0:
+                continue
+            slots = np.arange(w - kept, w, dtype=np.int64) % r
+            parts.append(
+                np.stack([c[s][slots].astype(np.int64) for c in rings], axis=1)
+            )
+        if parts:
+            ev = np.concatenate(parts, axis=0)
+            if len(parts) > 1:  # merge shard streams round-sorted, stable
+                ev = ev[np.argsort(ev[:, 0], kind="stable")]
+        else:
+            ev = np.zeros((0, 4), np.int64)
+        if self.counters is not None:
+            self.counters.inc("trace_events", int(ev.shape[0]))
+            self.counters.inc(
+                "trace_events_dropped", self.dropped - self._counted_dropped
+            )
+            self._counted_dropped = self.dropped
+        self._batches.append(ev)
+        if self.sink is not None:
+            self.sink(seq, ev)

@@ -43,6 +43,7 @@ that need bounded device-queue memory (None = unbounded, pure async).
 
 from __future__ import annotations
 
+import contextlib
 from collections import deque
 
 import jax
@@ -116,6 +117,11 @@ class BlockedFusedCluster:
             )
             for i in range(self.k)
         ]
+        # optional utils/profiling.py SpanRecorder: when set, every block
+        # dispatch records a (name, t0, dur, labels) span the trace
+        # assembler folds into the Perfetto timeline (host dispatch time —
+        # JAX async dispatch means device execution rides behind it)
+        self.spans = None
 
     # -- driving ----------------------------------------------------------
 
@@ -182,7 +188,9 @@ class BlockedFusedCluster:
         while len(self._inflight) > self.pipeline_depth:
             jax.block_until_ready(self._inflight.popleft())
 
-    def run(self, rounds: int = 1, ops=None, wal=None, egress=None, **kw):
+    def run(
+        self, rounds: int = 1, ops=None, wal=None, egress=None, trace=None, **kw
+    ):
         """`rounds` fused rounds on every block, dispatched ROUND-MAJOR:
         each sweep enqueues `round_chunk` rounds of every block before
         advancing, so block b+1's round hides block b's host-side dispatch
@@ -195,24 +203,34 @@ class BlockedFusedCluster:
         egress: optional list of K runtime.egress.EgressStream, same
         per-block shape — each block's batched ready/delta bundle is
         pushed once, after its last round, and rides D2H while the next
-        block computes."""
+        block computes.
+        trace: optional list of K runtime.trace.TraceStream — each block's
+        flight-recorder ring pushed the same way (event lane stamps are
+        block-LOCAL; trace/assemble.py globalizes by block offset)."""
         if wal is not None:
             wal = self._check_wal(wal)
         if egress is not None:
             egress = self._check_streams(egress, "egress", "EgressStream")
+        if trace is not None:
+            trace = self._check_streams(trace, "trace", "TraceStream")
         per_ops = self._bind_ops(ops)
         ops_first = kw.get("ops_first_round_only", True)
+        sp = self.spans
         if self.k == 1:
             # one resident block: a single multi-round scan dispatch beats
             # any interleave (nothing to overlap with)
             b = self.blocks[0]
-            b.run(
-                rounds,
-                ops=None if per_ops is None else per_ops[0],
-                wal=None if wal is None else wal[0],
-                egress=None if egress is None else egress[0],
-                **kw,
-            )
+            with sp.span("dispatch", block=0, rounds=rounds) if sp else (
+                contextlib.nullcontext()
+            ):
+                b.run(
+                    rounds,
+                    ops=None if per_ops is None else per_ops[0],
+                    wal=None if wal is None else wal[0],
+                    egress=None if egress is None else egress[0],
+                    trace=None if trace is None else trace[0],
+                    **kw,
+                )
             self._throttle(b)
             return
         done = 0
@@ -223,15 +241,21 @@ class BlockedFusedCluster:
                 o = None
                 if per_ops is not None and (first or not ops_first):
                     o = per_ops[i]
-                b.run(
-                    step,
-                    ops=o,
-                    wal=wal[i] if (wal is not None and last) else None,
-                    egress=(
-                        egress[i] if (egress is not None and last) else None
-                    ),
-                    **kw,
-                )
+                with sp.span("dispatch", block=i, round=done, rounds=step) if (
+                    sp
+                ) else contextlib.nullcontext():
+                    b.run(
+                        step,
+                        ops=o,
+                        wal=wal[i] if (wal is not None and last) else None,
+                        egress=(
+                            egress[i] if (egress is not None and last) else None
+                        ),
+                        trace=(
+                            trace[i] if (trace is not None and last) else None
+                        ),
+                        **kw,
+                    )
                 self._throttle(b)
             done += step
 

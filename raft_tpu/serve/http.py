@@ -6,8 +6,10 @@ GET /metrics renders every registered source through
 metrics/host.py prometheus_text, concatenated: the serving plane
 (raft_tpu_serve prefix, notify-latency histogram) and the engine plane
 (raft_tpu prefix, commit-latency histogram) stay SEPARATE families in one
-exposition body — never merged, because merge_snapshots would sum the two
-histograms into nonsense. GET /healthz answers 200 "ok" for liveness.
+exposition body. (merge_snapshots now namespaces histograms by
+`hist_name`, so merging them would no longer sum into nonsense — the
+split here is kept for the prefix separation.) GET /healthz answers 200
+"ok" for liveness.
 
     srv = MetricsHTTPServer()
     srv.add_source("raft_tpu_serve", "notify_latency_rounds",

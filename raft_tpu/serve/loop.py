@@ -31,11 +31,14 @@ and never raised — callers route on them; every one is counted under
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 from raft_tpu.metrics.host import (
     HostCounters,
     HostHistogram,
+    MetricsRegistry,
     prometheus_text,
 )
 from raft_tpu.ops import ready_mask
@@ -60,6 +63,9 @@ from raft_tpu.serve.kv import (
 )
 from raft_tpu.serve.router import CompletionRouter
 from raft_tpu.serve.session import Session, SessionManager
+from raft_tpu.runtime.trace import TraceStream
+from raft_tpu.trace import device as trdev
+from raft_tpu.utils.profiling import SpanRecorder, StepStats
 
 
 class ServeMetrics:
@@ -75,9 +81,13 @@ class ServeMetrics:
         self.rounds = 0
 
     def snapshot(self) -> dict:
+        # the stamped hist_name lets merge_snapshots namespace this family
+        # away from the device plane's commit-latency histogram, so the
+        # registry below can merge serve + step-stats sources safely
         return {
             "counters": dict(self.counters.counts),
             "hist": self.hist.snapshot(),
+            "hist_name": "notify_latency_rounds",
             "rounds": int(self.rounds),
         }
 
@@ -127,6 +137,18 @@ class ServeLoop:
         self.round = 0
 
         self.metrics = ServeMetrics()
+        # host-side phase timings for the round loop (admission / coalesce
+        # / dispatch / drain_reads / resync), exported as step_* counters
+        # through the registry so one Prometheus scrape covers the serving
+        # counters AND where the host spends its wall time
+        self.stats = StepStats()
+        # host span log for the trace assembler; gated on the flight
+        # recorder so the span list (and the per-phase TraceAnnotations)
+        # cost nothing on untraced production loops
+        self.spans = SpanRecorder() if trdev.tracelog_enabled() else None
+        self.registry = MetricsRegistry()
+        self.registry.register("serve", self.metrics.snapshot)
+        self.registry.register("steps", self.stats.snapshot)
         self.sessions = SessionManager(self.g)
         self.kv = KVStore(self.g)
         self.admission = AdmissionController(
@@ -172,6 +194,21 @@ class ServeLoop:
             for i in range(self.k)
         ]
         self._egress_arg = self.streams if self.blocked else self.streams[0]
+        # flight-recorder drains ride the same per-block stream layout;
+        # built only when the device plane is compiled in (the cluster was
+        # constructed under the same RAFT_TPU_TRACELOG, so enabled here
+        # implies the rings exist there). Drained event counters land in
+        # the serve counter bag (trace_events / trace_events_dropped).
+        self.traces = None
+        self._trace_arg = None
+        if trdev.tracelog_enabled():
+            self.traces = [
+                TraceStream(counters=self.metrics.counters)
+                for _ in range(self.k)
+            ]
+            self._trace_arg = (
+                self.traces if self.blocked else self.traces[0]
+            )
 
     # -- bootstrap ---------------------------------------------------------
 
@@ -183,7 +220,10 @@ class ServeLoop:
         self.router.needs_resync.update(range(self.g))
         spent = 0
         while self.router.needs_resync and spent < max_rounds:
-            self.cluster.run(8, auto_compact_lag=self.compact_lag)
+            self.cluster.run(
+                8, auto_compact_lag=self.compact_lag,
+                trace=self._trace_arg,
+            )
             self.round += 8
             spent += 8
             self.router.round = self.round
@@ -293,26 +333,43 @@ class ServeLoop:
         self.round += 1
         self.metrics.rounds = self.round
         self.router.round = self.round
-        self.admission.tick()
-        ops, injections = self.coalescer.build(self.router.views, self.round)
-        self.router.record_injections(injections)
-        if ops is not None and self.blocked:
-            # slice once, explicitly — the scheduler's identity LRU cannot
-            # hit on a fresh per-round ops object
-            ops = self.cluster.prepare_ops(ops)
-        self.cluster.run(
-            1,
-            ops=ops,
-            egress=self._egress_arg,
-            auto_compact_lag=self.compact_lag,
-        )
+        sp = self.spans
+        with self.stats.timed("admission"):
+            self.admission.tick()
+        with self.stats.timed("coalesce"), (
+            sp.span("inject", round=self.round)
+            if sp
+            else contextlib.nullcontext()
+        ):
+            ops, injections = self.coalescer.build(
+                self.router.views, self.round
+            )
+            self.router.record_injections(injections)
+            if ops is not None and self.blocked:
+                # slice once, explicitly — the scheduler's identity LRU
+                # cannot hit on a fresh per-round ops object
+                ops = self.cluster.prepare_ops(ops)
+        with self.stats.timed("dispatch"), (
+            sp.span("dispatch", round=self.round)
+            if sp
+            else contextlib.nullcontext()
+        ):
+            self.cluster.run(
+                1,
+                ops=ops,
+                egress=self._egress_arg,
+                trace=self._trace_arg,
+                auto_compact_lag=self.compact_lag,
+            )
         if self.coalescer.outstanding_reads:
-            drained = self.cluster.drain_read_states()
-            for glane, rss in drained.items():
-                for ctx, index in rss:
-                    self.router.on_read_release(glane, ctx, index)
+            with self.stats.timed("drain_reads"):
+                drained = self.cluster.drain_read_states()
+                for glane, rss in drained.items():
+                    for ctx, index in rss:
+                        self.router.on_read_release(glane, ctx, index)
         if self.router.needs_resync:
-            self.router.resync(self._columns())
+            with self.stats.timed("resync"):
+                self.router.resync(self._columns())
         if self.expire_every and self.round % self.expire_every == 0:
             self.kv.expire(self.round)
         self.metrics.counters.set("sessions_active", self.sessions.active)
@@ -320,9 +377,19 @@ class ServeLoop:
     def flush(self) -> None:
         """Resolve the in-flight egress tail: the double-buffered push
         resolves bundles one round behind, so the final round's commits
-        only notify after a flush."""
-        for s in self.streams:
-            s.flush()
+        only notify after a flush. The flight-recorder streams drain on
+        the same fence so `traces[i].events` is complete afterwards."""
+        sp = self.spans
+        with self.stats.timed("host_drain"), (
+            sp.span("host_drain", round=self.round)
+            if sp
+            else contextlib.nullcontext()
+        ):
+            for s in self.streams:
+                s.flush()
+            if self.traces is not None:
+                for t in self.traces:
+                    t.flush()
         self.router.round = self.round
 
     @property
@@ -356,7 +423,9 @@ class ServeLoop:
         return replay(self.g, self.router.applied_log, self.round)
 
     def metrics_snapshot(self) -> dict:
-        return self.metrics.snapshot()
+        """Merged host-plane snapshot: serving counters + notify-latency
+        histogram (namespaced by hist_name) + step_* phase timings."""
+        return self.registry.snapshot()
 
     def engine_snapshot(self) -> dict | None:
         return self.cluster.metrics_snapshot()

@@ -105,6 +105,13 @@ class CompletionRouter:
         # apply-ordered (group, Command, tick) log for the scalar twin
         self.applied_log: list = []
         self._served_batches: list = []  # released batches awaiting watermark
+        # proposal-lifecycle log for the trace assembler: one
+        # (group, submit, inject, commit, notify) round tuple per notified
+        # proposal. Only kept while the flight recorder is on — it grows
+        # with every proposal, and untraced loops must not accumulate it.
+        from raft_tpu.trace.device import tracelog_enabled
+
+        self.lifecycle: list | None = [] if tracelog_enabled() else None
 
     # -- injection bookkeeping -------------------------------------------
 
@@ -175,6 +182,11 @@ class CompletionRouter:
         self.admission.release()
         self.metrics.counters.inc("proposals_notified")
         self.metrics.hist.observe(self.round - t.submit_round)
+        if self.lifecycle is not None:
+            self.lifecycle.append((
+                t.group, t.submit_round, t.inject_round,
+                t.commit_round, t.notify_round,
+            ))
 
     # -- the linearizable read path --------------------------------------
 

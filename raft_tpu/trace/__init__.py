@@ -1,0 +1,32 @@
+"""Flight recorder + trace plane (device event rings -> Perfetto JSON).
+
+- device.py: TraceState ring carry + on-device transition detection
+  (threaded through ops/fused.py and ops/pallas_round.py).
+- runtime/trace.py: TraceStream, the double-buffered async drain.
+- assemble.py: merge device events + scheduler phase spans + serve
+  lifecycle spans into one Chrome-trace JSON; `explain(group)` timeline
+  query + CLI.
+
+Enable with RAFT_TPU_TRACELOG=1 (default off; off = elided from the
+jaxpr entirely). Ring depth: RAFT_TPU_TRACE_RING (default 4096/block).
+"""
+
+from raft_tpu.trace.device import (  # noqa: F401
+    CHAOS_FAULT,
+    COMMIT_STALL,
+    CONFCHANGE_APPLY,
+    KIND_NAMES,
+    LEADER_ELECTED,
+    LEADERSHIP_LOST,
+    N_KINDS,
+    SNAPSHOT_INSTALL,
+    STALL_AFTER,
+    TERM_BUMP,
+    TraceState,
+    VOTE_GRANTED,
+    init_trace,
+    kernel_calls,
+    record_round,
+    ring_capacity,
+    tracelog_enabled,
+)

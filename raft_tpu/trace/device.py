@@ -1,0 +1,261 @@
+"""Device half of the flight recorder: the `TraceState` ring carried
+through the fused round (ops/fused.py + ops/pallas_round.py).
+
+Where the metrics plane (metrics/device.py) answers "how much happened",
+the trace plane answers "WHAT happened to lane 48291" — per-lane state
+transitions detected on device and appended as fixed-width event records
+`(round, lane, kind, arg)` into a per-block ring buffer that the host
+drains asynchronously (runtime/trace.py TraceStream).
+
+House rules, inherited from the metrics/chaos/egress planes:
+
+1. **Zero cost when off.** Every site is guarded by a trace-time
+   `if trace is not None:`; `RAFT_TPU_TRACELOG=0` (the default — tracing
+   is opt-in like chaos) produces a jaxpr with no trace ops at all and
+   dispatches zero trace kernels (`kernel_calls()`-asserted in
+   tests/test_trace.py and benches/trace_ab.py).
+2. **Engine-independent detection.** Events are computed from the
+   (pre-round, post-round) fat-state diff OUTSIDE the round kernel but
+   inside the compiled scan body — the XLA and Pallas engines feed the
+   same detector the same bit-identical states, so the event streams are
+   bit-identical by construction and the Pallas kernel needs no changes
+   (no VMEM budget growth, no tile-boundary event logic).
+3. **Deterministic order.** The [N, K] event mask flattens lane-major
+   (lane outer, kind inner), so the global append order is
+   (lane, kind) — identical between the monolithic XLA round and the
+   tile-concatenated Pallas round.
+4. **Overflow drops OLDEST.** The write cursor `wr` counts every event
+   ever detected (monotone); the ring keeps the last `ring` of them. The
+   host drain (TraceStream) recovers the drop count exactly as
+   `max(0, (wr - rd) - ring)` and surfaces it via the metrics host plane
+   (`trace_events_dropped`).
+
+Event kinds are plain module ints, NOT IntEnum: enum scalars need the
+literal registration dance (types.register_literal_enums) to survive
+pallas tracing, and the trace plane should not depend on it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.types import StateType
+
+I32 = jnp.int32
+_LEADER = int(StateType.LEADER)
+
+# -- event kinds (the `kind` column; arg semantics per kind) ---------------
+LEADER_ELECTED = 0  # arg = term won
+LEADERSHIP_LOST = 1  # arg = term as of the round's end
+TERM_BUMP = 2  # arg = new term
+VOTE_GRANTED = 3  # arg = candidate id voted for
+SNAPSHOT_INSTALL = 4  # arg = installed snapshot index
+CONFCHANGE_APPLY = 5  # arg = conf-change entry index applied
+COMMIT_STALL = 6  # arg = committed index the leader is stuck at
+CHAOS_FAULT = 7  # arg = 1 crash, 2 restart, 3 both edges same round
+
+N_KINDS = 8
+KIND_NAMES = (
+    "leader_elected",
+    "leadership_lost",
+    "term_bump",
+    "vote_granted",
+    "snapshot_install",
+    "confchange_apply",
+    "commit_stall",
+    "chaos_fault",
+)
+
+# a leader blocked (last > committed) with no commit progress for this many
+# consecutive rounds fires one COMMIT_STALL onset event (counter resets on
+# any progress, so a persistent stall fires once per stall episode)
+STALL_AFTER = 8
+
+
+def _dc(cls):
+    fields = [f.name for f in dataclasses.fields(cls)]
+    return jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+
+
+@_dc
+@dataclasses.dataclass(frozen=True)
+class TraceState:
+    """The trace carry. Ring columns are per-BLOCK (one ring per resident
+    block, all lanes multiplexed); `stall` is the only per-lane column."""
+
+    ring_round: Any  # [R] i32 event round stamps
+    ring_lane: Any  # [R] i32 global lane index
+    ring_kind: Any  # [R] i32 one of the module kind constants
+    ring_arg: Any  # [R] i32 per-kind argument
+    wr: Any  # [] i32 monotone count of events ever appended
+    round: Any  # [] i32 rounds recorded (event round stamps are 1-based)
+    stall: Any  # [N] i32 consecutive no-progress rounds per blocked leader
+
+
+def tracelog_enabled() -> bool:
+    """Read RAFT_TPU_TRACELOG lazily (default OFF — tracing is opt-in like
+    chaos); the value is baked into each cluster at construction."""
+    return os.environ.get("RAFT_TPU_TRACELOG", "0") not in ("0", "", "off")
+
+
+def ring_capacity() -> int:
+    """Ring slots per block (RAFT_TPU_TRACE_RING, default 4096 = 64 KiB of
+    ring per block at 4 i32 columns)."""
+    raw = os.environ.get("RAFT_TPU_TRACE_RING", "4096")
+    try:
+        r = int(raw)
+    except ValueError as e:
+        raise ValueError(f"RAFT_TPU_TRACE_RING={raw!r} is not an int") from e
+    if r <= 0:
+        raise ValueError(f"RAFT_TPU_TRACE_RING must be positive, got {r}")
+    return r
+
+
+def init_trace(n: int, ring: int | None = None) -> TraceState:
+    """Fresh recorder for an n-lane block. Every field gets its OWN zeros
+    buffer — donated carries must never alias (fused.py donation rule)."""
+    r = ring_capacity() if ring is None else ring
+    return TraceState(
+        ring_round=jnp.zeros((r,), I32),
+        ring_lane=jnp.zeros((r,), I32),
+        ring_kind=jnp.zeros((r,), I32),
+        ring_arg=jnp.zeros((r,), I32),
+        wr=jnp.zeros((), I32),
+        round=jnp.zeros((), I32),
+        stall=jnp.zeros((n,), I32),
+    )
+
+
+# trace-time counter: bumps once per record_round() CALL SITE TRACED, i.e.
+# stays put when the plane is elided — the ready_mask.kernel_calls idiom,
+# asserted by tests/test_trace.py and benches/trace_ab.py
+_KERNEL_CALLS = 0
+
+
+def kernel_calls() -> int:
+    return _KERNEL_CALLS
+
+
+def record_round(
+    trace: TraceState,
+    st0,
+    st1,
+    *,
+    chaos=None,
+    lane_offset=None,
+) -> TraceState:
+    """Detect this round's per-lane transitions from the (pre, post) FAT
+    state pair and append them to the ring.
+
+    st0: fat state at the round's start, BEFORE chaos begin_round — a
+         chaos crash-wipe then shows up as LEADERSHIP_LOST/TERM_BUMP diffs
+         exactly like any other cause (and CHAOS_FAULT marks why).
+    st1: fat state at the round's end.
+    chaos: the PRE-round ChaosState (or None) — fires CHAOS_FAULT on the
+         crash/restart window edges applied this round.
+    lane_offset: global index of lane 0 of this state window (sharded
+         dispatch); None/0 = lanes are already global.
+    """
+    global _KERNEL_CALLS
+    _KERNEL_CALLS += 1
+
+    n = st0.term.shape[0]
+    r = trace.ring_round.shape[0]
+    rnd = trace.round + 1
+
+    lead0 = st0.state == _LEADER
+    lead1 = st1.state == _LEADER
+
+    masks = [None] * N_KINDS
+    args = [None] * N_KINDS
+    masks[LEADER_ELECTED] = lead1 & ~lead0
+    args[LEADER_ELECTED] = st1.term
+    masks[LEADERSHIP_LOST] = lead0 & ~lead1
+    args[LEADERSHIP_LOST] = st1.term
+    masks[TERM_BUMP] = st1.term > st0.term
+    args[TERM_BUMP] = st1.term
+    masks[VOTE_GRANTED] = (st1.vote != st0.vote) & (st1.vote > 0)
+    args[VOTE_GRANTED] = st1.vote
+    # received-snapshot install raises snap_index PAST the old last; local
+    # auto-compaction only ever moves it below applied <= last
+    masks[SNAPSHOT_INSTALL] = (st1.snap_index > st0.snap_index) & (
+        st1.snap_index > st0.last
+    )
+    args[SNAPSHOT_INSTALL] = st1.snap_index
+    masks[CONFCHANGE_APPLY] = (st0.pending_conf_index > st0.applied) & (
+        st1.applied >= st0.pending_conf_index
+    )
+    args[CONFCHANGE_APPLY] = st0.pending_conf_index
+
+    blocked = lead1 & (st1.last > st1.committed)
+    advanced = st1.committed > st0.committed
+    stall = jnp.where(blocked & ~advanced, trace.stall + 1, 0)
+    masks[COMMIT_STALL] = stall == STALL_AFTER
+    args[COMMIT_STALL] = st1.committed
+
+    if chaos is not None:
+        crash = chaos.round == chaos.crash_at
+        restart = chaos.round == chaos.restart_at
+        masks[CHAOS_FAULT] = crash | restart
+        args[CHAOS_FAULT] = crash.astype(I32) + 2 * restart.astype(I32)
+    else:
+        masks[CHAOS_FAULT] = jnp.zeros((n,), jnp.bool_)
+        args[CHAOS_FAULT] = jnp.zeros((n,), I32)
+
+    ev_mask = jnp.stack(masks, axis=1)  # [N, K] lane-major flatten below
+    ev_arg = jnp.stack(args, axis=1)
+
+    lane = jnp.arange(n, dtype=I32)
+    if lane_offset is not None:
+        lane = lane + lane_offset
+    ev_lane = jnp.broadcast_to(lane[:, None], (n, N_KINDS))
+    ev_kind = jnp.broadcast_to(jnp.arange(N_KINDS, dtype=I32)[None, :], (n, N_KINDS))
+
+    # cumsum-scatter compaction (the ops/ready_mask.py idiom), with an
+    # in-round drop-oldest twist: when a single round produces more than R
+    # events, only the LAST R survive — that keeps every kept event's slot
+    # unique, so the scatter needs no ordering guarantee for duplicates.
+    flat = ev_mask.reshape(-1)
+    pos = jnp.cumsum(flat.astype(I32)) - 1  # append position among kept
+    total = pos[-1] + 1  # events this round
+    keep = flat & (pos >= total - r)
+    slot = (trace.wr + pos) % r
+    idx = jnp.where(keep, slot, r)  # r = out of bounds -> dropped
+
+    def scatter(ring, val):
+        return ring.at[idx].set(val, mode="drop")
+
+    return TraceState(
+        ring_round=scatter(trace.ring_round, jnp.broadcast_to(rnd, (n * N_KINDS,))),
+        ring_lane=scatter(trace.ring_lane, ev_lane.reshape(-1)),
+        ring_kind=scatter(trace.ring_kind, ev_kind.reshape(-1)),
+        ring_arg=scatter(trace.ring_arg, ev_arg.reshape(-1)),
+        wr=trace.wr + total,
+        round=rnd,
+        stall=stall,
+    )
+
+
+def rebase(trace: TraceState, mask, delta) -> TraceState:
+    """Index-rebase hook (FusedCluster.rebase_groups): ring entries whose
+    arg column carries a log INDEX (snapshot_install, commit_stall) shift
+    with the rebased lanes so `explain` output matches the post-rebase
+    index space. mask: [N] bool lanes rebased; delta: [] or [N] i32 shift
+    (negative = down, the compaction direction)."""
+    n = trace.stall.shape[0]
+    d = jnp.broadcast_to(jnp.asarray(delta, I32), (n,))
+    lane_mask = jnp.asarray(mask, jnp.bool_)
+    # map each ring slot through its lane's rebase decision; lanes outside
+    # this block window (sharded gather) never appear in its ring
+    slot_lane = jnp.clip(trace.ring_lane, 0, n - 1)
+    hit = lane_mask[slot_lane] & (
+        (trace.ring_kind == SNAPSHOT_INSTALL) | (trace.ring_kind == COMMIT_STALL)
+    )
+    return dataclasses.replace(
+        trace, ring_arg=jnp.where(hit, trace.ring_arg + d[slot_lane], trace.ring_arg)
+    )

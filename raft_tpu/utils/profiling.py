@@ -79,6 +79,43 @@ class StepStats:
             for k in sorted(set(self.counts) | set(self.seconds))
         }
 
+    def snapshot(self) -> dict:
+        """Metrics-plane snapshot (registerable with MetricsRegistry): each
+        timed key becomes step_<key>_count / step_<key>_micros counters.
+        Monotone like every other counter source, so registry deltas and
+        Prometheus scrapes work unchanged."""
+        counters: dict[str, int] = {}
+        for k in sorted(set(self.counts) | set(self.seconds)):
+            counters[f"step_{k}_count"] = self.counts.get(k, 0)
+            counters[f"step_{k}_micros"] = int(self.seconds.get(k, 0.0) * 1e6)
+        return {"counters": counters, "rounds": 0}
+
+
+class SpanRecorder:
+    """Host-side span log for the trace assembler (trace/assemble.py):
+    each span() region records (name, t0, dur_s, labels) AND mirrors into
+    a jax.profiler TraceAnnotation so the same markup shows up in XLA
+    profiles captured with trace(). Used by the blocked scheduler for
+    per-(block, round) dispatch phases and by ServeLoop for the serving
+    phases (inject / dispatch / egress_drain / host_drain)."""
+
+    def __init__(self):
+        self.spans: list[tuple[str, float, float, dict]] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, **labels):
+        t0 = time.perf_counter()
+        with annotate(name):
+            try:
+                yield
+            finally:
+                self.spans.append(
+                    (name, t0, time.perf_counter() - t0, labels)
+                )
+
+    def clear(self):
+        self.spans = []
+
 
 def env_trace_dir() -> str | None:
     return os.environ.get("RAFT_TPU_TRACE") or None

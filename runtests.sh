@@ -50,12 +50,16 @@ smokes() {
   # smoke (closed-loop p50/p99 + open-loop saturation: exactly-once
   # notify, digest == admission-ordered scalar twin, typed rejections
   # under overload with no deadlock)
+  # ... + the trace A/B smoke (flight recorder on vs off must be
+  # digest-identical, TRACELOG=0 must trace zero recorder sites, and the
+  # drained events must equal the scalar-twin transition stream)
   run_bench benches/metrics_smoke.py \
     && run_bench benches/dispatch_ab.py \
     && run_bench benches/egress_ab.py \
     && run_bench benches/pallas_ab.py --smoke \
     && run_bench benches/chaos_soak.py --smoke \
-    && run_bench benches/serve_bench.py --smoke
+    && run_bench benches/serve_bench.py --smoke \
+    && run_bench benches/trace_ab.py
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
@@ -99,6 +103,10 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
     # the serving frontend gets its own process: its module-scoped
     # ServeLoop fixtures compile fused programs for two cluster shapes
     run_chunk tests/test_serve.py
+    # the flight recorder gets its own process: its traced clusters are
+    # distinct programs (trace carry changes every scan signature) across
+    # three engines plus a ServeLoop
+    run_chunk tests/test_trace.py
     # the pallas interpret-mode engine smoke gets its own process: each of
     # its kernel variants is one large interpreted scan program, and the
     # CI-asserted bit-identity (pallas vs XLA trajectories) lives here

@@ -180,6 +180,79 @@ def test_host_counters_and_merge():
     assert m["counters"]["elections_won"] == 0
 
 
+def test_merge_namespaces_histograms_by_name():
+    """Regression for the histogram merge hazard: two sources with
+    DIFFERENT latency semantics (device commit latency vs serve notify
+    latency) must not sum into one nonsense histogram. Families are keyed
+    by hist_name; same-named families still sum; mismatched edges raise."""
+    from raft_tpu.metrics.host import HostHistogram
+
+    dev = HostHistogram()
+    dev.observe(2, 3)
+    srv = HostHistogram()
+    srv.observe(4, 5)
+    m = merge_snapshots([
+        {"counters": {}, "hist": dev.snapshot(), "rounds": 1},  # legacy name
+        {
+            "counters": {},
+            "hist": srv.snapshot(),
+            "hist_name": "notify_latency_rounds",
+            "rounds": 1,
+        },
+    ])
+    assert set(m["hists"]) == {"commit_latency_rounds", "notify_latency_rounds"}
+    assert m["hists"]["commit_latency_rounds"]["count"] == 3
+    assert m["hists"]["notify_latency_rounds"]["count"] == 5
+    # legacy single-hist view picks the default-named family
+    assert m["hist"]["count"] == 3
+
+    # same-named families still sum bucketwise
+    m2 = merge_snapshots([
+        {"counters": {}, "hist": dev.snapshot(), "rounds": 0},
+        {"counters": {}, "hist": dev.snapshot(), "rounds": 0},
+    ])
+    assert m2["hist"]["count"] == 6 and m2["hist_name"] == "commit_latency_rounds"
+
+    # the multi-family merge round-trips through another merge via "hists"
+    m3 = merge_snapshots([m, m])
+    assert m3["hists"]["notify_latency_rounds"]["count"] == 10
+
+    # mismatched edges under one name must refuse, not corrupt
+    odd = {
+        "edges": [1, 2],
+        "buckets": [0, 0, 1],
+        "sum": 3,
+        "count": 1,
+    }
+    with pytest.raises(ValueError, match="different edges"):
+        merge_snapshots([
+            {"counters": {}, "hist": dev.snapshot(), "rounds": 0},
+            {"counters": {}, "hist": odd, "rounds": 0},
+        ])
+
+
+def test_prometheus_renders_named_families():
+    from raft_tpu.metrics.host import HostHistogram
+
+    srv = HostHistogram()
+    srv.observe(3, 2)
+    dev = HostHistogram()
+    dev.observe(1)
+    snap = merge_snapshots([
+        {"counters": {"x": 1}, "hist": dev.snapshot(), "rounds": 0},
+        {
+            "counters": {},
+            "hist": srv.snapshot(),
+            "hist_name": "notify_latency_rounds",
+            "rounds": 0,
+        },
+    ])
+    text = prometheus_text(snap, prefix="t")
+    assert "t_commit_latency_rounds_count 1" in text
+    assert "t_notify_latency_rounds_count 2" in text
+    assert "t_x_total 1" in text
+
+
 def test_registry_snapshot_and_delta():
     reg = MetricsRegistry()
     h = HostCounters()
