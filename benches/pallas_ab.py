@@ -1,10 +1,11 @@
 """Engine A/B: the VMEM-resident pallas round vs the XLA round.
 
-Runs the same FusedCluster workload twice in fresh subprocesses —
-RAFT_TPU_ENGINE=xla then =pallas (the production selection knob, so this
-harness exercises exactly what users flip) — and emits one bench JSON
-line per engine plus a summary, with ms/round AND the bytes-moved probes
-in `extra`:
+Runs the same FusedCluster workload in fresh subprocesses —
+RAFT_TPU_ENGINE=xla, =pallas at K=1, and =pallas at K=AB_K (the
+RAFT_TPU_PALLAS_ROUNDS megakernel arm; default 4) — the production
+selection knobs, so this harness exercises exactly what users flip — and
+emits one bench JSON line per arm plus a summary, with ms/round AND the
+bytes-moved probes in `extra`:
 
   - ms_per_round: wall clock over AB_ITERS timed dispatches
   - bytes_accessed_per_round: the compiled executable's cost-analysis
@@ -14,14 +15,17 @@ in `extra`:
     (raft_tpu/utils/profiling.py; device stats are None on XLA:CPU)
 
 Asserted invariants:
-  - both engines end on an identical slim_state digest (bit-identity)
-  - the pallas child really ran pallas: no silent engine fallback
+  - all arms end on an identical slim_state digest (bit-identity,
+    including the K>1 megakernel arm)
+  - the pallas children really ran pallas: no silent engine fallback
   - [TPU only] pallas ms/round <= AB_TOL x XLA ms/round at the default
-    tile, and pallas moves strictly fewer bytes/round than XLA
+    tile, pallas moves strictly fewer bytes/round than XLA, and the
+    K=AB_K megakernel moves strictly fewer bytes/round than K=1 (the
+    K-1 eliminated carry round-trips per dispatch)
 
 Exit 0 = pass, 1 = regression. `--smoke` shrinks the workload for CI
 (CPU interpret mode: correctness + plumbing only, timings meaningless).
-Env: AB_GROUPS, AB_VOTERS, AB_ROUNDS, AB_ITERS, AB_TOL, RAFT_TPU_*
+Env: AB_GROUPS, AB_VOTERS, AB_ROUNDS, AB_ITERS, AB_TOL, AB_K, RAFT_TPU_*
 (RAFT_TPU_COMPILE_CACHE is forwarded to the children verbatim).
 """
 
@@ -97,6 +101,7 @@ def child():
             lowered = plr._pallas_rounds_nodonate_jit.lower(
                 c.state, c.fab, c._no_ops, c.mute,
                 tile_lanes=c._pallas_tile, interpret=c._pallas_interpret,
+                rounds_per_call=c._pallas_rounds or 1,
                 **kw,
             )
         else:
@@ -125,6 +130,7 @@ def child():
             "engine_after": c.engine,
             "fallbacks": ENGINE_EVENTS.get("engine_pallas_fallback"),
             "tile_lanes": c._pallas_tile,
+            "rounds_per_call": c._pallas_rounds,
             "interpret": c._pallas_interpret,
             "ms_per_round": ms_per_round,
             "bytes_accessed_per_round": bytes_per_round,
@@ -136,9 +142,11 @@ def child():
     }), flush=True)
 
 
-def run_child(engine: str) -> dict:
+def run_child(engine: str, extra_env: dict | None = None) -> dict:
     env = dict(os.environ, RAFT_TPU_ENGINE=engine)  # forwards
     # RAFT_TPU_COMPILE_CACHE / RAFT_TPU_DONATE / JAX_PLATFORMS etc. verbatim
+    if extra_env:
+        env.update(extra_env)
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--child"],
         env=env, capture_output=True, text=True, check=True,
@@ -152,26 +160,42 @@ def main():
         os.environ.setdefault("AB_ROUNDS", "4")
         os.environ.setdefault("AB_ITERS", "2")
     tol = float(os.environ.get("AB_TOL", 1.05))
+    ab_k = int(os.environ.get("AB_K", 4))
     xla = run_child("xla")
-    pal = run_child("pallas")
+    pal = run_child("pallas", {"RAFT_TPU_PALLAS_ROUNDS": "1"})
+    palk = run_child("pallas", {"RAFT_TPU_PALLAS_ROUNDS": str(ab_k)})
     print(json.dumps(xla), flush=True)
     print(json.dumps(pal), flush=True)
-    xx, pp = xla["extra"], pal["extra"]
+    print(json.dumps(palk), flush=True)
+    xx, pp, kk = xla["extra"], pal["extra"], palk["extra"]
     on_tpu = pp["backend"] == "tpu"
 
     fails = []
     if pp["digest"] != xx["digest"]:
         fails.append("slim_state digest mismatch: pallas != xla trajectory")
-    if pp["engine_after"] != "pallas" or pp["fallbacks"]:
+    if kk["digest"] != xx["digest"]:
         fails.append(
-            f"pallas child fell back to {pp['engine_after']} "
-            f"({pp['fallbacks']} fallback(s)) — kernel failed to lower"
+            f"slim_state digest mismatch: pallas K={ab_k} megakernel "
+            "!= xla trajectory"
         )
+    for label, ex in (("pallas", pp), (f"pallas K={ab_k}", kk)):
+        if ex["engine_after"] != "pallas" or ex["fallbacks"]:
+            fails.append(
+                f"{label} child fell back to {ex['engine_after']} "
+                f"({ex['fallbacks']} fallback(s)) — kernel failed to lower"
+            )
     ratio = pal["value"] / max(xla["value"], 1e-9)
+    ratio_k = palk["value"] / max(xla["value"], 1e-9)
     if on_tpu and ratio > tol:
         fails.append(
             f"pallas regressed throughput: {pal['value']:.4f} ms/round vs "
             f"xla {xla['value']:.4f} (ratio {ratio:.3f} > tol {tol})"
+        )
+    if on_tpu and ratio_k > tol:
+        fails.append(
+            f"pallas K={ab_k} regressed throughput: {palk['value']:.4f} "
+            f"ms/round vs xla {xla['value']:.4f} "
+            f"(ratio {ratio_k:.3f} > tol {tol})"
         )
     if on_tpu and not (
         pp["bytes_accessed_per_round"]
@@ -182,11 +206,26 @@ def main():
             f"pallas does not move fewer bytes/round: "
             f"{pp['bytes_accessed_per_round']} vs {xx['bytes_accessed_per_round']}"
         )
+    if on_tpu and not (
+        kk["bytes_accessed_per_round"]
+        and pp["bytes_accessed_per_round"]
+        and kk["bytes_accessed_per_round"] < pp["bytes_accessed_per_round"]
+    ):
+        # the megakernel's whole point: K-1 fewer carry HBM round-trips
+        # per dispatch must show up as strictly fewer bytes than K=1
+        fails.append(
+            f"K={ab_k} megakernel does not move fewer bytes/round than "
+            f"K=1: {kk['bytes_accessed_per_round']} vs "
+            f"{pp['bytes_accessed_per_round']}"
+        )
     print(json.dumps({
         "metric": "pallas_ab",
         "ok": not fails,
         "ms_ratio_pallas_over_xla": round(ratio, 3),
+        "ms_ratio_pallas_k_over_xla": round(ratio_k, 3),
+        "megakernel_k": ab_k,
         "bytes_pallas": pp["bytes_accessed_per_round"],
+        "bytes_pallas_k": kk["bytes_accessed_per_round"],
         "bytes_xla": xx["bytes_accessed_per_round"],
         "tpu_gates": on_tpu,
         "tol": tol,

@@ -1714,6 +1714,7 @@ class FusedCluster:
         learner_ids: tuple = (),
         engine: str | None = None,
         tile_lanes: int | None = None,
+        rounds_per_call: int | None = None,
         **cfg,
     ):
         import numpy as np
@@ -1731,6 +1732,10 @@ class FusedCluster:
         self._tile_req = tile_lanes  # explicit tile (None = env/autotune)
         self._pallas_tile = None  # resolved lazily at first pallas dispatch
         self._pallas_interpret = None
+        # megakernel rounds-per-call K (None = env/plan-cache/autotune);
+        # resolved lazily alongside the tile at first pallas dispatch
+        self._rounds_req = rounds_per_call
+        self._pallas_rounds = None
         self.g, self.v = n_groups, n_voters
         n = n_groups * n_voters
         self.shape = shape or Shape(n_lanes=n, max_peers=n_voters)
@@ -1941,6 +1946,11 @@ class FusedCluster:
         configuration errors and propagate."""
         from raft_tpu.ops import pallas_round as plr
 
+        # K first: the joint autotune (inside _resolve_pallas_rounds)
+        # populates the tile cache, which _resolve_pallas_tile consults.
+        # Both resolvers run OUTSIDE the try: TileError / ValueError here
+        # are configuration errors, never engine fallbacks.
+        rpc = self._resolve_pallas_rounds()
         tile = self._resolve_pallas_tile()
         if self._pallas_interpret is None:
             self._pallas_interpret = plr.default_interpret()
@@ -1948,6 +1958,7 @@ class FusedCluster:
             v=self.v,
             tile_lanes=tile,
             n_rounds=rounds,
+            rounds_per_call=rpc,
             do_tick=do_tick,
             auto_propose=auto_propose,
             auto_compact_lag=auto_compact_lag,
@@ -1974,7 +1985,8 @@ class FusedCluster:
 
             record_engine_fallback(
                 f"{type(self).__name__}(n={self.shape.n_lanes}, v={self.v}, "
-                f"tile={tile}, backend={jax.default_backend()})",
+                f"tile={tile}, rounds_per_call={rpc}, "
+                f"backend={jax.default_backend()})",
                 e,
             )
             self.engine = "xla"
@@ -2012,17 +2024,64 @@ class FusedCluster:
         self._pallas_tile = t
         return t
 
-    def _time_tile(self, tile_lanes: int) -> float:
-        """Autotune probe: seconds for a short warmed block of rounds on
+    def _resolve_pallas_rounds(self) -> int:
+        """Pick the megakernel K once per cluster: explicit ctor
+        rounds_per_call > RAFT_TPU_PALLAS_ROUNDS env > the process-wide
+        (shape, backend) plan cache > TPU joint (tile, K) autotune sweep
+        (pallas_round.autotune_plan — which also fills the tile cache the
+        tile resolver consults) > 1. Every winner is validated against
+        the RAFT_TPU_UNROLL composition up front."""
+        if self._pallas_rounds is not None:
+            return self._pallas_rounds
+        from raft_tpu.ops import pallas_round as plr
+
+        n = self.shape.n_lanes
+        backend = jax.default_backend()
+        key = plr.shape_key(self.shape, backend)
+        k = self._rounds_req
+        if k is None:
+            k = plr.env_rounds_per_call()
+        if k is None:
+            plan = plr.cached_plan(key)
+            if plan is not None:
+                k = plan[1]
+        if k is None:
+            if backend == "tpu" and plr.autotune_enabled():
+                # a pinned tile (ctor/env) restricts the sweep's tile axis
+                # but still sweeps K
+                pinned = self._tile_req
+                if pinned is None:
+                    env = os.environ.get("RAFT_TPU_PALLAS_TILE")
+                    pinned = int(env) if env else None
+                tiles = None
+                if pinned is not None:
+                    plr.check_tile(n, self.v, pinned)
+                    tiles = (pinned,)
+                else:
+                    for c in plr.tile_candidates(n, self.v):
+                        plr.check_tile(n, self.v, c)
+                _, k = plr.autotune_plan(
+                    n, self.v, key=key, time_fn=self._time_plan, tiles=tiles
+                )
+            else:
+                k = 1
+        plr.validate_round_plan(k, unroll=_SCAN_UNROLL)
+        self._pallas_rounds = k
+        return k
+
+    def _time_plan(self, tile_lanes: int, rounds_per_call: int) -> float:
+        """Autotune probe: seconds PER ROUND for a short warmed block on
         the copying twin (the carry is untouched)."""
         import time as _time
 
         from raft_tpu.ops import pallas_round as plr
 
+        nr = 4 * rounds_per_call
         kw = dict(
             v=self.v,
             tile_lanes=tile_lanes,
-            n_rounds=4,
+            n_rounds=nr,
+            rounds_per_call=rounds_per_call,
             do_tick=True,
             auto_propose=False,
             auto_compact_lag=None,
@@ -2037,7 +2096,11 @@ class FusedCluster:
         )  # compile + warm
         t0 = _time.perf_counter()
         jax.block_until_ready(plr._pallas_rounds_nodonate_jit(*args, **kw))
-        return _time.perf_counter() - t0
+        return (_time.perf_counter() - t0) / nr
+
+    def _time_tile(self, tile_lanes: int) -> float:
+        """Tile-only autotune probe (K fixed at the resolved/default K)."""
+        return self._time_plan(tile_lanes, self._pallas_rounds or 1)
 
     def ops(self, **kw) -> LocalOps:
         """Build a LocalOps with the given per-lane columns set. Values may

@@ -1,4 +1,4 @@
-"""VMEM-resident fused round: the `pallas` engine behind RAFT_TPU_ENGINE.
+"""VMEM-resident fused rounds: the `pallas` engine behind RAFT_TPU_ENGINE.
 
 The round-5 profile shows the XLA fused round is HBM-bound at ~3 GB/round
 moved — ~12x the one-read+one-write floor of the resident carry — because
@@ -7,15 +7,28 @@ shared state arrays (benches/pallas_probe.py header, which this module
 productionizes). The cure is the hand-fused-kernel pattern TPU serving
 stacks reach for when XLA's fusion boundaries leave bandwidth on the
 table: ONE Pallas kernel per group-aligned lane tile that reads every
-slim-carry field into VMEM once, runs the whole round (route_fabric +
+slim-carry field into VMEM once, runs the round (route_fabric +
 fused_round, unchanged jnp bodies), and writes the slim carry back once.
+
+**The K-round megakernel.** `rounds_per_call` (K, env
+RAFT_TPU_PALLAS_ROUNDS) fuses K rounds into each pallas_call: the tile's
+state/fabric/metrics/chaos columns are read into VMEM once, K iterations
+of route_fabric + fused_round run back-to-back with the carry resident in
+VMEM (the inter-round slim<->fat casts are replayed in-register so the
+trajectory stays bit-identical to K chained K=1 calls), and the tile is
+written back once — eliminating K-1 HBM round-trips of the carry per
+dispatch. The HBM<->VMEM tile in/out is double-buffered by Mosaic's grid
+pipelining (the next tile's loads overlap the current tile's K rounds; no
+manual DMA needed). An `n_rounds` that K does not divide dispatches a
+second, remainder-sized megakernel after the scan of full-K calls.
 
 Contract vs ops/fused.py fused_rounds:
 
 - `pallas_rounds` mirrors fused_rounds' signature and return tuple
   (state, fab[, metrics][, chaos]) and is BIT-IDENTICAL to it per round
-  (asserted over >=32 rounds by tests/test_pallas_round.py in interpret
-  mode; interpret=True is the CPU path — Mosaic only lowers on TPU).
+  at every K (asserted over >=33 rounds by tests/test_pallas_round.py in
+  interpret mode; interpret=True is the CPU path — Mosaic only lowers on
+  TPU).
 - Tile invariant: `tile_lanes % v == 0` and `n % tile_lanes == 0`
   (TileError otherwise) — a raft group's voters never straddle a tile, so
   the in-tile shift router, aligned_peer_mute, and the chaos/metrics
@@ -23,13 +36,22 @@ Contract vs ops/fused.py fused_rounds:
 - The metrics/chaos carries thread THROUGH the kernel: per-lane columns
   (latency sampler, fault knobs, recovery probe) tile like state; the
   lane-reduced scalars (counters/hist/lat_sum, recovery recounts) come
-  back as one [n_tiles, 128] partials row per tile and are reduced
-  OUTSIDE the call, so `metrics=None` / `chaos=None` still elide every
-  plane op from the trace exactly like the XLA path.
-- The chaos PRNG is a pure function of GLOBAL lane index, so each tile
+  back as PER-ROUND [K, n_tiles, 128] partials rows reduced OUTSIDE the
+  call (metrics deltas sum over rounds and tiles — i32 wrap-add is
+  associative, so the order change is exact; the chaos recounts are
+  absolute, so only the LAST round's row lands in the carry). With
+  `metrics=None` / `chaos=None` the partials output disappears and every
+  plane op is elided from the trace exactly like the XLA path.
+- The chaos PRNG is a pure function of GLOBAL (lane, round), so each tile
   passes `lane_offset = program_id * tile_lanes` into the chaos hooks
-  (chaos/device.py _lane_edge) and reproduces the monolithic fault
-  timeline bit-for-bit.
+  (chaos/device.py _lane_edge) and the in-kernel round loop advances the
+  absolute round counter — tiling and K are both invisible to the fault
+  timeline.
+- The trace plane's diff detection consumes per-round (pre, post)
+  boundary states OUTSIDE the kernel (trace/device.py record_round),
+  which a K-round megakernel does not export: trace-enabled runs route to
+  K=1 (documented in README, asserted by tests) — same events, K-1 fewer
+  fused round-trips forgone while the flight recorder is on.
 - Donation composes like fused_rounds: `_pallas_rounds_jit` donates the
   (state, fab, metrics, chaos) carry and must run under the jax 0.4.37
   persistent-cache fence (ops/fused.py _no_persistent_cache);
@@ -44,11 +66,14 @@ lower for a given Shape, they log once via the metrics host plane
 (metrics/host.py record_engine_fallback) and fall back to the XLA path
 rather than erroring — see FusedCluster._run_pallas.
 
-Tile autotuner: `autotune_tile` sweeps tile_candidates at first dispatch
-(TPU only; sweeping interpret mode would time the interpreter) and caches
-the winner per (shape, backend) in the module-level _TILE_CACHE, shared
-by every scheduler in the process. RAFT_TPU_PALLAS_TILE pins the tile;
-RAFT_TPU_PALLAS_AUTOTUNE=0 skips the sweep (default_tile is used).
+Autotuner: `autotune_plan` sweeps (tile, K) jointly at first dispatch
+(TPU only; sweeping interpret mode would time the interpreter), caching
+the per-K tile winners under (shape, backend, K) and the overall (tile,
+K) plan under (shape, backend), shared by every scheduler in the process.
+RAFT_TPU_PALLAS_TILE pins the tile and RAFT_TPU_PALLAS_ROUNDS pins K
+(each validated up front — validate_round_plan gives the clear error the
+satellite demands instead of a mid-dispatch Mosaic failure);
+RAFT_TPU_PALLAS_AUTOTUNE=0 skips every sweep (default_tile, K=1).
 """
 
 from __future__ import annotations
@@ -79,16 +104,30 @@ U32 = jnp.uint32
 
 ENGINES = ("xla", "pallas")
 
-# Width of the per-tile partials row: one TPU lane register. Layout (i32):
-#   [0 : K)          metrics counter deltas       (K = len(metmod.COUNTERS))
-#   [K : K+B)        commit-latency hist deltas   (B = metmod.N_BUCKETS)
-#   [K+B]            lat_sum delta
-#   [C], [C+1]       chaos n_reelected / n_recommitted per-tile recounts
-# where C = K+B+1 when metrics ride along, else 0. Deltas accumulate
-# across tiles; the chaos recounts are absolute per-tile counts that sum
-# exactly because tiles are group-aligned and the probe columns are
-# group-uniform (chaos/device.py end_round).
+# Width of the per-(round, tile) partials row: one TPU lane register.
+# Layout (i32):
+#   [0 : C)          metrics counter deltas       (C = len(metmod.COUNTERS))
+#   [C : C+B)        commit-latency hist deltas   (B = metmod.N_BUCKETS)
+#   [C+B]            lat_sum delta
+#   [X], [X+1]       chaos n_reelected / n_recommitted per-tile recounts
+# where X = C+B+1 when metrics ride along, else 0. Deltas accumulate
+# across tiles AND in-kernel rounds; the chaos recounts are absolute
+# per-tile counts that sum exactly across tiles because tiles are
+# group-aligned and the probe columns are group-uniform (chaos/device.py
+# end_round) — only the last in-kernel round's row is consumed.
 PARTIAL_WIDTH = 128
+
+# In-kernel rounds are a Python loop the tracer unrolls: the Mosaic
+# program grows ~linearly in K, so an unbounded K dies mid-compile on
+# program/VMEM limits. Bound it where the knob is parsed, with a clear
+# error (the RAFT_TPU_UNROLL treatment, ops/fused.py:388-394).
+MAX_ROUNDS_PER_CALL = 64
+# The scan unroll (RAFT_TPU_UNROLL) multiplies the in-kernel K: cap the
+# product so the two knobs can't compose into an absurd program.
+MAX_UNROLLED_ROUNDS = 256
+# Joint autotune sweep set for K (tile candidates come from
+# tile_candidates); kept small — the sweep compiles one program per pair.
+ROUND_CANDIDATES = (1, 2, 4, 8)
 
 # chaos per-lane columns that enter the kernel: host-set knobs (read-only
 # in-kernel) then the recovery-probe columns (read-write, tiled outputs)
@@ -133,6 +172,73 @@ def autotune_enabled() -> bool:
         "",
         "off",
     )
+
+
+def env_rounds_per_call() -> int | None:
+    """RAFT_TPU_PALLAS_ROUNDS: pin the megakernel K. None when unset;
+    parse failures raise the same clear error shape as RAFT_TPU_UNROLL
+    (ops/fused.py:388-394) instead of surfacing mid-dispatch."""
+    raw = os.environ.get("RAFT_TPU_PALLAS_ROUNDS")
+    if raw in (None, ""):
+        return None
+    try:
+        k = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"RAFT_TPU_PALLAS_ROUNDS must be an integer >= 1, got {raw!r}"
+        ) from None
+    if k < 1:
+        raise ValueError(
+            f"RAFT_TPU_PALLAS_ROUNDS must be an integer >= 1, got {raw!r}"
+        )
+    return k
+
+
+def validate_round_plan(
+    rounds_per_call,
+    *,
+    unroll: int | None = None,
+    round_chunk: int | None = None,
+) -> None:
+    """Up-front check of the RAFT_TPU_UNROLL x K x round_chunk
+    composition: every failure mode here would otherwise surface as a
+    mid-dispatch Mosaic program-size/VMEM error (or a silent per-chunk
+    kernel-variant explosion on the blocked path) long after the knobs
+    were set. Raise the clear error NOW, where the configuration is."""
+    if not isinstance(rounds_per_call, int) or isinstance(
+        rounds_per_call, bool
+    ) or rounds_per_call < 1:
+        raise ValueError(
+            "rounds_per_call (RAFT_TPU_PALLAS_ROUNDS) must be an integer "
+            f">= 1, got {rounds_per_call!r}"
+        )
+    if rounds_per_call > MAX_ROUNDS_PER_CALL:
+        raise ValueError(
+            f"rounds_per_call={rounds_per_call} exceeds "
+            f"MAX_ROUNDS_PER_CALL={MAX_ROUNDS_PER_CALL}: the K in-kernel "
+            "rounds are unrolled into the Mosaic program, so a huge K "
+            "fails program/VMEM limits mid-compile; lower "
+            "RAFT_TPU_PALLAS_ROUNDS"
+        )
+    if unroll is not None and unroll * rounds_per_call > MAX_UNROLLED_ROUNDS:
+        raise ValueError(
+            f"RAFT_TPU_UNROLL={unroll} x rounds_per_call={rounds_per_call} "
+            f"= {unroll * rounds_per_call} unrolled rounds per dispatch "
+            f"exceeds {MAX_UNROLLED_ROUNDS}: the scan unroll multiplies "
+            "the in-kernel K; lower one of the two knobs"
+        )
+    if (
+        round_chunk is not None
+        and rounds_per_call > 1
+        and round_chunk % rounds_per_call
+    ):
+        raise ValueError(
+            f"round_chunk={round_chunk} is not a multiple of "
+            f"rounds_per_call={rounds_per_call}: every blocked chunk would "
+            "compile an extra remainder-tail kernel variant (one per "
+            "distinct chunk size). Pick a K that divides round_chunk, or "
+            "pin RAFT_TPU_PALLAS_ROUNDS=1"
+        )
 
 
 def check_tile(n: int, v: int, tile_lanes: int) -> None:
@@ -188,18 +294,27 @@ def default_tile(n: int, v: int) -> int:
     return best if best is not None else cands[0]
 
 
-def shape_key(shape, backend: str) -> tuple:
-    """Autotune cache key per (shape, backend)."""
+def shape_key(shape, backend: str, rounds: int | None = None) -> tuple:
+    """Autotune cache key per (shape, backend[, K]): the 2-tuple form
+    keys the overall plan/tile, the 3-tuple form (rounds=K) keys the
+    per-K tile winners the joint sweep records."""
     try:
         dims = dataclasses.astuple(shape)
     except TypeError:  # pragma: no cover - non-dataclass shape stand-ins
         dims = tuple(sorted(vars(shape).items()))
-    return (dims, backend)
+    key = (dims, backend)
+    if rounds is not None:
+        key += (rounds,)
+    return key
 
 
 # winner tile per shape_key, shared process-wide (FusedCluster and the
-# blocked/sharded schedulers all consult it before sweeping)
+# blocked/sharded schedulers all consult it before sweeping). Keys are
+# (shape, backend) for the overall winner and (shape, backend, K) for the
+# per-K winners the joint sweep also records.
 _TILE_CACHE: dict[tuple, int] = {}
+# overall (tile_lanes, rounds_per_call) winner per (shape, backend)
+_PLAN_CACHE: dict[tuple, tuple[int, int]] = {}
 
 
 def cached_tile(key: tuple) -> int | None:
@@ -210,9 +325,18 @@ def remember_tile(key: tuple, tile_lanes: int) -> None:
     _TILE_CACHE[key] = tile_lanes
 
 
+def cached_plan(key: tuple) -> tuple[int, int] | None:
+    return _PLAN_CACHE.get(key)
+
+
+def remember_plan(key: tuple, tile_lanes: int, rounds_per_call: int) -> None:
+    _PLAN_CACHE[key] = (tile_lanes, rounds_per_call)
+
+
 def autotune_tile(n: int, v: int, *, key: tuple, time_fn) -> int:
     """Sweep tile_candidates with the caller's `time_fn(tile) -> seconds`
-    (warmed, post-compile) and cache the winner under `key`."""
+    (warmed, post-compile) and cache the winner under `key`. Tile-only
+    sweep for callers with a pinned K; autotune_plan is the joint form."""
     hit = cached_tile(key)
     if hit is not None:
         return hit
@@ -223,6 +347,41 @@ def autotune_tile(n: int, v: int, *, key: tuple, time_fn) -> int:
             best, best_t = dt, t
     remember_tile(key, best_t)
     return best_t
+
+
+def autotune_plan(
+    n: int,
+    v: int,
+    *,
+    key: tuple,
+    time_fn,
+    tiles=None,
+    rounds=ROUND_CANDIDATES,
+) -> tuple[int, int]:
+    """Joint (tile, K) sweep with the caller's `time_fn(tile, k) ->
+    seconds per ROUND` (warmed, post-compile). Caches the per-K tile
+    winner under `key + (k,)` — the (shape, backend, K) contract — and
+    the overall (tile, K) plan (plus its tile) under the plain `key`.
+    `tiles` restricts the tile axis (a pinned RAFT_TPU_PALLAS_TILE still
+    sweeps K)."""
+    hit = cached_plan(key)
+    if hit is not None:
+        return hit
+    tiles = tuple(tiles) if tiles is not None else tuple(tile_candidates(n, v))
+    best = None  # (dt, tile, k)
+    for k in rounds:
+        validate_round_plan(k)
+        best_k = None  # (dt, tile)
+        for t in tiles:
+            dt = time_fn(t, k)
+            if best_k is None or dt < best_k[0]:
+                best_k = (dt, t)
+            if best is None or dt < best[0]:
+                best = (dt, t, k)
+        remember_tile(key + (k,), best_k[1])
+    remember_tile(key, best[1])
+    remember_plan(key, best[1], best[2])
+    return best[1], best[2]
 
 
 # --------------------------------------------------------------------------
@@ -238,6 +397,7 @@ def pallas_rounds(
     v: int,
     tile_lanes: int,
     n_rounds: int,
+    rounds_per_call: int = 1,
     do_tick: bool = True,
     auto_propose: bool = False,
     auto_compact_lag: int | None = None,
@@ -248,16 +408,21 @@ def pallas_rounds(
     trace=None,
     trace_lane_offset=None,
 ):
-    """n_rounds fused rounds, each as ONE pallas_call over group-aligned
-    lane tiles. Same contract and bit-identical trajectories as
-    ops/fused.py fused_rounds (minus straddle support) — see module doc.
+    """n_rounds fused rounds as a scan of K-round megakernel pallas_calls
+    over group-aligned lane tiles (rounds_per_call = K), plus one
+    remainder-sized call when K does not divide n_rounds. Same contract
+    and bit-identical trajectories as ops/fused.py fused_rounds (minus
+    straddle support) at every K — see module doc.
 
     trace: the flight-recorder carry rides the scan OUTSIDE the kernel —
-    transition detection diffs the (pre, post) fat states the kernel
-    already exchanges with the scan body (trace/device.py record_round),
-    so the kernel itself is unchanged (no VMEM growth) and the event
-    stream is bit-identical to the XLA engine's by construction."""
+    transition detection diffs the (pre, post) fat states each call
+    exchanges with the scan body (trace/device.py record_round). Those
+    boundary states only exist per round at K=1, so a trace-enabled run
+    routes to rounds_per_call=1 (the kernel itself is unchanged, no VMEM
+    growth, and the event stream is bit-identical to the XLA engine's by
+    construction)."""
     maybe_force_fail()
+    validate_round_plan(rounds_per_call)
     state = slim_state(state)
     fab = fmod.slim_fabric(fab)
     n = state.term.shape[0]
@@ -274,116 +439,16 @@ def pallas_rounds(
     ls, lf, lo = len(flat_s), len(flat_f), len(flat_o)
     grid = (n // tile_lanes,)
 
-    K = len(metmod.COUNTERS)
-    B = metmod.N_BUCKETS
-    ch_off = (K + B + 1) if has_met else 0
+    nc = len(metmod.COUNTERS)
+    nb = metmod.N_BUCKETS
+    ch_off = (nc + nb + 1) if has_met else 0
 
     def lane_spec(x):
         bs = (tile_lanes,) + x.shape[1:]
         nd = x.ndim
         return pl.BlockSpec(bs, lambda i, nd=nd: (i,) + (0,) * (nd - 1))
 
-    def kernel(*refs):
-        pos = 0
-
-        def take(k):
-            nonlocal pos
-            out = list(refs[pos : pos + k])
-            pos += k
-            return out
-
-        s_in, f_in, o_in = take(ls), take(lf), take(lo)
-        mute_ref = take(1)[0] if has_mute else None
-        samp_in = take(2) if has_met else None
-        knob_in = take(len(_CH_KNOBS)) if has_ch else None
-        probe_in = take(len(_CH_PROBE)) if has_ch else None
-        scal_ref = take(1)[0] if has_scal else None
-        s_out, f_out = take(ls), take(lf)
-        samp_out = take(2) if has_met else None
-        probe_out = take(len(_CH_PROBE)) if has_ch else None
-        part_ref = take(1)[0] if has_scal else None
-
-        st = fat_state(jax.tree.unflatten(tree_s, [r[...] for r in s_in]))
-        fb = fmod.fat_fabric(
-            jax.tree.unflatten(tree_f, [r[...] for r in f_in])
-        )
-        op = jax.tree.unflatten(tree_o, [r[...] for r in o_in])
-        mt = mute_ref[...] if has_mute else None
-        pm = fmod.aligned_peer_mute(mt, v) if has_mute else None
-        inb = fmod.route_fabric(fb, v, mt, peer_mute=pm)
-
-        # global index of this tile's first lane: the chaos PRNG streams
-        # are functions of global lane, so tiling is invisible to them
-        lane_off = pl.program_id(0) * tile_lanes
-
-        tick_mask = None
-        ch_t = None
-        if has_ch:
-            knobs = {k: r[...] for k, r in zip(_CH_KNOBS, knob_in)}
-            probes = {k: r[...] for k, r in zip(_CH_PROBE, probe_in)}
-            ch_t = chmod.ChaosState(
-                seed=jax.lax.bitcast_convert_type(scal_ref[0, 3], U32),
-                round=scal_ref[0, 1],
-                heal_round=scal_ref[0, 2],
-                n_reelected=jnp.zeros((), I32),
-                n_recommitted=jnp.zeros((), I32),
-                **knobs,
-                **probes,
-            )
-            ch_t, st, inb, op, tick_mask = chmod.begin_round(
-                ch_t, st, inb, op, v, lane_offset=lane_off
-            )
-        mt_t = None
-        if has_met:
-            # zero-based counter slots: the kernel computes this tile's
-            # DELTA; the true running totals never enter the kernel
-            mt_t = metmod.MetricsState(
-                counters=jnp.zeros((K,), I32),
-                hist=jnp.zeros((B,), I32),
-                lat_sum=jnp.zeros((), I32),
-                round_ctr=scal_ref[0, 0],
-                samp_index=samp_in[0][...],
-                samp_round=samp_in[1][...],
-            )
-        res = fmod.fused_round(
-            st,
-            inb,
-            op,
-            mt,
-            peer_mute=pm,
-            do_tick=do_tick,
-            auto_propose=auto_propose,
-            auto_compact_lag=auto_compact_lag,
-            tick_mask=tick_mask,
-            metrics=mt_t,
-        )
-        st2, f2 = res[0], res[1]
-        mt2 = res[2] if has_met else None
-        if has_ch:
-            ch_t, f2 = chmod.end_round(
-                ch_t, st2, fb, f2, v, lane_offset=lane_off
-            )
-        for r, x in zip(s_out, jax.tree.leaves(slim_state(st2))):
-            r[...] = x
-        for r, x in zip(f_out, jax.tree.leaves(fmod.slim_fabric(f2))):
-            r[...] = x
-        if has_met:
-            samp_out[0][...] = mt2.samp_index
-            samp_out[1][...] = mt2.samp_round
-        if has_ch:
-            for r, k in zip(probe_out, _CH_PROBE):
-                r[...] = getattr(ch_t, k)
-        if has_scal:
-            parts = []
-            if has_met:
-                parts += [mt2.counters, mt2.hist, mt2.lat_sum[None]]
-            if has_ch:
-                parts += [ch_t.n_reelected[None], ch_t.n_recommitted[None]]
-            row = jnp.concatenate(parts)
-            row = jnp.pad(row, (0, PARTIAL_WIDTH - row.shape[0]))
-            part_ref[...] = row[None, :]
-
-    # -- specs / shapes -----------------------------------------------------
+    # -- shared specs / shapes (partials are per-K, added in make_call) ----
     in_specs = [lane_spec(x) for x in flat_s + flat_f + flat_o]
     if has_mute:
         in_specs.append(lane_spec(mute))
@@ -403,33 +468,183 @@ def pallas_rounds(
         out_leaves += [getattr(chaos, k) for k in _CH_PROBE]
     out_specs = [lane_spec(x) for x in out_leaves]
     out_shape = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in out_leaves]
-    if has_scal:
-        out_specs.append(pl.BlockSpec((1, PARTIAL_WIDTH), lambda i: (i, 0)))
-        out_shape.append(
-            jax.ShapeDtypeStruct((grid[0], PARTIAL_WIDTH), jnp.int32)
+
+    def make_call(kc: int):
+        """One pallas_call running kc rounds per grid step with the
+        tile's carry resident in VMEM throughout (the megakernel)."""
+
+        def kernel(*refs):
+            pos = 0
+
+            def take(m):
+                nonlocal pos
+                out = list(refs[pos : pos + m])
+                pos += m
+                return out
+
+            s_in, f_in, o_in = take(ls), take(lf), take(lo)
+            mute_ref = take(1)[0] if has_mute else None
+            samp_in = take(2) if has_met else None
+            knob_in = take(len(_CH_KNOBS)) if has_ch else None
+            probe_in = take(len(_CH_PROBE)) if has_ch else None
+            scal_ref = take(1)[0] if has_scal else None
+            s_out, f_out = take(ls), take(lf)
+            samp_out = take(2) if has_met else None
+            probe_out = take(len(_CH_PROBE)) if has_ch else None
+            part_ref = take(1)[0] if has_scal else None
+
+            st = fat_state(
+                jax.tree.unflatten(tree_s, [r[...] for r in s_in])
+            )
+            fb = fmod.fat_fabric(
+                jax.tree.unflatten(tree_f, [r[...] for r in f_in])
+            )
+            op = jax.tree.unflatten(tree_o, [r[...] for r in o_in])
+            # in-kernel rounds k>0 of an ops_first_round_only dispatch see
+            # zero ops: the one global round that applies ops is k==0 of
+            # the FIRST call (the scan body zeroes the later calls' leaves)
+            op_zero = (
+                jax.tree.map(jnp.zeros_like, op)
+                if (kc > 1 and ops_first_round_only)
+                else None
+            )
+            mt = mute_ref[...] if has_mute else None
+            pm = fmod.aligned_peer_mute(mt, v) if has_mute else None
+
+            # global index of this tile's first lane: the chaos PRNG
+            # streams are functions of global lane, so tiling is invisible
+            lane_off = pl.program_id(0) * tile_lanes
+
+            ch_t = None
+            if has_ch:
+                knobs = {k: r[...] for k, r in zip(_CH_KNOBS, knob_in)}
+                probes = {k: r[...] for k, r in zip(_CH_PROBE, probe_in)}
+                ch_t = chmod.ChaosState(
+                    seed=jax.lax.bitcast_convert_type(scal_ref[0, 3], U32),
+                    round=scal_ref[0, 1],
+                    heal_round=scal_ref[0, 2],
+                    n_reelected=jnp.zeros((), I32),
+                    n_recommitted=jnp.zeros((), I32),
+                    **knobs,
+                    **probes,
+                )
+            mt_t = None
+            if has_met:
+                # zero-based counter slots: the kernel computes DELTAS;
+                # the true running totals never enter the kernel
+                mt_t = metmod.MetricsState(
+                    counters=jnp.zeros((nc,), I32),
+                    hist=jnp.zeros((nb,), I32),
+                    lat_sum=jnp.zeros((), I32),
+                    round_ctr=scal_ref[0, 0],
+                    samp_index=samp_in[0][...],
+                    samp_round=samp_in[1][...],
+                )
+
+            rows = []
+            st2 = f2 = mt2 = None
+            for k in range(kc):
+                if k:
+                    # replay the inter-round slim<->fat casts in-register:
+                    # bit-identity with the XLA scan (and with K=1, where
+                    # these casts happen across the HBM carry) depends on
+                    # crossing the exact same dtype boundary every round
+                    st = fat_state(slim_state(st2))
+                    fb = fmod.fat_fabric(fmod.slim_fabric(f2))
+                    if has_met:
+                        # fresh delta slots per round (per-round partials
+                        # rows); the sampler + round counter thread on
+                        mt_t = dataclasses.replace(
+                            mt2,
+                            counters=jnp.zeros((nc,), I32),
+                            hist=jnp.zeros((nb,), I32),
+                            lat_sum=jnp.zeros((), I32),
+                        )
+                op_k = op_zero if (k and ops_first_round_only) else op
+                inb = fmod.route_fabric(fb, v, mt, peer_mute=pm)
+                tick_mask = None
+                if has_ch:
+                    ch_t, st, inb, op_k, tick_mask = chmod.begin_round(
+                        ch_t, st, inb, op_k, v, lane_offset=lane_off
+                    )
+                res = fmod.fused_round(
+                    st,
+                    inb,
+                    op_k,
+                    mt,
+                    peer_mute=pm,
+                    do_tick=do_tick,
+                    auto_propose=auto_propose,
+                    auto_compact_lag=auto_compact_lag,
+                    tick_mask=tick_mask,
+                    metrics=mt_t,
+                )
+                st2, f2 = res[0], res[1]
+                mt2 = res[2] if has_met else None
+                if has_ch:
+                    ch_t, f2 = chmod.end_round(
+                        ch_t, st2, fb, f2, v, lane_offset=lane_off
+                    )
+                if has_scal:
+                    parts = []
+                    if has_met:
+                        parts += [mt2.counters, mt2.hist, mt2.lat_sum[None]]
+                    if has_ch:
+                        parts += [
+                            ch_t.n_reelected[None],
+                            ch_t.n_recommitted[None],
+                        ]
+                    row = jnp.concatenate(parts)
+                    rows.append(
+                        jnp.pad(row, (0, PARTIAL_WIDTH - row.shape[0]))
+                    )
+            for r, x in zip(s_out, jax.tree.leaves(slim_state(st2))):
+                r[...] = x
+            for r, x in zip(f_out, jax.tree.leaves(fmod.slim_fabric(f2))):
+                r[...] = x
+            if has_met:
+                samp_out[0][...] = mt2.samp_index
+                samp_out[1][...] = mt2.samp_round
+            if has_ch:
+                for r, name in zip(probe_out, _CH_PROBE):
+                    r[...] = getattr(ch_t, name)
+            if has_scal:
+                part_ref[...] = jnp.stack(rows)[:, None, :]
+
+        out_specs_k = list(out_specs)
+        out_shape_k = list(out_shape)
+        if has_scal:
+            out_specs_k.append(
+                pl.BlockSpec((kc, 1, PARTIAL_WIDTH), lambda i: (0, i, 0))
+            )
+            out_shape_k.append(
+                jax.ShapeDtypeStruct(
+                    (kc, grid[0], PARTIAL_WIDTH), jnp.int32
+                )
+            )
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs_k,
+            out_shape=out_shape_k,
+            interpret=interpret,
         )
 
-    call = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=out_specs,
-        out_shape=out_shape,
-        interpret=interpret,
-    )
-
-    # -- scan over rounds ---------------------------------------------------
-    def body(carry, i):
+    # -- one K-round dispatch ----------------------------------------------
+    def run_block(callee, kc, carry, first):
         fs, ff, met, ch, tr = carry
-        # pre-round captures for the flight recorder: the carry state
-        # before the kernel, the chaos carry before its round advance
+        # pre-round captures for the flight recorder (kc == 1 whenever tr
+        # is not None): the carry state before the kernel, the chaos carry
+        # before its round advance
         st_pre = (
-            fat_state(jax.tree.unflatten(tree_s, fs)) if tr is not None else None
+            fat_state(jax.tree.unflatten(tree_s, fs))
+            if tr is not None
+            else None
         )
         ch_pre = ch
         o_leaves = flat_o
         if ops_first_round_only:
-            first = i == 0
             o_leaves = [
                 jnp.where(first, x, jnp.zeros_like(x)) for x in flat_o
             ]
@@ -455,13 +670,13 @@ def pallas_rounds(
                     ]
                 ).reshape(1, 4)
             )
-        out = list(call(*inputs))
+        out = list(callee(*inputs))
         pos = 0
 
-        def take(k):
+        def take(m):
             nonlocal pos
-            res = out[pos : pos + k]
-            pos += k
+            res = out[pos : pos + m]
+            pos += m
             return res
 
         new_fs, new_ff = take(ls), take(lf)
@@ -470,37 +685,62 @@ def pallas_rounds(
         if has_ch:
             probes = take(len(_CH_PROBE))
         if has_scal:
-            parts = jnp.sum(take(1)[0], axis=0)  # [PARTIAL_WIDTH] i32
+            # [kc, n_tiles, W] per-round rows -> [kc, W] tile-reduced
+            parts = jnp.sum(take(1)[0], axis=1)
             if has_met:
+                # metrics slots are deltas: fold the kc rounds too (i32
+                # wrap-add is associative — exact vs kc sequential adds)
+                dsum = jnp.sum(parts, axis=0)
                 met = dataclasses.replace(
                     met,
-                    counters=met.counters + parts[:K],
-                    hist=met.hist + parts[K : K + B],
-                    lat_sum=met.lat_sum + parts[K + B],
-                    round_ctr=met.round_ctr + 1,
+                    counters=met.counters + dsum[:nc],
+                    hist=met.hist + dsum[nc : nc + nb],
+                    lat_sum=met.lat_sum + dsum[nc + nb],
+                    round_ctr=met.round_ctr + kc,
                     samp_index=samp_i,
                     samp_round=samp_r,
                 )
             if has_ch:
+                # chaos slots are absolute recounts: the LAST round's row
                 ch = dataclasses.replace(
                     ch,
                     **dict(zip(_CH_PROBE, probes)),
-                    n_reelected=parts[ch_off],
-                    n_recommitted=parts[ch_off + 1],
-                    round=ch.round + 1,
+                    n_reelected=parts[kc - 1, ch_off],
+                    n_recommitted=parts[kc - 1, ch_off + 1],
+                    round=ch.round + kc,
                 )
         if tr is not None:
             st_post = fat_state(jax.tree.unflatten(tree_s, new_fs))
             tr = trmod.record_round(
-                tr, st_pre, st_post, chaos=ch_pre, lane_offset=trace_lane_offset
+                tr,
+                st_pre,
+                st_post,
+                chaos=ch_pre,
+                lane_offset=trace_lane_offset,
             )
-        return (new_fs, new_ff, met, ch, tr), None
+        return (new_fs, new_ff, met, ch, tr)
 
-    (flat_s, flat_f, metrics, chaos, trace), _ = jax.lax.scan(
-        body,
-        (flat_s, flat_f, metrics, chaos, trace),
-        jnp.arange(n_rounds, dtype=I32),
-    )
+    # -- scan of full-K calls + remainder tail -----------------------------
+    kc = rounds_per_call
+    if trace is not None and kc != 1:
+        # per-round boundary states for the diff detector only exist at
+        # K=1 (module doc); the routing is silent and bit-exact
+        kc = 1
+    kc = max(1, min(kc, n_rounds)) if n_rounds else 1
+    n_full, rem = divmod(n_rounds, kc)
+
+    carry = (flat_s, flat_f, metrics, chaos, trace)
+    if n_full:
+        call_main = make_call(kc)
+
+        def body(c, i):
+            return run_block(call_main, kc, c, i == 0), None
+
+        carry, _ = jax.lax.scan(body, carry, jnp.arange(n_full, dtype=I32))
+    if rem:
+        # a second, remainder-sized megakernel program in the same trace
+        carry = run_block(make_call(rem), rem, carry, n_full == 0)
+    flat_s, flat_f, metrics, chaos, trace = carry
     res = (
         jax.tree.unflatten(tree_s, flat_s),
         jax.tree.unflatten(tree_f, flat_f),
@@ -518,6 +758,7 @@ _PALLAS_STATIC = (
     "v",
     "tile_lanes",
     "n_rounds",
+    "rounds_per_call",
     "do_tick",
     "auto_propose",
     "auto_compact_lag",

@@ -83,6 +83,18 @@ def joint_committed(match, mask_in, mask_out):
     )
 
 
+def joint_committed_dispatch(match, mask_in, mask_out, **kw):
+    """Engine-dispatching twin of joint_committed for standalone batched
+    reductions: routes to the Pallas quorum kernel by default
+    (RAFT_TPU_QUORUM_PALLAS, see ops/quorum_pallas.py — the lane-major
+    kernel no longer pays a per-operand relayout). Accepts [N, V]
+    operands only. The fused round does NOT go through here — its quorum
+    math stays inline jnp so XLA fuses it into neighboring phases."""
+    from raft_tpu.ops import quorum_pallas as qp
+
+    return qp.joint_committed_dispatch(match, mask_in, mask_out, **kw)
+
+
 def joint_vote(votes, mask_in, mask_out):
     """Both halves must win; either Lost loses. reference: quorum/joint.go:61-75."""
     r1 = majority_vote(votes, mask_in)

@@ -4,38 +4,39 @@ The reference computes a group's commit index by sorting <=9 acked indexes
 and picking element n-(n/2+1) (quorum/majority.go:126-172); SURVEY §7 names
 the batched form — "commit-index reduction at 1M x 7 with mixed masks/joint
 configs" — as the make-or-break kernel and prescribes a fixed sorting
-network. This module is that kernel: match/mask tiles are processed
-voter-major ([V, TILE] blocks, V padded to the 8-sublane tile), the sort is
-an odd-even transposition network of elementwise min/max over [TILE] lanes
-(VPU-native, no sort HLO, no gather), selection is a masked sum, and the
-joint-config form fuses BOTH halves' reductions plus their min into one
-VMEM-resident pass — zero intermediate HBM round-trips.
+network. This module is that kernel: the sort is an odd-even transposition
+network of elementwise min/max over [TILE] lane vectors (VPU-native, no
+sort HLO, no gather), selection is a masked sum, and the joint-config form
+fuses BOTH halves' reductions plus their min into one VMEM-resident pass —
+zero intermediate HBM round-trips.
 
-The XLA path (ops/quorum.py) stays the default — measured on a v5e-1 at the
-SURVEY headline shape (1M groups x 7 voters, bit-exact outputs):
+History: this kernel originally tiled its operands voter-major and paid a
+full [N, V] -> [V, N] HBM relayout per operand before the grid even ran —
+measured at 1M x 7 on a v5e-1 that relayout dominated (joint: XLA 2.49 ms
+vs Pallas 5.77 ms, with ~0.1 ms of actual VPU reduction work), so the
+dispatch defaulted to XLA. That relayout is gone: the kernels now read the
+operands in their NATIVE lane-major [N, V] layout — [TILE, VPAD] blocks,
+VPAD the 8-sublane int32 tile — and peel the V voter columns in VMEM,
+where the shuffle is on-chip register traffic instead of an HBM round
+trip. With the relayout eliminated the old argument for the XLA default is
+obsolete, and `joint_committed_dispatch` routes joint configs to THIS
+kernel by default (RAFT_TPU_QUORUM_PALLAS=0 restores XLA; outputs are
+bit-identical either way, tests/test_quorum_pallas.py). A Mosaic lowering
+failure degrades to the XLA path with a once-logged engine event
+(metrics/host.py record_engine_fallback), mirroring the full-round
+engine's posture (ops/pallas_round.py).
 
-    majority_committed   XLA 3.16 ms   Pallas 3.14 ms
-    joint_committed      XLA 2.49 ms   Pallas 5.77 ms
+For callers that can keep the quorum operands voter-major IN THEIR CARRY
+(amortizing one layout change over many reductions), `pack_voter_major` +
+`joint_committed_packed` expose the zero-relayout fast path: the packed
+[VPAD, N_pad] operands feed a voter-major kernel directly and no per-call
+layout work remains at all.
 
-Both paths are dominated by the [N, V] -> [V, N] relayout the voter-major
-tiling needs (the reduction itself is ~0.1 ms of VPU work), and inside the
-fused round kernel XLA additionally fuses the quorum math into its
-neighbors, which a pallas_call boundary would prevent. So this kernel is
-kept as a validated, benchmarked alternative (tests/test_quorum_pallas.py
-asserts bit-equality in interpret mode and the TPU microbench above runs it
-compiled), not wired in by default.
-
-The joint form deserves emphasis: even though `_joint_kernel` already fuses
-both halves' reductions AND their min into one VMEM pass (there is nothing
-left to fuse), it pays the relayout TWICE (three [N, V] operands vs two) and
-XLA's joint path shares the transposed operand between halves — hence
-2.3x slower despite the tighter kernel. `joint_committed_dispatch` below
-therefore routes joint configs to the XLA path by default; the pallas
-kernel runs only on explicit request (engine="pallas" or
-RAFT_TPU_QUORUM_PALLAS=1), mirroring the opt-in posture of the full-round
-engine (ops/pallas_round.py, RAFT_TPU_ENGINE=pallas) where the whole round
-— not one reduction — crosses the pallas_call boundary and the relayout
-amortizes over every phase.
+Note the fused round (ops/fused.py) does NOT call this dispatch: its
+quorum math inlines as jnp inside the round body, where XLA fuses it into
+neighboring phases — a pallas_call boundary there would break that fusion.
+This kernel serves the standalone batched reduction (ops/quorum.py
+callers operating outside the fused round).
 """
 
 from __future__ import annotations
@@ -67,13 +68,14 @@ def _sorted_cols(vals, v):
     return cols
 
 
-def _reduce_half(match_ref, mask_ref, v):
-    """One majority reduction over a [VPAD, TILE] block: returns ([TILE]
-    committed, [TILE] n==0 flag)."""
+def _reduce_half(match_cols, mask_cols, v):
+    """One majority reduction over per-voter [TILE] vectors: returns
+    ([TILE] committed, [TILE] n==0 flag). Layout-agnostic — the caller
+    peels the voter vectors from whichever block layout it read."""
     rows = [
-        jnp.where(mask_ref[j, :] != 0, match_ref[j, :], -1) for j in range(v)
+        jnp.where(mask_cols[j] != 0, match_cols[j], -1) for j in range(v)
     ]
-    n = sum((mask_ref[j, :] != 0).astype(I32) for j in range(v))
+    n = sum((mask_cols[j] != 0).astype(I32) for j in range(v))
     q = n // 2 + 1
     srt = _sorted_cols(rows, v)
     # element v - q of the ascending array (see quorum.py: V-n pad values of
@@ -85,67 +87,142 @@ def _reduce_half(match_ref, mask_ref, v):
     return picked, n == 0
 
 
+def _lane_cols(ref, v):
+    """Peel the V voter columns of a lane-major [TILE, VPAD] block into
+    [TILE] vectors. This is the in-VMEM replacement for the old HBM
+    [N, V] -> [V, N] relayout: the shuffle happens on-chip, per tile."""
+    blk = ref[...]
+    return [blk[:, j] for j in range(v)]
+
+
 def _committed_kernel(match_ref, mask_ref, out_ref, *, v):
-    picked, empty = _reduce_half(match_ref, mask_ref, v)
+    picked, empty = _reduce_half(
+        _lane_cols(match_ref, v), _lane_cols(mask_ref, v), v
+    )
     out_ref[0, :] = jnp.where(empty, COMMITTED_INF, picked)
 
 
 def _joint_kernel(match_ref, in_ref, out_m_ref, out_ref, *, v):
-    a, a_empty = _reduce_half(match_ref, in_ref, v)
-    b, b_empty = _reduce_half(match_ref, out_m_ref, v)
+    m_cols = _lane_cols(match_ref, v)
+    a, a_empty = _reduce_half(m_cols, _lane_cols(in_ref, v), v)
+    b, b_empty = _reduce_half(m_cols, _lane_cols(out_m_ref, v), v)
     a = jnp.where(a_empty, COMMITTED_INF, a)
     b = jnp.where(b_empty, COMMITTED_INF, b)
     out_ref[0, :] = jnp.minimum(a, b)
 
 
-def _pad(x, n_pad, v):
-    """[N, V] -> [VPAD, N_pad] voter-major."""
+def _vm_cols(ref, v):
+    """Voter rows of a packed voter-major [VPAD, TILE] block."""
+    return [ref[j, :] for j in range(v)]
+
+
+def _joint_kernel_vm(match_ref, in_ref, out_m_ref, out_ref, *, v):
+    m_cols = _vm_cols(match_ref, v)
+    a, a_empty = _reduce_half(m_cols, _vm_cols(in_ref, v), v)
+    b, b_empty = _reduce_half(m_cols, _vm_cols(out_m_ref, v), v)
+    a = jnp.where(a_empty, COMMITTED_INF, a)
+    b = jnp.where(b_empty, COMMITTED_INF, b)
+    out_ref[0, :] = jnp.minimum(a, b)
+
+
+def _pad_lanes(x, n_pad, v):
+    """[N, V] -> [N_pad, VPAD] lane-major: a pure pad, layout-preserving —
+    no transpose, no HBM relayout."""
     n = x.shape[0]
-    xt = jnp.swapaxes(x.astype(I32), 0, 1)  # [V, N]
+    return jnp.pad(x.astype(I32), ((0, n_pad - n), (0, _VPAD - v)))
+
+
+def pack_voter_major(x):
+    """[N, V] -> [VPAD, N_pad] voter-major, the ONE-TIME layout change for
+    carries that feed joint_committed_packed many times. Padding with
+    zeros is correct for both masks (0 = absent voter) and match values
+    (masked before use)."""
+    n, v = x.shape
+    n_pad = -(-n // _TILE) * _TILE
+    xt = jnp.swapaxes(x.astype(I32), 0, 1)
     return jnp.pad(xt, ((0, _VPAD - v), (0, n_pad - n)))
+
+
+def _out_specs(n_pad):
+    grid = (n_pad // _TILE,)
+    return grid, pl.BlockSpec(
+        (1, _TILE), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def committed_pallas(match, mask, interpret: bool | None = None):
-    """majority_committed on the Pallas path. match/mask: [N, V] -> [N]."""
+    """majority_committed on the Pallas path. match/mask: [N, V] -> [N],
+    read lane-major (native layout, zero relayout)."""
     n, v = match.shape
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     n_pad = -(-n // _TILE) * _TILE
-    grid = (n_pad // _TILE,)
-    spec = pl.BlockSpec((_VPAD, _TILE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    grid, out_spec = _out_specs(n_pad)
+    spec = pl.BlockSpec(
+        (_TILE, _VPAD), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
     out = pl.pallas_call(
         functools.partial(_committed_kernel, v=v),
         out_shape=jax.ShapeDtypeStruct((1, n_pad), I32),
         grid=grid,
         in_specs=[spec, spec],
-        out_specs=pl.BlockSpec((1, _TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_specs=out_spec,
         interpret=interpret,
-    )(_pad(match, n_pad, v), _pad(mask, n_pad, v))
+    )(_pad_lanes(match, n_pad, v), _pad_lanes(mask, n_pad, v))
     return out[0, :n]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def joint_committed_pallas(match, mask_in, mask_out, interpret: bool | None = None):
-    """JointConfig.CommittedIndex fused: both halves + min in one pass."""
+    """JointConfig.CommittedIndex fused: both halves + min in one pass,
+    operands read lane-major (native layout, zero relayout)."""
     n, v = match.shape
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     n_pad = -(-n // _TILE) * _TILE
-    grid = (n_pad // _TILE,)
-    spec = pl.BlockSpec((_VPAD, _TILE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    grid, out_spec = _out_specs(n_pad)
+    spec = pl.BlockSpec(
+        (_TILE, _VPAD), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
     out = pl.pallas_call(
         functools.partial(_joint_kernel, v=v),
         out_shape=jax.ShapeDtypeStruct((1, n_pad), I32),
         grid=grid,
         in_specs=[spec, spec, spec],
-        out_specs=pl.BlockSpec((1, _TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_specs=out_spec,
         interpret=interpret,
     )(
-        _pad(match, n_pad, v),
-        _pad(mask_in, n_pad, v),
-        _pad(mask_out, n_pad, v),
+        _pad_lanes(match, n_pad, v),
+        _pad_lanes(mask_in, n_pad, v),
+        _pad_lanes(mask_out, n_pad, v),
     )
+    return out[0, :n]
+
+
+@functools.partial(jax.jit, static_argnames=("v", "n", "interpret"))
+def joint_committed_packed(
+    match_vm, in_vm, out_vm, *, v: int, n: int,
+    interpret: bool | None = None,
+):
+    """JointConfig.CommittedIndex over pre-packed voter-major operands
+    (pack_voter_major): [VPAD, N_pad] x3 -> [n]. Zero per-call layout work
+    — the fast path for carries that store the operands packed."""
+    n_pad = match_vm.shape[1]
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    grid, out_spec = _out_specs(n_pad)
+    spec = pl.BlockSpec(
+        (_VPAD, _TILE), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        functools.partial(_joint_kernel_vm, v=v),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), I32),
+        grid=grid,
+        in_specs=[spec, spec, spec],
+        out_specs=out_spec,
+        interpret=interpret,
+    )(match_vm, in_vm, out_vm)
     return out[0, :n]
 
 
@@ -153,25 +230,35 @@ def joint_committed_dispatch(
     match, mask_in, mask_out, *, engine: str | None = None,
     interpret: bool | None = None,
 ):
-    """JointConfig.CommittedIndex with the measured-fastest default: XLA
-    (2.49 ms vs the fused kernel's 5.77 ms at 1M x 7 — the kernel pays the
-    voter-major relayout once per operand, see module doc). The pallas
-    kernel runs only on explicit opt-in: engine="pallas" or
-    RAFT_TPU_QUORUM_PALLAS=1. Outputs are bit-identical either way
-    (tests/test_quorum_pallas.py)."""
+    """JointConfig.CommittedIndex, defaulting to the Pallas kernel now
+    that the per-operand voter-major relayout is gone (module doc):
+    engine kwarg > RAFT_TPU_QUORUM_PALLAS env (default 1) > pallas.
+    RAFT_TPU_QUORUM_PALLAS=0 restores the XLA path. Outputs are
+    bit-identical either way (tests/test_quorum_pallas.py). A pallas
+    lowering failure logs one engine event and degrades to XLA."""
     e = engine
     if e is None:
         e = (
             "pallas"
-            if os.environ.get("RAFT_TPU_QUORUM_PALLAS", "0") not in ("0", "")
+            if os.environ.get("RAFT_TPU_QUORUM_PALLAS", "1") not in ("0", "")
             else "xla"
         )
-    if e == "pallas":
-        return joint_committed_pallas(
-            match, mask_in, mask_out, interpret=interpret
-        )
-    if e != "xla":
+    if e not in ("xla", "pallas"):
         raise ValueError(f"unknown engine {e!r}: expected 'xla' or 'pallas'")
     from raft_tpu.ops.quorum import joint_committed
 
+    if e == "pallas":
+        try:
+            return joint_committed_pallas(
+                match, mask_in, mask_out, interpret=interpret
+            )
+        except Exception as err:
+            from raft_tpu.metrics.host import record_engine_fallback
+
+            n, v = match.shape
+            record_engine_fallback(
+                f"joint_committed_dispatch(n={n}, v={v}, "
+                f"backend={jax.default_backend()})",
+                err,
+            )
     return joint_committed(match, mask_in, mask_out)

@@ -374,6 +374,7 @@ class ShardedFusedCluster:
         self.n_shards = len(devices)
         self.lanes_per_shard = n // len(devices)
         self._shard_tile = None
+        self._shard_rounds = None
         if straddle and self.inner.engine == "pallas":
             # the pallas kernel's router is strictly tile-local; the halo
             # ppermute of the straddle path has no kernel analog
@@ -463,6 +464,26 @@ class ShardedFusedCluster:
         self._shard_tile = t
         return t
 
+    def _resolve_shard_rounds(self) -> int:
+        """Megakernel K for the per-shard pallas grid. Explicit ctor
+        rounds_per_call > RAFT_TPU_PALLAS_ROUNDS env > 1; no joint sweep
+        here for the same reason as the tile (timing the collective
+        program times the mesh, not the kernel). Validated up front —
+        config errors, never engine fallbacks."""
+        if self._shard_rounds is not None:
+            return self._shard_rounds
+        from raft_tpu.ops import pallas_round as plr
+        from raft_tpu.ops.fused import _SCAN_UNROLL
+
+        k = self.inner._rounds_req
+        if k is None:
+            k = plr.env_rounds_per_call()
+        if k is None:
+            k = 1
+        plr.validate_round_plan(k, unroll=_SCAN_UNROLL)
+        self._shard_rounds = k
+        return k
+
     def run(self, rounds: int = 1, ops=None, do_tick: bool = True,
             auto_propose: bool = False, auto_compact_lag=None, trace=None):
         """trace: an optional runtime.trace.TraceStream — the stacked
@@ -491,7 +512,11 @@ class ShardedFusedCluster:
         extras = [x for x in (met, ch, tr) if x is not None]
         engine = self.inner.engine
         tile = interp = None
+        rpc = 1
         if engine == "pallas":
+            # K/unroll validation is a config error and must propagate —
+            # resolve it OUTSIDE the fallback try
+            rpc = self._resolve_shard_rounds()
             # tile/force-fail problems surface here, before the carry is
             # handed to a donating dispatch (TileErrors still propagate)
             try:
@@ -503,7 +528,8 @@ class ShardedFusedCluster:
             except Exception as e:
                 self._fall_back(e)
                 engine = "xla"
-        key = (engine, rounds, do_tick, auto_propose, auto_compact_lag)
+                rpc = 1
+        key = (engine, rounds, do_tick, auto_propose, auto_compact_lag, rpc)
         if key not in self._cache:
 
             def stepper(st, f, o, m, *ex):
@@ -529,6 +555,7 @@ class ShardedFusedCluster:
                     res = plr.pallas_rounds(
                         st, f, o, m,
                         v=self.v, tile_lanes=tile, n_rounds=rounds,
+                        rounds_per_call=rpc,
                         do_tick=do_tick, auto_propose=auto_propose,
                         auto_compact_lag=auto_compact_lag,
                         interpret=interp, metrics=mt, chaos=c,

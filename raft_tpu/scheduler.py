@@ -51,7 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.config import Shape
-from raft_tpu.ops.fused import FusedCluster, LocalOps
+from raft_tpu.ops.fused import _SCAN_UNROLL, FusedCluster, LocalOps
 
 
 class BlockedFusedCluster:
@@ -95,6 +95,23 @@ class BlockedFusedCluster:
             raise ValueError("round_chunk must be >= 1")
         if pipeline_depth is not None and pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1 (or None)")
+        # up-front RAFT_TPU_UNROLL x K x round_chunk composition check for
+        # the pallas megakernel: a K that does not divide round_chunk
+        # compiles an extra remainder-tail kernel per chunk, and
+        # unroll x K explodes the unrolled program — fail HERE with a
+        # clear error, not mid-dispatch inside Mosaic. Only a pinned K
+        # (ctor kwarg or RAFT_TPU_PALLAS_ROUNDS) is checkable this early;
+        # an autotuned K re-validates at resolve time.
+        from raft_tpu.ops import pallas_round as plr
+
+        if plr.resolve_engine(cfg.get("engine")) == "pallas":
+            k_req = cfg.get("rounds_per_call")
+            if k_req is None:
+                k_req = plr.env_rounds_per_call()
+            if k_req is not None:
+                plr.validate_round_plan(
+                    k_req, unroll=_SCAN_UNROLL, round_chunk=round_chunk
+                )
         self.g, self.v = n_groups, n_voters
         self.block_groups = block_groups
         self.k = n_groups // block_groups

@@ -50,7 +50,11 @@ smokes() {
   # smoke (closed-loop p50/p99 + open-loop saturation: exactly-once
   # notify, digest == admission-ordered scalar twin, typed rejections
   # under overload with no deadlock)
-  # ... + the trace A/B smoke (flight recorder on vs off must be
+  # ... + the pallas engine A/B smoke (xla vs pallas K=1 vs the K=AB_K
+  # megakernel: all three arms must land the identical slim_state digest
+  # with no silent engine fallback; the ms/round and bytes-moved gates —
+  # including K>1 moving strictly fewer carry bytes than K=1 — arm on
+  # TPU only) + the trace A/B smoke (flight recorder on vs off must be
   # digest-identical, TRACELOG=0 must trace zero recorder sites, and the
   # drained events must equal the scalar-twin transition stream)
   run_bench benches/metrics_smoke.py \

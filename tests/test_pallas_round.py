@@ -22,6 +22,8 @@ blocked/sharded engine passthrough, and the tile helper unit coverage.
 
 from __future__ import annotations
 
+import hashlib
+
 import numpy as np
 import pytest
 
@@ -59,6 +61,15 @@ def _assert_trees_equal(a, b, what):
 
 def _fallbacks():
     return ENGINE_EVENTS.get("engine_pallas_fallback")
+
+
+def _digest(*trees):
+    """sha256 over every leaf's bytes — the acceptance-criteria digest."""
+    h = hashlib.sha256()
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
 
 
 # -- 1. bit-identity -------------------------------------------------------
@@ -246,6 +257,212 @@ def test_donation_composes_with_pallas_under_fence(monkeypatch):
     _assert_trees_equal(c.fab, d.fab, "donation changed the fabric")
 
 
+# -- megakernel: K rounds per pallas_call ----------------------------------
+
+
+def test_megakernel_bit_identity_with_metrics_and_chaos(monkeypatch):
+    """33 rounds at K=4 leave a remainder tail (33 = 8*4+1), so the scan
+    of full-K megakernels AND the remainder-sized second program are both
+    exercised. Digest-identical (sha256 over every carry leaf) to the XLA
+    fused_rounds and to K=1 pallas, with metrics AND chaos threading
+    through the per-round [K, n_tiles, 128] partials. (One K only: each
+    K variant is a fresh large interpreted program, ~1 min on 1-core CI;
+    the divisible-K and cluster-level tests below cover other K values.)"""
+    k = 4
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "1")
+    c = FusedCluster(G, V, seed=7, shape=_shape())
+    c.set_chaos(
+        drop_num=np.full((N, V), probability(0.2), np.int32),
+        tick_skew_num=np.full(N, probability(0.1), np.int32),
+        heal_round=7,
+    )
+    kw = dict(
+        v=V, n_rounds=33, do_tick=True, auto_propose=True,
+        auto_compact_lag=4, ops_first_round_only=True,
+        metrics=c.metrics, chaos=c.chaos,
+    )
+    ref = fused._fused_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute, straddle=None, **kw
+    )
+    k1 = plr._pallas_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute,
+        tile_lanes=TILE, interpret=True, **kw
+    )
+    got = plr._pallas_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute,
+        tile_lanes=TILE, interpret=True, rounds_per_call=k, **kw
+    )
+    assert len(ref) == len(got) == 4
+    for r, g, what in zip(ref, got, ("state", "fabric", "metrics", "chaos")):
+        _assert_trees_equal(r, g, what)
+    assert _digest(*got) == _digest(*ref) == _digest(*k1)
+
+
+def test_megakernel_divisible_no_tail(monkeypatch):
+    """K | n_rounds: pure scan of full-K calls, no remainder program.
+    K=6 (vs K=4 above) also varies the in-kernel unroll depth."""
+    monkeypatch.setenv("RAFT_TPU_METRICS", "0")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    c = FusedCluster(G, V, seed=3, shape=_shape())
+    kw = dict(
+        v=V, n_rounds=12, do_tick=True, auto_propose=True,
+        auto_compact_lag=4, ops_first_round_only=True,
+        metrics=None, chaos=None,
+    )
+    ref = fused._fused_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute, straddle=None, **kw
+    )
+    got = plr._pallas_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute,
+        tile_lanes=TILE, interpret=True, rounds_per_call=6, **kw
+    )
+    _assert_trees_equal(ref[0], got[0], "state")
+    _assert_trees_equal(ref[1], got[1], "fabric")
+
+
+def test_cluster_megakernel_run_parity(monkeypatch):
+    """The FusedCluster wiring: ctor rounds_per_call flows through
+    _run_pallas into the megakernel dispatch, bit-identical to XLA.
+    (K=2 and few rounds: the kernel-level digest test above already
+    covers K=4 at depth; this one only proves the cluster plumbing.)"""
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    cx = FusedCluster(G, V, seed=2, shape=_shape())
+    cp = FusedCluster(G, V, seed=2, shape=_shape(), engine="pallas",
+                      tile_lanes=TILE, rounds_per_call=2)
+    cx.run(5, auto_propose=True)
+    cp.run(5, auto_propose=True)
+    assert cp.engine == "pallas"
+    assert cp._pallas_rounds == 2
+    _assert_trees_equal(cx.state, cp.state, "cluster state")
+    _assert_trees_equal(cx.metrics, cp.metrics, "cluster metrics")
+
+
+def test_rounds_knob_parse_and_validation(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_PALLAS_ROUNDS", raising=False)
+    assert plr.env_rounds_per_call() is None
+    monkeypatch.setenv("RAFT_TPU_PALLAS_ROUNDS", "4")
+    assert plr.env_rounds_per_call() == 4
+    for bad in ("abc", "0", "-2"):
+        monkeypatch.setenv("RAFT_TPU_PALLAS_ROUNDS", bad)
+        with pytest.raises(ValueError, match="RAFT_TPU_PALLAS_ROUNDS"):
+            plr.env_rounds_per_call()
+    monkeypatch.delenv("RAFT_TPU_PALLAS_ROUNDS", raising=False)
+    plr.validate_round_plan(1)
+    plr.validate_round_plan(plr.MAX_ROUNDS_PER_CALL)
+    with pytest.raises(ValueError, match="MAX_ROUNDS_PER_CALL"):
+        plr.validate_round_plan(plr.MAX_ROUNDS_PER_CALL + 1)
+    with pytest.raises(ValueError, match="integer >= 1"):
+        plr.validate_round_plan(0)
+    with pytest.raises(ValueError, match="unrolled rounds"):
+        plr.validate_round_plan(8, unroll=64)
+    with pytest.raises(ValueError, match="round_chunk"):
+        plr.validate_round_plan(3, round_chunk=4)
+    plr.validate_round_plan(2, round_chunk=4, unroll=2)  # composes fine
+    # the blocked ctor surfaces the composition error up front, for both a
+    # ctor-pinned and an env-pinned K
+    with pytest.raises(ValueError, match="round_chunk"):
+        BlockedFusedCluster(4, 3, block_groups=2, seed=1, shape=_shape(6),
+                            engine="pallas", rounds_per_call=3,
+                            round_chunk=4)
+    monkeypatch.setenv("RAFT_TPU_PALLAS_ROUNDS", "3")
+    with pytest.raises(ValueError, match="round_chunk"):
+        BlockedFusedCluster(4, 3, block_groups=2, seed=1, shape=_shape(6),
+                            engine="pallas", round_chunk=4)
+    # env pin resolves into the cluster's K
+    monkeypatch.setenv("RAFT_TPU_PALLAS_ROUNDS", "2")
+    c = FusedCluster(G, V, seed=1, shape=_shape(), engine="pallas",
+                     tile_lanes=TILE)
+    assert c._resolve_pallas_rounds() == 2
+
+
+def test_trace_plane_routes_to_k1(monkeypatch):
+    """The flight recorder's diff detection needs per-round boundary
+    states outside the kernel, so a trace-enabled run routes to K=1: a
+    rounds_per_call=4 cluster walks the identical state AND ring as K=1."""
+    monkeypatch.setenv("RAFT_TPU_METRICS", "0")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    monkeypatch.setenv("RAFT_TPU_TRACELOG", "1")
+    c1 = FusedCluster(G, V, seed=11, shape=_shape(), engine="pallas",
+                      tile_lanes=TILE, rounds_per_call=1)
+    c4 = FusedCluster(G, V, seed=11, shape=_shape(), engine="pallas",
+                      tile_lanes=TILE, rounds_per_call=4)
+    assert c1.trace is not None and c4.trace is not None
+    c1.run(9, auto_propose=True)
+    c4.run(9, auto_propose=True)
+    assert c4.engine == "pallas"
+    _assert_trees_equal(c1.state, c4.state, "trace-routed state")
+    _assert_trees_equal(c1.trace, c4.trace, "trace ring")
+
+
+def test_donation_composes_with_megakernel(monkeypatch):
+    """Donation x cache-fence x K>1: the donating twin under the jax
+    0.4.37 fence deletes the old carry and changes no value. (K=2 keeps
+    the interpreted program small — the fence forces recompiles, so this
+    test pays the megakernel trace cost 4x.)"""
+    monkeypatch.setenv("RAFT_TPU_METRICS", "0")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    monkeypatch.setenv("RAFT_TPU_DONATE", "1")
+    cache_flag = jax.config.jax_enable_compilation_cache
+    c = FusedCluster(G, V, seed=9, shape=_shape(), engine="pallas",
+                     tile_lanes=TILE, rounds_per_call=2)
+    assert c._donate
+    st0 = c.state
+    c.run(5, auto_propose=True)  # 2 full K=2 calls + a 1-round tail
+    assert c.engine == "pallas"
+    assert st0.term.is_deleted()
+    assert jax.config.jax_enable_compilation_cache == cache_flag
+    c.run(5, auto_propose=True)
+
+    monkeypatch.setenv("RAFT_TPU_DONATE", "0")
+    d = FusedCluster(G, V, seed=9, shape=_shape(), engine="pallas",
+                     tile_lanes=TILE, rounds_per_call=2)
+    d.run(5, auto_propose=True)
+    d.run(5, auto_propose=True)
+    _assert_trees_equal(c.state, d.state, "megakernel donation changed a value")
+    _assert_trees_equal(c.fab, d.fab, "megakernel donation changed the fabric")
+
+
+def test_autotune_plan_joint_sweep():
+    """The joint (tile, K) sweep with a fake timer: overall winner lands
+    in the plan cache AND the plain tile cache, per-K tile winners land
+    under (shape, backend, K), and a warm key never re-times."""
+    n, v = 4096 * 3, 3
+    cands = plr.tile_candidates(n, v)
+    assert len(cands) > 1
+    want_t, want_k = cands[len(cands) // 2], 4
+    timed = []
+
+    def fake_time(t, k):
+        timed.append((t, k))
+        return 0.5 if (t, k) == (want_t, want_k) else 1.0 + t * 1e-9 + k * 1e-3
+
+    key = ("test-autotune-plan", "tpu")
+    assert plr.autotune_plan(n, v, key=key, time_fn=fake_time) == (
+        want_t, want_k,
+    )
+    assert len(timed) == len(cands) * len(plr.ROUND_CANDIDATES)
+    assert plr.cached_plan(key) == (want_t, want_k)
+    assert plr.cached_tile(key) == want_t
+    for k in plr.ROUND_CANDIDATES:
+        assert plr.cached_tile(key + (k,)) in cands
+    n_before = len(timed)
+    assert plr.autotune_plan(n, v, key=key, time_fn=fake_time) == (
+        want_t, want_k,
+    )
+    assert len(timed) == n_before
+    # a pinned tile restricts the tile axis but still sweeps K
+    key2 = ("test-autotune-plan-pinned", "tpu")
+    timed.clear()
+    t_pin = cands[0]
+    tile, k = plr.autotune_plan(
+        n, v, key=key2, time_fn=fake_time, tiles=(t_pin,)
+    )
+    assert tile == t_pin
+    assert len(timed) == len(plr.ROUND_CANDIDATES)
+
+
 # -- satellite: BlockedFusedCluster ops-cache LRU --------------------------
 
 
@@ -305,6 +522,45 @@ def test_sharded_engine_parity(monkeypatch):
     assert sp.inner.engine == "pallas"
     _assert_trees_equal(sx.inner.state, sp.inner.state, "sharded state")
     _assert_trees_equal(sx.inner.metrics, sp.inner.metrics, "sharded metrics")
+
+
+def test_blocked_megakernel_parity(monkeypatch):
+    """RAFT_TPU_PALLAS_ROUNDS=2 on the blocked path: every block resolves
+    K=2, round_chunk=2 dispatches one megakernel call per chunk, and the
+    trajectory matches the XLA blocked run exactly."""
+    monkeypatch.setenv("RAFT_TPU_METRICS", "0")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    monkeypatch.setenv("RAFT_TPU_PALLAS_ROUNDS", "2")
+    bx = BlockedFusedCluster(4, 3, block_groups=2, seed=3, shape=_shape(6))
+    bp = BlockedFusedCluster(4, 3, block_groups=2, seed=3, shape=_shape(6),
+                             engine="pallas", tile_lanes=6, round_chunk=2)
+    bx.run(6, auto_propose=True)
+    bp.run(6, auto_propose=True)
+    for p, x in zip(bp.blocks, bx.blocks):
+        assert p.engine == "pallas"
+        assert p._pallas_rounds == 2
+        _assert_trees_equal(x.state, p.state, "blocked megakernel diverged")
+
+
+def test_sharded_megakernel_parity(monkeypatch):
+    """Per-shard megakernel: K=2 inside shard_map, K in the stepper cache
+    key, metrics psum-merged per dispatch — identical to the XLA mesh."""
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "0")
+    monkeypatch.setenv("RAFT_TPU_PALLAS_ROUNDS", "2")
+    dev = jax.devices()[:2]
+    sx = ShardedFusedCluster(G, V, seed=7, shape=_shape(), engine="xla",
+                             devices=dev)
+    sp = ShardedFusedCluster(G, V, seed=7, shape=_shape(), engine="pallas",
+                             tile_lanes=V, devices=dev)
+    sx.run(7, auto_propose=True)  # 3 full K=2 calls + a 1-round tail
+    sp.run(7, auto_propose=True)
+    assert sp.inner.engine == "pallas"
+    assert sp._shard_rounds == 2
+    assert any(k[-1] == 2 for k in sp._cache)  # K rides the stepper key
+    _assert_trees_equal(sx.inner.state, sp.inner.state, "sharded state")
+    _assert_trees_equal(sx.inner.metrics, sp.inner.metrics,
+                        "sharded metrics")
 
 
 def test_sharded_straddle_vs_pallas(monkeypatch):

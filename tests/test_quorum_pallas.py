@@ -7,10 +7,13 @@ import pytest
 import jax.numpy as jnp
 
 from raft_tpu.ops import quorum as qr
+from raft_tpu.ops import quorum_pallas as qp
 from raft_tpu.ops.quorum_pallas import (
     committed_pallas,
     joint_committed_dispatch,
+    joint_committed_packed,
     joint_committed_pallas,
+    pack_voter_major,
 )
 
 
@@ -37,20 +40,33 @@ def test_joint_matches_xla(v):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
-def test_joint_dispatch_routes_to_xla_by_default(monkeypatch):
-    """Joint configs default to the XLA path (2.3x faster, see module doc);
-    the fused kernel is explicit opt-in — and both agree bit-exactly."""
-    rng = np.random.default_rng(99)
-    n, v = 513, 5
+def _joint_case(seed=99, n=513, v=5):
+    rng = np.random.default_rng(seed)
     match = jnp.asarray(rng.integers(0, 1 << 20, (n, v)), jnp.int32)
     m_in = jnp.asarray(rng.random((n, v)) < 0.8)
     m_out = jnp.asarray(rng.random((n, v)) < 0.4)
+    return match, m_in, m_out
+
+
+def test_joint_dispatch_defaults_to_pallas(monkeypatch):
+    """With the lane-major kernels the per-operand relayout is gone and the
+    joint dispatch defaults to the Pallas kernel (RAFT_TPU_QUORUM_PALLAS
+    unset -> pallas; =0 restores XLA) — both agree bit-exactly."""
+    match, m_in, m_out = _joint_case()
     monkeypatch.delenv("RAFT_TPU_QUORUM_PALLAS", raising=False)
     want = qr.joint_committed(match, m_in, m_out)
+    np.testing.assert_array_equal(
+        np.asarray(
+            joint_committed_dispatch(match, m_in, m_out, interpret=True)
+        ),
+        np.asarray(want),
+    )
+    monkeypatch.setenv("RAFT_TPU_QUORUM_PALLAS", "0")
     np.testing.assert_array_equal(
         np.asarray(joint_committed_dispatch(match, m_in, m_out)),
         np.asarray(want),
     )
+    # explicit kwarg beats env either way
     np.testing.assert_array_equal(
         np.asarray(
             joint_committed_dispatch(
@@ -62,12 +78,63 @@ def test_joint_dispatch_routes_to_xla_by_default(monkeypatch):
     monkeypatch.setenv("RAFT_TPU_QUORUM_PALLAS", "1")
     np.testing.assert_array_equal(
         np.asarray(
-            joint_committed_dispatch(match, m_in, m_out, interpret=True)
+            joint_committed_dispatch(match, m_in, m_out, engine="xla")
         ),
         np.asarray(want),
     )
     with pytest.raises(ValueError, match="unknown engine"):
         joint_committed_dispatch(match, m_in, m_out, engine="bogus")
+
+
+def test_joint_dispatch_falls_back_on_kernel_failure(monkeypatch):
+    """A pallas lowering failure degrades to XLA with a once-logged engine
+    event (metrics/host.py record_engine_fallback) instead of erroring."""
+    from raft_tpu.metrics import host as mhost
+
+    match, m_in, m_out = _joint_case(seed=7)
+    want = qr.joint_committed(match, m_in, m_out)
+
+    def boom(*a, **k):
+        raise RuntimeError("forced quorum kernel failure")
+
+    monkeypatch.setattr(qp, "joint_committed_pallas", boom)
+    before = mhost.ENGINE_EVENTS.get("engine_pallas_fallback")
+    got = joint_committed_dispatch(match, m_in, m_out, engine="pallas")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    after = mhost.ENGINE_EVENTS.get("engine_pallas_fallback")
+    assert after == before + 1
+
+
+def test_joint_dispatch_delegation_via_quorum():
+    """ops/quorum.py re-exports the dispatch for callers that never import
+    the pallas module directly."""
+    match, m_in, m_out = _joint_case(seed=13, n=257, v=3)
+    want = qr.joint_committed(match, m_in, m_out)
+    got = qr.joint_committed_dispatch(
+        match, m_in, m_out, engine="pallas", interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("v", [3, 7])
+def test_joint_packed_matches_xla(v):
+    """The zero-relayout packed path: pack_voter_major once, reduce many
+    times — bit-identical to the XLA joint reduction."""
+    rng = np.random.default_rng(20 + v)
+    n = 1500  # non-multiple of the tile to exercise padding
+    match = jnp.asarray(rng.integers(0, 1 << 20, (n, v)), jnp.int32)
+    m_in = jnp.asarray(rng.random((n, v)) < 0.8)
+    m_out = jnp.asarray(rng.random((n, v)) < 0.4)
+    got = joint_committed_packed(
+        pack_voter_major(match),
+        pack_voter_major(m_in),
+        pack_voter_major(m_out),
+        v=v,
+        n=n,
+        interpret=True,
+    )
+    want = qr.joint_committed(match, m_in, m_out)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_empty_config_is_inf():
