@@ -7,7 +7,13 @@ Two ladders:
   PROBE_BLOCKED=1: every rung = K resident blocks of PROBE_BLOCK_GROUPS
   groups stepped by ONE compiled kernel (scheduler.BlockedFusedCluster) —
   a fresh session pays one compile for the whole ladder and reaches its
-  first north-star measurement in minutes (VERDICT r3 item 8)."""
+  first north-star measurement in minutes (VERDICT r3 item 8).
+
+PROBE_DIET=0/1 forces the diet-v2 packed carry (RAFT_TPU_DIET) off/on for
+every rung, and each rung's JSON line carries live_bytes_per_lane (the
+utils/profiling.py live-buffer probe over the resident carry) — run the
+ladder twice with the knob flipped and the pair is the byte-diet
+acceptance artifact (ISSUE 9: >= 30% lower bytes/lane with diet on)."""
 
 from __future__ import annotations
 
@@ -59,6 +65,9 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
         best = min(best, time.perf_counter() - t0)
     lanes = n_groups * n_voters
     round_ms = 1000 * best / block
+    from raft_tpu.utils.profiling import live_buffer_bytes
+
+    live_per_lane = live_buffer_bytes() / lanes
     mem = {}
     try:
         ms = jax.local_devices()[0].memory_stats() or {}
@@ -80,6 +89,8 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
                 "groups_ticks_per_s": round(n_groups * block / best, 1),
                 "us_per_lane_round": round(1e6 * best / block / lanes, 2),
                 "compile_s": round(compile_s, 1),
+                "diet": int(os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")),
+                "live_bytes_per_lane": round(live_per_lane, 1),
                 **mem,
             }
         ),
@@ -118,6 +129,9 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
         c.block_until_ready()
         best = min(best, time.perf_counter() - t0)
     lanes = n_groups * n_voters
+    from raft_tpu.utils.profiling import live_buffer_bytes
+
+    live_per_lane = live_buffer_bytes() / lanes
     mem = {}
     try:
         ms = jax.local_devices()[0].memory_stats() or {}
@@ -139,6 +153,8 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
                 "groups_ticks_per_s": round(n_groups * block / best, 1),
                 "us_per_lane_round": round(1e6 * best / block / lanes, 2),
                 "compile_s": round(compile_s, 1),
+                "diet": int(os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")),
+                "live_bytes_per_lane": round(live_per_lane, 1),
                 **mem,
             }
         ),
@@ -148,6 +164,10 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
 
 
 if __name__ == "__main__":
+    if os.environ.get("PROBE_DIET") is not None:
+        # the ladder doubles as the diet-v2 acceptance artifact: force the
+        # packed-carry knob off/on for every rung from one place
+        os.environ["RAFT_TPU_DIET"] = os.environ["PROBE_DIET"]
     voters = int(os.environ.get("PROBE_VOTERS", 3))
     w = int(os.environ.get("PROBE_WINDOW", 16))
     e = int(os.environ.get("PROBE_ENTRIES", 2))

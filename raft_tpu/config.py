@@ -51,6 +51,11 @@ class Shape:
     max_msg_entries: int = 8
     max_inflight: int = 8
     max_read_index: int = 4  # outstanding ReadIndex requests per lane ("R")
+    # Largest single entry payload (bytes) the diet-v2 packed carry can
+    # store: log_bytes / rep.ent_bytes narrow to int16 under RAFT_TPU_DIET
+    # (state.pack_state / fused.pack_fabric). A bound, not a shape — it
+    # exists so the int16 claim is validated where the configuration is.
+    max_entry_bytes: int = 32767
     outbox: int = 0  # 0 -> derived
 
     def __post_init__(self):
@@ -64,6 +69,26 @@ class Shape:
             if not 1 <= getattr(self, f) <= 127:
                 raise ValueError(f"{f} must be in 1..127 (int8 carry diet; "
                                  "inbox sizing assumes at least 1)")
+        # the diet-v2 packed carry (state.pack_state) stores the per-peer
+        # bool masks as one bitset word per lane and the rebased index
+        # columns as uint16: V must fit one 32-bit word, and the window
+        # must leave the post-rebase index space far under 2^16
+        if not 1 <= self.max_peers <= 32:
+            raise ValueError(
+                "max_peers must be in 1..32 (diet-v2 packs the [N, V] bool "
+                "masks into one bitset word per lane)"
+            )
+        if self.log_window > 1 << 14:
+            raise ValueError(
+                "log_window must be <= 16384 (diet-v2 stores rebased index "
+                "columns as uint16; the post-rebase space is a few windows "
+                "plus the between-rebase growth budget)"
+            )
+        if not 1 <= self.max_entry_bytes <= 32767:
+            raise ValueError(
+                "max_entry_bytes must be in 1..32767 (diet-v2 stores entry "
+                "size columns as int16)"
+            )
 
     @property
     def n(self) -> int:

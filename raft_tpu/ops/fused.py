@@ -227,6 +227,116 @@ def fat_fabric(fab: Fabric) -> Fabric:
     return _cast_fabric(fab, widen=True)
 
 
+# Diet-v2 fabric extension (state.pack_state's wire-side twin, applied when
+# RAFT_TPU_DIET packs the carry): every bounded term/index/commit column of
+# an in-flight message narrows to uint16 — messages carry values from the
+# sender's (rebased) state, so the state-side u16 invariant covers them —
+# and per-entry payload sizes narrow to int16 under Shape.max_entry_bytes.
+# Host-ticket columns (hb.context, vote.context) stay int32. Empty slots
+# are zeros (ChannelOutbox starts from empty_fabric each round), so packing
+# every cell regardless of kind is exact.
+FABRIC_PACK = {
+    ("rep", "term"): jnp.uint16,
+    ("rep", "index"): jnp.uint16,
+    ("rep", "log_term"): jnp.uint16,
+    ("rep", "commit"): jnp.uint16,
+    ("rep", "reject_hint"): jnp.uint16,
+    ("rep", "snap_index"): jnp.uint16,
+    ("rep", "snap_term"): jnp.uint16,
+    ("rep", "ent_term"): jnp.uint16,
+    ("rep", "ent_bytes"): jnp.int16,
+    ("hb", "term"): jnp.uint16,
+    ("hb", "commit"): jnp.uint16,
+    ("vote", "term"): jnp.uint16,
+    ("vote", "index"): jnp.uint16,
+    ("vote", "log_term"): jnp.uint16,
+    ("vresp", "term"): jnp.uint16,
+    ("self_", "term"): jnp.uint16,
+    ("self_", "index"): jnp.uint16,
+}
+
+
+def is_packed_fabric(fab: Fabric) -> bool:
+    """Diet-v2 fabric layout detector (static under jit: leaf dtype)."""
+    return fab.rep.index.dtype == jnp.uint16
+
+
+def fabric_diet_overflow(fab: Fabric):
+    """[N] bool: any fabric cell of this (unpacked) fabric outside its
+    diet-v2 storage range. Folded into state.error_bits at the store
+    boundary (store_carry) — the fabric has no error column of its own."""
+    from raft_tpu.state import _DIET_RANGE
+
+    n = fab.self_.kind.shape[0]
+    ovf = jnp.zeros((n,), BOOL)
+    if is_packed_fabric(fab):
+        return ovf
+    for (chan_name, field), dt in FABRIC_PACK.items():
+        x = getattr(getattr(fab, chan_name), field)
+        lo, hi = _DIET_RANGE[dt]
+        bad = (x < lo) | (x > hi)
+        while bad.ndim > 1:
+            bad = bad.any(axis=-1)
+        ovf = ovf | bad
+    return ovf
+
+
+def _cast_fabric_map(fab: Fabric, table, widen: bool, clamp: bool) -> Fabric:
+    from raft_tpu.state import _DIET_RANGE
+
+    for (chan_name, field), dt in table.items():
+        chan = getattr(fab, chan_name)
+        x = getattr(chan, field)
+        target = jnp.int32 if widen else dt
+        if x.dtype != target:
+            if clamp and not widen:
+                lo, hi = _DIET_RANGE[dt]
+                x = jnp.clip(x, lo, hi)
+            chan = dataclasses.replace(chan, **{field: x.astype(target)})
+            fab = dataclasses.replace(fab, **{chan_name: chan})
+    return fab
+
+
+def pack_fabric(fab: Fabric) -> Fabric:
+    """Slim/fat -> diet-v2 packed fabric (idempotent). Out-of-range cells
+    clamp; callers fold fabric_diet_overflow into error_bits first
+    (store_carry) so a clamp is never silent."""
+    if is_packed_fabric(fab):
+        return fab
+    return _cast_fabric_map(slim_fabric(fab), FABRIC_PACK, widen=False, clamp=True)
+
+
+def unpack_fabric(fab: Fabric) -> Fabric:
+    """Diet-v2 packed -> the exact slim-canonical fabric (idempotent)."""
+    if not is_packed_fabric(fab):
+        return fab
+    return _cast_fabric_map(fab, FABRIC_PACK, widen=True, clamp=False)
+
+
+def store_carry(state, fab):
+    """Diet-v2 store boundary for a (state, fabric) carry pair: fold the
+    fabric's overflow flags into state.error_bits (never a silent clamp),
+    then pack both. The single definition every engine shares — the XLA
+    scan body and the in-kernel pallas replay must cross the exact same
+    dtype boundary for bit-identity."""
+    from raft_tpu.state import ERR_DIET_OVERFLOW, pack_state
+
+    ovf = fabric_diet_overflow(fab)
+    state = dataclasses.replace(
+        state,
+        error_bits=jnp.asarray(state.error_bits)
+        | jnp.where(ovf, jnp.int32(ERR_DIET_OVERFLOW), jnp.int32(0)),
+    )
+    return pack_state(state), pack_fabric(fab)
+
+
+def load_carry(state, fab):
+    """Diet-v2 load boundary: packed (state, fabric) -> fat compute view."""
+    from raft_tpu.state import fat_state, unpack_state
+
+    return fat_state(unpack_state(state)), fat_fabric(unpack_fabric(fab))
+
+
 def _route_transpose_field(x, v):
     """inbox[g, j, i] = outbox[g, i, j] via an explicit [G,V,V] transpose.
     Readable, but on TPU the [G,V,V,...] intermediates get tile-padded on
@@ -1582,15 +1692,23 @@ def fused_rounds(
     state diff and ring-appended (trace/device.py record_round), and the
     carry is appended to the return tuple. trace_lane_offset (a traced
     scalar, sharded dispatch) globalizes the event lane stamps."""
-    from raft_tpu.state import fat_state, slim_state
+    from raft_tpu.state import fat_state, is_packed, slim_state
 
     if chaos is not None and straddle is not None:
         raise ValueError(
             "chaos plane needs group-aligned lanes; straddling shards are "
             "not supported (its group reductions reshape [N] -> [G, V])"
         )
-    state = slim_state(state)
-    fab = slim_fabric(fab)
+    # diet-v2: a packed carry (bitset masks + u16 indexes, state.pack_state)
+    # stays packed across the scan — the branch is static under jit (leaf
+    # ndim/dtype are part of the signature), so a diet-off cluster compiles
+    # the exact PR-8 program
+    packed = is_packed(state)
+    if packed:
+        state, fab = store_carry(state, fab)
+    else:
+        state = slim_state(state)
+        fab = slim_fabric(fab)
     peer_mute = None
     if mute is not None:
         # loop-invariant across the scan: hoist the [N,V] sender-mute matrix
@@ -1612,8 +1730,11 @@ def fused_rounds(
                 ),
                 ops,
             )
-        st_fat = fat_state(st)
-        f_fat = fat_fabric(f)
+        if packed:
+            st_fat, f_fat = load_carry(st, f)
+        else:
+            st_fat = fat_state(st)
+            f_fat = fat_fabric(f)
         # flight recorder: the pre-round state is captured BEFORE chaos
         # begin_round, so a crash wipe diffs like any leadership loss (and
         # the pre-round chaos carry marks the fault edge itself)
@@ -1651,7 +1772,11 @@ def fused_rounds(
             tr = trmod.record_round(
                 tr, st_pre, st, chaos=ch_pre, lane_offset=trace_lane_offset
             )
-        return (slim_state(st), slim_fabric(f2), met, ch, tr), None
+        if packed:
+            st, f2 = store_carry(st, f2)
+        else:
+            st, f2 = slim_state(st), slim_fabric(f2)
+        return (st, f2, met, ch, tr), None
 
     # a None metrics/chaos/trace slot is an empty pytree: the scan carry
     # shape is unchanged when a plane is off
@@ -1752,7 +1877,7 @@ class FusedCluster:
                 raise ValueError(f"learner id {lid} outside canonical 1..{n_voters}")
             is_learner[:, lid - 1] = True
         lane_cfg = make_lane_config(self.shape, **cfg)
-        from raft_tpu.state import slim_state
+        from raft_tpu.state import diet_enabled, pack_state, slim_state
 
         # the carry lives in the slim storage dtypes from birth so every
         # run() call presents one jit signature (no fat->slim recompile)
@@ -1760,6 +1885,17 @@ class FusedCluster:
             init_state(self.shape, ids, peers, is_learner, seed=seed, cfg=lane_cfg)
         )
         self.fab = slim_fabric(empty_fabric(n, n_voters, self.shape.max_msg_entries))
+        # diet-v2 (RAFT_TPU_DIET, read once at construction): the resident
+        # carry packs down to bitset masks + uint16 rebased indexes
+        # (state.pack_state / pack_fabric); every dispatch widens in-device.
+        # _diet_budget is the host-side headroom counter for the automatic
+        # pre-overflow rebase (_diet_headroom) — 0 forces a device read on
+        # the first run() to seed it.
+        self._diet = diet_enabled()
+        self._diet_budget = 0
+        if self._diet:
+            self.state = pack_state(self.state)
+            self.fab = pack_fabric(self.fab)
         self.mute = jnp.zeros((n,), BOOL)
         # carry donation (see donation_enabled): baked at construction like
         # the metrics flag so a cluster's dispatch behavior never flips
@@ -1829,6 +1965,8 @@ class FusedCluster:
         self._flush_pending_wal()
         self._flush_pending_egress()
         self._flush_pending_trace()
+        if self._diet:
+            self._diet_headroom(rounds)
         res = None
         if self.engine == "pallas":
             res = self._run_pallas(
@@ -1888,7 +2026,11 @@ class FusedCluster:
         if self.trace is not None:
             self.trace = res[i]
         if wal is not None:
-            wal.push(self.state)
+            # the WAL streams the slim-canonical view (byte-identical diet
+            # on/off); unpack_state is the identity when the carry is slim,
+            # and when packed its widened columns are fresh buffers, so the
+            # donation fence semantics are unchanged
+            wal.push(self._wal_view())
             if self._donate:
                 self._wal_pending = wal
         if egress is not None:
@@ -2155,12 +2297,11 @@ class FusedCluster:
         the 2^30 guard)."""
         import numpy as np
 
-        from raft_tpu.ops import log as lg
-        from raft_tpu.state import slim_state
-
         w = self.shape.w
         n = self.g * self.v
-        snap = np.asarray(self.state.snap_index)
+        # packed snap_index (uint16) holds the same absolute values — the
+        # int64 view keeps the arithmetic below width-independent
+        snap = np.asarray(self.state.snap_index).astype(np.int64)
         deltas = np.zeros((n,), np.int32)
         mask = np.zeros((n,), bool)
         out = {}
@@ -2176,38 +2317,117 @@ class FusedCluster:
             out[g] = d
         if not out:
             return out
+        self._apply_rebase(mask, deltas)
+        return out
+
+    def _apply_rebase(self, mask, deltas):
+        """Shared rebase applier behind rebase_groups and the diet-v2
+        automatic trigger: flush the D2H fences, run the rebase jits on the
+        unpacked (absolute-int32) carry, re-narrow, and shift the
+        metrics/chaos/trace side tables. The rebase arithmetic MUST see
+        int32 — jnp.maximum(x - d, 0) on a packed uint16 column would wrap
+        before the floor — so a packed carry unpacks around the jits."""
+        from raft_tpu.state import is_packed, pack_state, slim_state, unpack_state
+
         dj = jnp.asarray(deltas)
+        mj = jnp.asarray(mask)
         self._flush_pending_wal()
         self._flush_pending_egress()
         self._flush_pending_trace()
+        packed = is_packed(self.state)
+        st, fb = unpack_state(self.state), unpack_fabric(self.fab)
         if self._donate:
             with _no_persistent_cache():
-                self.state = slim_state(
-                    _rebase_indexes_donate_jit(self.state, jnp.asarray(mask), dj)
-                )
-                self.fab = slim_fabric(
-                    _rebase_fabric_donate_jit(fat_fabric(self.fab), dj)
-                )
+                st = slim_state(_rebase_indexes_donate_jit(st, mj, dj))
+                fb = slim_fabric(_rebase_fabric_donate_jit(fat_fabric(fb), dj))
         else:
-            self.state = slim_state(
-                _rebase_indexes_jit(self.state, jnp.asarray(mask), dj)
-            )
-            self.fab = slim_fabric(rebase_fabric(fat_fabric(self.fab), dj))
+            st = slim_state(_rebase_indexes_jit(st, mj, dj))
+            fb = slim_fabric(rebase_fabric(fat_fabric(fb), dj))
+        if packed:
+            st, fb = pack_state(st), pack_fabric(fb)
+        self.state, self.fab = st, fb
+        # any rebase (manual fast-forward included) moves the index space
+        # out from under the headroom counter — force a device re-sync on
+        # the next dispatch rather than trusting a stale budget
+        self._diet_budget = 0
         if self.metrics is not None:
             # in-flight latency samples hold absolute indexes — shift them
             # with their lanes (or drop, never mismeasure)
-            self.metrics = metmod.rebase_samples(
-                self.metrics, jnp.asarray(mask), dj
-            )
+            self.metrics = metmod.rebase_samples(self.metrics, mj, dj)
         if self.chaos is not None:
             # the recovery baseline holds absolute committed values — it
             # shifts with its lanes like the latency samples above
-            self.chaos = chmod.rebase(self.chaos, jnp.asarray(mask), dj)
+            self.chaos = chmod.rebase(self.chaos, mj, dj)
         if self.trace is not None:
             # recorded events whose arg column carries a log index shift
             # with their lanes so explain() output stays in the live space
-            self.trace = trmod.rebase(self.trace, jnp.asarray(mask), dj)
-        return out
+            self.trace = trmod.rebase(self.trace, mj, dj)
+
+    # -- diet-v2 (RAFT_TPU_DIET) ------------------------------------------
+
+    # Automatic-rebase threshold for the packed uint16 index columns: when
+    # the projected max absolute index would cross this, every group
+    # rebases down before the dispatch. 48k leaves 16k of clearance under
+    # 2^16 (a whole max-size log_window), and sits far above anything a
+    # test/bench workload reaches — digests stay comparable diet on/off.
+    DIET_REBASE_AT = 48 * 1024
+
+    def _diet_headroom(self, rounds: int):
+        """Pre-dispatch overflow guard for the packed index columns. A
+        host-side budget counter amortizes the device read: one dispatch
+        can grow any index by at most rounds*(E+1) (E appended entries +
+        one snapshot catch-up jump per round; a snapshot jump lands at a
+        peer's `last`, already inside the budgeted envelope), so the
+        counter spends that bound per run and only syncs max(last) off the
+        device when the budget runs dry."""
+        grow = rounds * (self.shape.max_msg_entries + 1)
+        if self._diet_budget > grow:
+            self._diet_budget -= grow
+            return
+        mx = int(jnp.max(self.state.last.astype(I32)))
+        if mx + grow >= self.DIET_REBASE_AT:
+            self._rebase_all_groups()
+            mx = int(jnp.max(self.state.last.astype(I32)))
+        self._diet_budget = max(self.DIET_REBASE_AT - mx - grow, 0)
+
+    def _rebase_all_groups(self):
+        """Vectorized whole-batch rebase (the diet-v2 trigger path):
+        per-group window-aligned min-snap deltas computed in one numpy
+        pass — rebase_groups' python per-group loop is unusable at the
+        333k-group scale this exists for."""
+        import numpy as np
+
+        w = self.shape.w
+        snap = np.asarray(self.state.snap_index).astype(np.int64)
+        d_g = (snap.reshape(self.g, self.v).min(axis=1) // w) * w
+        deltas = np.repeat(d_g, self.v).astype(np.int32)
+        mask = deltas != 0
+        if mask.any():
+            self._apply_rebase(mask, deltas)
+
+    def _wal_view(self):
+        """The state view the WAL/host planes stream: slim-canonical
+        dtypes, absolute int32 index columns, [N, V] bool masks. The
+        identity when diet is off, so streamed bytes are identical diet
+        on/off (asserted by tests/test_diet.py)."""
+        from raft_tpu.state import unpack_state
+
+        return unpack_state(self.state)
+
+    def host_state(self):
+        """Host-reader view of the carry (see _wal_view); raw `self.state`
+        may be diet-v2 packed (bitset masks, uint16 indexes)."""
+        return self._wal_view()
+
+    def adopt_state(self, st):
+        """Install a host-built (slim/fat) state as the carry, re-packing
+        when the current carry is diet-v2 packed — the write-side twin of
+        host_state() used by the confchange driver."""
+        from raft_tpu.state import is_packed, pack_state, slim_state
+
+        self.state = (
+            pack_state(st) if is_packed(self.state) else slim_state(st)
+        )
 
     @classmethod
     def restore_from_wal(
@@ -2238,10 +2458,14 @@ class FusedCluster:
         import numpy as np
 
         from raft_tpu.runtime.wal import WalStream
-        from raft_tpu.state import slim_state
+        from raft_tpu.state import is_packed, pack_state, slim_state, unpack_state
 
         c = cls(n_groups, n_voters, seed=seed, shape=shape, **cfg)
-        st = c.state
+        # WAL bytes are in the slim-canonical layout (_wal_view streams the
+        # unpacked view) — restore into that layout, then re-pack if the
+        # freshly-built carry is diet-v2 packed
+        packed = is_packed(c.state)
+        st = unpack_state(c.state)
         upd = {}
         for f in WalStream.FIELDS:  # the stream schema IS the restore set
             cur = getattr(st, f)
@@ -2255,7 +2479,8 @@ class FusedCluster:
             upd["log_bytes"] = jnp.asarray(
                 np.asarray(log_bytes), dtype=st.log_bytes.dtype
             )
-        c.state = slim_state(dc.replace(st, **upd))
+        st = slim_state(dc.replace(st, **upd))
+        c.state = pack_state(st) if packed else st
         return c
 
     # -- inspection -------------------------------------------------------
@@ -2285,7 +2510,10 @@ class FusedCluster:
         blocking read (the compute_bundle discipline, ops/ready_mask.py)."""
         import numpy as np
 
-        leaves = [getattr(self.state, name) for name in names]
+        # host_state(): diet-v2 packed columns widen to absolute int32 /
+        # [N, V] bool before they become host-visible (identity diet-off)
+        st = self.host_state()
+        leaves = [getattr(st, name) for name in names]
         for x in leaves:
             if hasattr(x, "copy_to_host_async"):
                 x.copy_to_host_async()
@@ -2307,7 +2535,9 @@ class FusedCluster:
         if not cnt.any():
             return {}
         ctx = np.asarray(self.state.rs_ctx)
-        idx = np.asarray(self.state.rs_index)
+        # widen-at-read: rs_index may be diet-v2 packed (uint16, same
+        # absolute values); served indexes stay absolute int32
+        idx = np.asarray(self.state.rs_index).astype(np.int32)
         out = {
             int(lane): [
                 (int(ctx[lane, k]), int(idx[lane, k]))

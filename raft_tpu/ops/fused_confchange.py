@@ -175,9 +175,11 @@ class FusedConfChanger:
         lanes = {int(l): kind for l in leaders}
         if not lanes:
             return {}
-        pci_before = np.asarray(self.c.state.pending_conf_index).copy()
+        # widen-at-read: the column may be diet-v2 packed (uint16, same
+        # absolute values)
+        pci_before = np.asarray(self.c.state.pending_conf_index).astype(np.int32)
         c.run(1, ops=c.ops(prop_cc=lanes), do_tick=False)
-        pci = np.asarray(self.c.state.pending_conf_index)
+        pci = np.asarray(self.c.state.pending_conf_index).astype(np.int32)
         accepted = {}
         for lane in lanes:
             g = lane // self.v
@@ -273,10 +275,16 @@ class FusedConfChanger:
         if not self._pending:
             return []
         c = self.c
-        n, v = c.state.prs_id.shape
-        applied = np.asarray(c.state.applied)
+        # host_state(): the diet-v2 packed carry stores the [N, V] masks as
+        # bitset words and prs_id as int8 — the unpacked view restores the
+        # [N, V] bool / int32 layout _row_key's frombuffer decoding assumes
+        # (identity when diet is off; serial harness clusters lack the
+        # method and carry unpacked state already)
+        hs = c.host_state() if hasattr(c, "host_state") else c.state
+        n, v = hs.prs_id.shape
+        applied = np.asarray(hs.applied)
         vw = {
-            f: np.asarray(getattr(c.state, f))
+            f: np.asarray(getattr(hs, f))
             for f in (
                 "prs_id",
                 "voters_in",
@@ -313,8 +321,8 @@ class FusedConfChanger:
                 del self._pending[g]
                 done.append(g)
         if lane_mask.any():
-            c.state = install_config(
-                c.state,
+            new_st = install_config(
+                hs,
                 jnp.asarray(lane_mask),
                 jnp.asarray(t_prs),
                 jnp.asarray(t_vin),
@@ -323,6 +331,11 @@ class FusedConfChanger:
                 jnp.asarray(t_lnx),
                 jnp.asarray(t_al),
             )
+            # adopt_state re-packs under diet; direct assignment otherwise
+            if hasattr(c, "adopt_state"):
+                c.adopt_state(new_st)
+            else:
+                c.state = new_st
         return done
 
     def settle(
@@ -345,8 +358,10 @@ class FusedConfChanger:
             self.c.run(rounds_per_block, **run_kw)
             done = self.apply_ready()
             if auto_leave and done:
-                al = np.asarray(self.c.state.auto_leave)
-                joint = np.asarray(self.c.state.voters_out).any(axis=1)
+                c = self.c
+                hs = c.host_state() if hasattr(c, "host_state") else c.state
+                al = np.asarray(hs.auto_leave)
+                joint = np.asarray(hs.voters_out).any(axis=1)
                 need = [
                     g
                     for g in done

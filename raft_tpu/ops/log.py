@@ -42,6 +42,9 @@ ERR_APPLIED_OUT_OF_RANGE = 16  # reference log.go:328-331 panic
 # a billion-entry margin to react (snapshot + re-key the group host-side).
 ERR_INDEX_NEAR_OVERFLOW = 32
 INDEX_OVERFLOW_MARGIN = 1 << 30
+# diet-v2 pack boundary clamp (defined beside pack_state; re-exported here
+# so the error_bits flag family reads as one table)
+from raft_tpu.state import ERR_DIET_OVERFLOW  # noqa: E402,F401
 
 
 def _err(state: RaftState, cond, bit: int) -> RaftState:

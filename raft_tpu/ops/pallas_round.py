@@ -96,7 +96,7 @@ except Exception:  # pragma: no cover - interpret mode works without SMEM
 from raft_tpu.chaos import device as chmod
 from raft_tpu.metrics import device as metmod
 from raft_tpu.ops import fused as fmod
-from raft_tpu.state import fat_state, slim_state
+from raft_tpu.state import fat_state, is_packed, slim_state, unpack_state
 from raft_tpu.trace import device as trmod
 
 I32 = jnp.int32
@@ -423,8 +423,17 @@ def pallas_rounds(
     construction)."""
     maybe_force_fail()
     validate_round_plan(rounds_per_call)
-    state = slim_state(state)
-    fab = fmod.slim_fabric(fab)
+    # diet-v2: a packed carry (bitset masks + u16 indexes) rides the
+    # HBM<->VMEM boundary packed — every boundary cast below replays the
+    # same store_carry/load_carry pair the XLA scan crosses, so
+    # trajectories stay bit-identical across engines. Static under jit
+    # (leaf ndim/dtype are part of the signature).
+    packed = is_packed(state)
+    if packed:
+        state, fab = fmod.store_carry(state, fab)
+    else:
+        state = slim_state(state)
+        fab = fmod.slim_fabric(fab)
     n = state.term.shape[0]
     check_tile(n, v, tile_lanes)
 
@@ -493,12 +502,18 @@ def pallas_rounds(
             probe_out = take(len(_CH_PROBE)) if has_ch else None
             part_ref = take(1)[0] if has_scal else None
 
-            st = fat_state(
-                jax.tree.unflatten(tree_s, [r[...] for r in s_in])
-            )
-            fb = fmod.fat_fabric(
-                jax.tree.unflatten(tree_f, [r[...] for r in f_in])
-            )
+            if packed:
+                st, fb = fmod.load_carry(
+                    jax.tree.unflatten(tree_s, [r[...] for r in s_in]),
+                    jax.tree.unflatten(tree_f, [r[...] for r in f_in]),
+                )
+            else:
+                st = fat_state(
+                    jax.tree.unflatten(tree_s, [r[...] for r in s_in])
+                )
+                fb = fmod.fat_fabric(
+                    jax.tree.unflatten(tree_f, [r[...] for r in f_in])
+                )
             op = jax.tree.unflatten(tree_o, [r[...] for r in o_in])
             # in-kernel rounds k>0 of an ops_first_round_only dispatch see
             # zero ops: the one global round that applies ops is k==0 of
@@ -545,12 +560,16 @@ def pallas_rounds(
             st2 = f2 = mt2 = None
             for k in range(kc):
                 if k:
-                    # replay the inter-round slim<->fat casts in-register:
+                    # replay the inter-round storage casts in-register:
                     # bit-identity with the XLA scan (and with K=1, where
                     # these casts happen across the HBM carry) depends on
-                    # crossing the exact same dtype boundary every round
-                    st = fat_state(slim_state(st2))
-                    fb = fmod.fat_fabric(fmod.slim_fabric(f2))
+                    # crossing the exact same dtype boundary every round —
+                    # the diet-v2 pack/unpack pair when the carry is packed
+                    if packed:
+                        st, fb = fmod.load_carry(*fmod.store_carry(st2, f2))
+                    else:
+                        st = fat_state(slim_state(st2))
+                        fb = fmod.fat_fabric(fmod.slim_fabric(f2))
                     if has_met:
                         # fresh delta slots per round (per-round partials
                         # rows); the sampler + round counter thread on
@@ -598,9 +617,13 @@ def pallas_rounds(
                     rows.append(
                         jnp.pad(row, (0, PARTIAL_WIDTH - row.shape[0]))
                     )
-            for r, x in zip(s_out, jax.tree.leaves(slim_state(st2))):
+            if packed:
+                st_w, f_w = fmod.store_carry(st2, f2)
+            else:
+                st_w, f_w = slim_state(st2), fmod.slim_fabric(f2)
+            for r, x in zip(s_out, jax.tree.leaves(st_w)):
                 r[...] = x
-            for r, x in zip(f_out, jax.tree.leaves(fmod.slim_fabric(f2))):
+            for r, x in zip(f_out, jax.tree.leaves(f_w)):
                 r[...] = x
             if has_met:
                 samp_out[0][...] = mt2.samp_index
@@ -637,8 +660,10 @@ def pallas_rounds(
         # pre-round captures for the flight recorder (kc == 1 whenever tr
         # is not None): the carry state before the kernel, the chaos carry
         # before its round advance
+        # unpack_state: identity on a slim carry; a diet-v2 packed carry
+        # widens to the layout the trace diff detector expects
         st_pre = (
-            fat_state(jax.tree.unflatten(tree_s, fs))
+            fat_state(unpack_state(jax.tree.unflatten(tree_s, fs)))
             if tr is not None
             else None
         )
@@ -710,7 +735,7 @@ def pallas_rounds(
                     round=ch.round + kc,
                 )
         if tr is not None:
-            st_post = fat_state(jax.tree.unflatten(tree_s, new_fs))
+            st_post = fat_state(unpack_state(jax.tree.unflatten(tree_s, new_fs)))
             tr = trmod.record_round(
                 tr,
                 st_pre,

@@ -383,8 +383,11 @@ class BlockedFusedCluster:
         each named [N_block]-leading leaf is concatenated in GLOBAL lane
         order (block i owns lanes [i*B*V, (i+1)*B*V)). Async host copies
         start on every block's leaves before the first blocking read."""
+        # per-block host_state(): packed (diet-v2) columns widen to
+        # absolute int32 before concatenation (identity when diet is off)
         leaves = [
-            [getattr(b.state, name) for name in names] for b in self.blocks
+            [getattr(b.host_state(), name) for name in names]
+            for b in self.blocks
         ]
         for row in leaves:
             for x in row:
@@ -406,7 +409,14 @@ class BlockedFusedCluster:
         return out
 
     def total_committed(self) -> int:
-        return int(sum(int(jnp.sum(b.state.committed)) for b in self.blocks))
+        # astype before the sum: a diet-v2 packed committed column is
+        # uint16 and a [N]-wide sum of it could wrap in its own dtype
+        return int(
+            sum(
+                int(jnp.sum(b.state.committed.astype(jnp.int32)))
+                for b in self.blocks
+            )
+        )
 
     def leader_count(self) -> int:
         return int(sum(len(b.leader_lanes()) for b in self.blocks))

@@ -20,6 +20,7 @@ the reference log layer reads only Term/Index/size (log.go:109-456).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any
 
 import jax
@@ -233,6 +234,169 @@ def fat_state(state: "RaftState") -> "RaftState":
     return _cast_fields(state, STATE_SLIM, widen=True)
 
 
+# --------------------------------------------------------------------------
+# Byte diet v2 (RAFT_TPU_DIET): packed bitsets + rebased narrow indices.
+#
+# A second, opt-in storage boundary beside slim/fat: `pack_state` narrows
+# the slim-canonical layout further for the resident carry, `unpack_state`
+# restores it exactly. Both are idempotent; `unpack_state(pack_state(x))`
+# is bit-identical to `slim_state(x)` for every in-range value, so diet-on
+# and diet-off runs walk the same trajectory (benches/diet_ab.py holds the
+# digests together).
+#
+# - every [N, V] bool mask (and the [N, R, V] ro_acks) packs into one
+#   bitset word per lane — the smallest unsigned width that holds V bits,
+#   so a 3-voter group pays 1 byte, not 4 (Shape validates V <= 32);
+# - index-valued columns store as uint16 ABSOLUTE values in the already-
+#   rebased index space (every one of them is shifted by ops/log.py
+#   rebase_indexes, so "offset from the per-lane base" is the value
+#   itself once FusedCluster's auto-rebase keeps max(last) under
+#   DIET_REBASE_AT). Term columns ride the same width: terms count
+#   elections, not entries, and the overflow check below flags the
+#   pathological case rather than ever wrapping silently;
+# - small-id columns (canonical ids 1..V) store as int8;
+# - log_bytes stores as int16 under Shape.max_entry_bytes.
+#
+# Out-of-range values at a pack boundary CLAMP and set ERR_DIET_OVERFLOW
+# in error_bits — never a silent wrap; tests/chaos soaks assert the bit
+# stays zero (ops/log.py re-exports the flag beside its ERR_* family).
+
+ERR_DIET_OVERFLOW = 64
+
+# inclusive value range per packed storage dtype
+_DIET_RANGE = {
+    jnp.uint16: (0, (1 << 16) - 1),
+    jnp.int16: (-(1 << 15), (1 << 15) - 1),
+    jnp.int8: (-128, 127),
+}
+
+# rebased index columns + term columns -> uint16 (all index fields here are
+# the exact set ops/log.py rebase_indexes shifts)
+PACK_U16 = (
+    "term", "snap_term", "pending_snap_term", "avail_snap_term", "log_term",
+    "last", "stabled", "committed", "applying", "applied",
+    "snap_index", "pending_snap_index", "avail_snap_index",
+    "pending_conf_index",
+    "pr_match", "pr_next", "pr_pending_snapshot",
+    "infl_index", "ro_index", "rs_index",
+)
+# canonical raft ids 1..V (V <= 32) -> int8
+PACK_I8 = ("id", "vote", "lead", "lead_transferee", "prs_id", "ro_from", "pri_from")
+# entry payload sizes bounded by Shape.max_entry_bytes -> int16
+PACK_I16 = ("log_bytes",)
+# bool mask columns -> one bitset word per lane along the trailing V axis
+PACK_BITSET = (
+    "voters_in", "voters_out", "learners", "learners_next",
+    "pr_recent_active", "pr_msg_app_flow_paused", "ro_acks",
+)
+# LaneConfig columns with config-time-validated bounds (make_lane_config)
+CFG_PACK = {
+    "election_tick": jnp.int16,  # <= 2^14, validated
+    "heartbeat_tick": jnp.int16,  # <= 2^14, validated
+    "max_inflight": jnp.int8,  # <= 127, validated
+}
+
+
+def diet_enabled() -> bool:
+    """Read RAFT_TPU_DIET lazily (default OFF) so tests/benches can toggle
+    it per-cluster; like donation_enabled, the value is baked into each
+    cluster at construction and the carry layout never flips mid-run."""
+    return os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")
+
+
+def bitset_dtype(v: int):
+    """Smallest unsigned word holding v mask bits (Shape caps v at 32)."""
+    if v <= 8:
+        return jnp.uint8
+    if v <= 16:
+        return jnp.uint16
+    if v <= 32:
+        return jnp.uint32
+    raise ValueError(f"bitset packing needs v <= 32, got {v}")
+
+
+def pack_bits(x, dtype):
+    """[..., V] bool -> [...] bitset word (bit j = column j)."""
+    v = x.shape[-1]
+    w = jnp.left_shift(jnp.uint32(1), jnp.arange(v, dtype=jnp.uint32))
+    return jnp.sum(x.astype(jnp.uint32) * w, axis=-1).astype(dtype)
+
+
+def unpack_bits(x, v: int):
+    """[...] bitset word -> [..., V] bool (exact inverse of pack_bits)."""
+    b = jnp.right_shift(
+        x[..., None].astype(jnp.uint32), jnp.arange(v, dtype=jnp.uint32)
+    )
+    return (b & jnp.uint32(1)).astype(BOOL)
+
+
+def is_packed(state: "RaftState") -> bool:
+    """Diet-v2 layout detector (static under jit: leaf ndim)."""
+    return getattr(state.voters_in, "ndim", 2) == 1
+
+
+def pack_state(state: "RaftState") -> "RaftState":
+    """Slim/fat -> diet-v2 packed storage layout (idempotent). Values
+    outside a field's packed range clamp and set ERR_DIET_OVERFLOW —
+    flagged, never silently wrapped."""
+    if is_packed(state):
+        return state
+    state = slim_state(state)
+    v = state.voters_in.shape[-1]
+    bd = bitset_dtype(v)
+    ovf = jnp.zeros(state.term.shape, BOOL)
+    upd = {}
+
+    def narrow(name, dt):
+        nonlocal ovf
+        x = getattr(state, name)
+        lo, hi = _DIET_RANGE[dt]
+        bad = (x < lo) | (x > hi)
+        while bad.ndim > 1:
+            bad = bad.any(axis=-1)
+        ovf = ovf | bad
+        upd[name] = jnp.clip(x, lo, hi).astype(dt)
+
+    for f in PACK_U16:
+        narrow(f, jnp.uint16)
+    for f in PACK_I8:
+        narrow(f, jnp.int8)
+    for f in PACK_I16:
+        narrow(f, jnp.int16)
+    for f in PACK_BITSET:
+        upd[f] = pack_bits(getattr(state, f), bd)
+    upd["error_bits"] = state.error_bits | jnp.where(
+        ovf, jnp.int32(ERR_DIET_OVERFLOW), jnp.int32(0)
+    )
+    # LaneConfig bounds are ValueError-enforced at make_lane_config, so
+    # these casts are exact by construction — no overflow check needed
+    upd["cfg"] = dataclasses.replace(
+        state.cfg,
+        **{k: getattr(state.cfg, k).astype(dt) for k, dt in CFG_PACK.items()},
+    )
+    return dataclasses.replace(state, **upd)
+
+
+def unpack_state(state: "RaftState") -> "RaftState":
+    """Diet-v2 packed -> the exact slim-canonical layout (idempotent).
+    Host-visible consumers (WAL, state_columns, confchange) read through
+    this so every value surfaces absolute and int32-or-slim, byte-identical
+    to a diet-off carry."""
+    if not is_packed(state):
+        return state
+    v = state.prs_id.shape[-1]  # [N, V] survives packing (dtype-only)
+    upd = {
+        f: getattr(state, f).astype(I32) for f in PACK_U16 + PACK_I8 + PACK_I16
+    }
+    for f in PACK_BITSET:
+        upd[f] = unpack_bits(getattr(state, f), v)
+    upd["cfg"] = dataclasses.replace(
+        state.cfg,
+        **{k: getattr(state.cfg, k).astype(I32) for k in CFG_PACK},
+    )
+    return dataclasses.replace(state, **upd)
+
+
 def make_lane_config(shape: Shape, **overrides) -> LaneConfig:
     n = shape.n
 
@@ -269,6 +433,14 @@ def make_lane_config(shape: Shape, **overrides) -> LaneConfig:
     for k in ("election_tick", "heartbeat_tick"):
         if not bool(np.all(np.asarray(defaults[k]) <= 1 << 14)):
             raise ValueError(f"{k} must be <= 16384 (int16 carry diet)")
+    # the slim carry stores infl_start/infl_count as int8 (STATE_SLIM) and
+    # diet-v2 packs max_inflight itself (CFG_PACK): a per-lane override
+    # must respect the same bound Shape enforces for its static twin
+    mi = np.asarray(defaults["max_inflight"])
+    if not bool(np.all((mi >= 1) & (mi <= 127))):
+        raise ValueError(
+            "max_inflight must be in 1..127 for every lane (int8 carry diet)"
+        )
     return LaneConfig(**defaults)
 
 

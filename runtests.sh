@@ -56,14 +56,19 @@ smokes() {
   # including K>1 moving strictly fewer carry bytes than K=1 — arm on
   # TPU only) + the trace A/B smoke (flight recorder on vs off must be
   # digest-identical, TRACELOG=0 must trace zero recorder sites, and the
-  # drained events must equal the scalar-twin transition stream)
+  # drained events must equal the scalar-twin transition stream) + the
+  # byte-diet A/B smoke (diet on vs off over xla / pallas K=1 / pallas
+  # K=AB_K with every observability plane live: one identical trajectory
+  # digest across all six arms, >= 30% smaller carry bytes/lane with diet
+  # on, round-time regression gate arms on TPU only)
   run_bench benches/metrics_smoke.py \
     && run_bench benches/dispatch_ab.py \
     && run_bench benches/egress_ab.py \
     && run_bench benches/pallas_ab.py --smoke \
     && run_bench benches/chaos_soak.py --smoke \
     && run_bench benches/serve_bench.py --smoke \
-    && run_bench benches/trace_ab.py
+    && run_bench benches/trace_ab.py \
+    && run_bench benches/diet_ab.py --smoke
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
@@ -115,6 +120,11 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
     # its kernel variants is one large interpreted scan program, and the
     # CI-asserted bit-identity (pallas vs XLA trajectories) lives here
     run_chunk tests/test_pallas_round.py
+    # the diet-v2 packed-carry suite gets its own process: its twin runs
+    # compile every engine/donation variant twice (diet off vs on are
+    # distinct dtype signatures) plus one K=4 interpreted megakernel on a
+    # packed carry
+    run_chunk tests/test_diet.py
     run_chunk tests/test_sharded.py
     smokes
   fi
