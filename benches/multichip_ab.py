@@ -1,0 +1,311 @@
+"""Multi-chip A/B: MeshBlockedCluster vs the monolithic blocked scheduler.
+
+Runs the same blocked workload — all observability planes + the byte diet
++ donation ON — in fresh subprocesses:
+
+  mono    BlockedFusedCluster(groups, block_groups)   one-device blocks
+  mesh    MeshBlockedCluster(groups, block_groups)    blocks sharded over
+                                                      the whole device mesh
+  single  FusedCluster(groups)                        scalar-composition twin
+                                                      (only when K == 1: the
+                                                      block seed scheme makes
+                                                      block 0 == the single)
+
+One bench JSON line per arm plus a summary. Asserted invariants:
+
+  - every arm ends on ONE identical sha256 digest of the slim-canonical
+    trajectory fields — the sharded × blocked composition is invisible to
+    the trajectory (asserted on every backend, CPU-sim included)
+  - per-block WAL deltas and egress bundles are byte-identical between
+    mesh (per-(shard, block) payloads merged host-side via
+    merge_shard_deltas / merge_delta_bundles) and mono (whole-block
+    payloads); flight-recorder event streams match when neither arm
+    dropped events
+  - error_bits stays zero everywhere
+  - [TPU only, >= 2 chips] mesh groups·ticks/s >= AB_MESH_GAIN x mono
+    (default 1.2 — the whole point of the mesh is to beat one chip)
+
+Exit 0 = pass, 1 = regression. `--smoke` shrinks the workload for CI.
+Env: AB_GROUPS, AB_BLOCK_GROUPS, AB_VOTERS, AB_ROUNDS, AB_ITERS,
+AB_MESH_GAIN, AB_MODE (child arm selector), RAFT_TPU_* (forwarded).
+When JAX_PLATFORMS=cpu and no device-count override is present, children
+inherit XLA_FLAGS --xla_force_host_platform_device_count=8 (the CI
+8-device CPU simulation; real TPU runs are never overridden).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DIGEST_FIELDS = (
+    "term", "vote", "lead", "state", "committed", "last",
+    "log_term", "error_bits",
+)
+
+
+def child():
+    import time
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.config import Shape
+    from raft_tpu.runtime.egress import (
+        EgressStream, ShardedEgressStream, merge_delta_bundles,
+    )
+    from raft_tpu.runtime.trace import TraceStream
+    from raft_tpu.runtime.wal import (
+        ShardedWalStream, WalStream, merge_shard_deltas,
+    )
+
+    mode = os.environ.get("AB_MODE", "mono")
+    groups = int(os.environ.get("AB_GROUPS", 4096))
+    bg = int(os.environ.get("AB_BLOCK_GROUPS", max(groups // 4, 1)))
+    v = int(os.environ.get("AB_VOTERS", 3))
+    w, e = 16, 2
+    # per-BLOCK shape: every resident block (and its sharded twin) runs
+    # the same bg*v-lane program
+    shape = Shape(
+        n_lanes=bg * v, max_peers=v, log_window=w,
+        max_msg_entries=e, max_inflight=2, max_read_index=2,
+    )
+    lag = min(8, w // 2)
+    rounds = int(os.environ.get("AB_ROUNDS", 16))
+    iters = int(os.environ.get("AB_ITERS", 4))
+    n_dev = jax.device_count()
+
+    if mode == "mesh":
+        from raft_tpu.parallel.mesh import MeshBlockedCluster
+
+        c = MeshBlockedCluster(groups, v, block_groups=bg, seed=42,
+                               shape=shape)
+    elif mode == "single":
+        from raft_tpu.ops.fused import FusedCluster
+        from raft_tpu.scheduler import BlockedFusedCluster
+
+        assert bg == groups, "the single arm is only K=1-comparable"
+        c = BlockedFusedCluster(groups, v, block_groups=bg, seed=42,
+                                shape=shape)
+        # one block, seed 42 + 7919*0: literally the FusedCluster program
+        assert isinstance(c.blocks[0], FusedCluster)
+    else:
+        from raft_tpu.scheduler import BlockedFusedCluster
+
+        c = BlockedFusedCluster(groups, v, block_groups=bg, seed=42,
+                                shape=shape)
+
+    # identical deterministic fault pattern in every arm (global lanes)
+    if c.chaos_enabled:
+        n = groups * v
+        drops = np.zeros((n, v), np.int32)  # per-edge drop budget
+        drops[:: max(n // 8, 1), 0] = 1
+        c.set_chaos(drop_num=drops, heal_round=8)
+
+    # flight-recorder streams ride every dispatch so the rings never drop
+    # at smoke scale (a dropped event would make the mesh/mono event
+    # streams legitimately diverge: per-shard rings hold S x R events,
+    # the monolithic ring R)
+    traces = (
+        [TraceStream() for _ in range(c.k)]
+        if c.blocks[0].trace is not None else None
+    )
+
+    def step(r):
+        c.run(r, auto_propose=True, auto_compact_lag=lag, trace=traces)
+
+    step(rounds)  # compile
+    c.block_until_ready()
+    warm = 0
+    while c.leader_count() < groups:
+        step(rounds)
+        warm += rounds
+        if warm > 40 * 16:
+            raise RuntimeError("A/B warm-up stalled before full election")
+    c.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step(rounds)
+    c.block_until_ready()
+    dt = time.perf_counter() - t0
+    gticks = groups * rounds * iters / dt
+
+    # one final streamed sweep: the per-(shard, block) payload probe
+    if mode == "mesh":
+        wal_parts: dict = {}
+        eg_parts: dict = {}
+        wal = c.wal_streams(
+            sink=lambda b, s, seq, d: wal_parts.setdefault(b, {}).__setitem__(s, d)
+        )
+        egress = c.egress_streams(
+            sink=lambda b, s, seq, bn: eg_parts.setdefault(b, {}).__setitem__(s, bn)
+        )
+    else:
+        wal_parts, eg_parts = {}, {}
+        wal = [
+            WalStream(sink=lambda seq, d, b=i: wal_parts.__setitem__(b, d))
+            for i in range(c.k)
+        ]
+        egress = [
+            EgressStream(sink=lambda seq, bn, b=i: eg_parts.__setitem__(b, bn))
+            for i in range(c.k)
+        ]
+    c.run(1, auto_propose=True, auto_compact_lag=lag, wal=wal,
+          egress=egress, trace=traces)
+    for st in wal + egress + (traces or []):
+        st.flush()
+
+    payload = hashlib.sha256()
+    for b in range(c.k):
+        d = (
+            merge_shard_deltas([wal_parts[b][s] for s in range(c.n_shards)])
+            if mode == "mesh" else wal_parts[b]
+        )
+        for f in WalStream.FIELDS:
+            payload.update(np.ascontiguousarray(d[f]).tobytes())
+        bn = (
+            merge_delta_bundles([eg_parts[b][s] for s in range(c.n_shards)])
+            if mode == "mesh" else eg_parts[b]
+        )
+        for f in ("changed", "active", "term", "lead", "state", "committed",
+                  "applied", "last", "rs_count"):
+            payload.update(np.ascontiguousarray(getattr(bn, f)).tobytes())
+
+    trace_digest, trace_dropped = None, 0
+    if traces is not None:
+        th = hashlib.sha256()
+        for ts in traces:
+            ev = ts.events
+            # canonical row order: the mesh merge is round-sorted but
+            # same-round events across shards interleave by shard index —
+            # sort rows fully so both arms hash one canonical set
+            ev = ev[np.lexsort(ev.T[::-1])]
+            th.update(np.ascontiguousarray(ev).tobytes())
+            trace_dropped += ts.dropped
+        trace_digest = th.hexdigest()
+
+    cols = c.state_columns(*DIGEST_FIELDS)
+    digest = hashlib.sha256()
+    for name in DIGEST_FIELDS:
+        digest.update(np.ascontiguousarray(cols[name]).tobytes())
+    c.check_no_errors()
+    snap = c.metrics_snapshot()
+    print(json.dumps({
+        "config": f"multichip_ab:{mode}:g={groups}:bg={bg}:dev={n_dev}",
+        "value": round(gticks, 1),
+        "unit": "groups*ticks/s",
+        "extra": {
+            "mode": mode,
+            "k_blocks": c.k,
+            "n_devices": n_dev,
+            "digest": digest.hexdigest(),
+            "payload_digest": payload.hexdigest(),
+            "trace_digest": trace_digest,
+            "trace_dropped": trace_dropped,
+            "committed": c.total_committed(),
+            "counters": None if snap is None else snap["counters"],
+            "diet": os.environ.get("RAFT_TPU_DIET", "0"),
+            "backend": jax.default_backend(),
+        },
+    }), flush=True)
+
+
+def run_child(mode: str) -> dict:
+    env = dict(
+        os.environ,
+        AB_MODE=mode,
+        # the acceptance matrix: every plane + the byte diet + donation on
+        RAFT_TPU_METRICS="1",
+        RAFT_TPU_CHAOS="1",
+        RAFT_TPU_TRACELOG="1",
+        RAFT_TPU_DIET=os.environ.get("RAFT_TPU_DIET", "1"),
+        RAFT_TPU_DONATE=os.environ.get("RAFT_TPU_DONATE", "1"),
+    )
+    # CPU runs simulate the 8-device mesh; a real TPU mesh is never forced
+    flags = env.get("XLA_FLAGS", "")
+    if (
+        env.get("JAX_PLATFORMS", "").startswith("cpu")
+        and "host_platform_device_count" not in flags
+    ):
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count=8 {flags}".strip()
+        )
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    if "--smoke" in sys.argv:
+        os.environ.setdefault("AB_GROUPS", "16")
+        os.environ.setdefault("AB_BLOCK_GROUPS", "8")
+        os.environ.setdefault("AB_ROUNDS", "4")
+        os.environ.setdefault("AB_ITERS", "2")
+    groups = int(os.environ.get("AB_GROUPS", 4096))
+    bg = int(os.environ.get("AB_BLOCK_GROUPS", max(groups // 4, 1)))
+    gain = float(os.environ.get("AB_MESH_GAIN", 1.2))
+    modes = ["mono", "mesh"] + (["single"] if bg == groups else [])
+    arms = {}
+    for mode in modes:
+        r = run_child(mode)
+        print(json.dumps(r), flush=True)
+        arms[mode] = r
+
+    fails = []
+    base = arms["mono"]["extra"]
+    for mode, r in arms.items():
+        ex = r["extra"]
+        if ex["digest"] != base["digest"]:
+            fails.append(
+                f"{mode}: trajectory digest diverged from mono — the "
+                "sharded x blocked composition is not invisible"
+            )
+        if ex["counters"] != base["counters"]:
+            fails.append(f"{mode}: metrics counters diverged from mono")
+    mesh = arms["mesh"]["extra"]
+    if mesh["payload_digest"] != base["payload_digest"]:
+        fails.append(
+            "mesh: merged per-(shard, block) WAL/egress payloads are not "
+            "byte-identical to the monolithic block payloads"
+        )
+    if (
+        mesh["trace_digest"] is not None
+        and mesh["trace_dropped"] == 0 == base["trace_dropped"]
+        and mesh["trace_digest"] != base["trace_digest"]
+    ):
+        fails.append("mesh: flight-recorder event streams diverged from mono")
+    on_tpu = base["backend"] == "tpu" and mesh["n_devices"] >= 2
+    ratio = arms["mesh"]["value"] / max(arms["mono"]["value"], 1e-9)
+    if on_tpu and ratio < gain:
+        fails.append(
+            f"mesh throughput gain {ratio:.2f}x < {gain}x over mono on "
+            f"{mesh['n_devices']} chips"
+        )
+    print(json.dumps({
+        "metric": "multichip_ab",
+        "ok": not fails,
+        "mesh_gticks": arms["mesh"]["value"],
+        "mono_gticks": arms["mono"]["value"],
+        "gain": round(ratio, 3),
+        "k_blocks": mesh["k_blocks"],
+        "n_devices": mesh["n_devices"],
+        "tpu_gates": on_tpu,
+    }), flush=True)
+    for f in fails:
+        print(f"FAIL: {f}", file=sys.stderr)
+    sys.exit(1 if fails else 0)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -163,6 +163,81 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
     del c
 
 
+def measure_mesh(n_groups, n_voters, block_groups, block=32, iters=5,
+                 w=16, e=2):
+    """One mesh rung: K resident blocks, each sharded over EVERY local
+    device (parallel/mesh.py MeshBlockedCluster). The 8M-16M-group
+    north-star arm (ROADMAP item 2): on an 8-chip host, e.g.
+
+      PROBE_MESH=1 PROBE_BLOCK_GROUPS=1048576 \\
+      PROBE_GROUPS=8388608,16777216 PROBE_DIET=1 benches/scaling_probe.py
+
+    runs 8-16 blocks of 1M groups, ~2M-6M lanes resident per chip with
+    the diet carry — one compile for the whole ladder."""
+    from raft_tpu.config import Shape
+    from raft_tpu.parallel.mesh import MeshBlockedCluster
+
+    f = int(os.environ.get("PROBE_INFLIGHT", min(8, e)))
+    r = int(os.environ.get("PROBE_READS", 2))
+    shape = Shape(
+        n_lanes=block_groups * n_voters, max_peers=n_voters, log_window=w,
+        max_msg_entries=e, max_inflight=f, max_read_index=r,
+    )
+    c = MeshBlockedCluster(
+        n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape
+    )
+    lag = min(8, w // 2)
+    t0 = time.perf_counter()
+    c.run(block, auto_propose=True, auto_compact_lag=lag)
+    c.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    warm = 0
+    while c.leader_count() < n_groups and warm < 40 * 16:
+        c.run(block, auto_propose=True, auto_compact_lag=lag)
+        warm += block
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        c.run(block, auto_propose=True, auto_compact_lag=lag)
+        c.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    lanes = n_groups * n_voters
+    from raft_tpu.utils.profiling import live_buffer_bytes
+
+    live_per_lane = live_buffer_bytes() / lanes
+    mem = {}
+    try:
+        ms = jax.local_devices()[0].memory_stats() or {}
+        mem = {
+            "hbm_in_use_gb": round(ms.get("bytes_in_use", 0) / 2**30, 2),
+            "hbm_peak_gb": round(ms.get("peak_bytes_in_use", 0) / 2**30, 2),
+        }
+    except Exception:
+        pass
+    print(
+        json.dumps(
+            {
+                "groups": n_groups,
+                "resident_blocks": c.k,
+                "block_groups": block_groups,
+                "shards": c.n_shards,
+                "lanes_per_shard": c.lanes_per_shard,
+                "voters": n_voters,
+                "lanes": lanes,
+                "round_ms": round(1000 * best / block, 3),
+                "groups_ticks_per_s": round(n_groups * block / best, 1),
+                "us_per_lane_round": round(1e6 * best / block / lanes, 2),
+                "compile_s": round(compile_s, 1),
+                "diet": int(os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")),
+                "live_bytes_per_lane": round(live_per_lane, 1),
+                **mem,
+            }
+        ),
+        flush=True,
+    )
+    del c
+
+
 if __name__ == "__main__":
     if os.environ.get("PROBE_DIET") is not None:
         # the ladder doubles as the diet-v2 acceptance artifact: force the
@@ -175,7 +250,11 @@ if __name__ == "__main__":
     shapes = os.environ.get(
         "PROBE_GROUPS", "4096,16384,65536,131072,262144"
     )
-    if os.environ.get("PROBE_BLOCKED"):
+    if os.environ.get("PROBE_MESH"):
+        bg = int(os.environ.get("PROBE_BLOCK_GROUPS", 65536))
+        for g in [int(x) for x in shapes.split(",")]:
+            measure_mesh(g, voters, bg, block=block, w=w, e=e)
+    elif os.environ.get("PROBE_BLOCKED"):
         bg = int(os.environ.get("PROBE_BLOCK_GROUPS", 65536))
         for g in [int(x) for x in shapes.split(",")]:
             if g % bg == 0:

@@ -1962,9 +1962,7 @@ class FusedCluster:
         while RAFT_TPU_TRACELOG=0 (self.trace is None)."""
         if ops is None:
             ops = self._no_ops
-        self._flush_pending_wal()
-        self._flush_pending_egress()
-        self._flush_pending_trace()
+        self._flush_stream_fences()
         if self._diet:
             self._diet_headroom(rounds)
         res = None
@@ -2041,6 +2039,16 @@ class FusedCluster:
             trace.push(self.trace)
             if self._donate:
                 self._trace_pending = trace
+
+    def _flush_stream_fences(self):
+        """Resolve every in-flight D2H stream copy (WAL, egress, trace)
+        before a donating dispatch — or a rebase — invalidates the buffers
+        they reference. The sharded driver (parallel/sharded.py) dispatches
+        its own shard_map program instead of calling run(), but its streams
+        ride THESE fences so inner rebases cover them too."""
+        self._flush_pending_wal()
+        self._flush_pending_egress()
+        self._flush_pending_trace()
 
     def _flush_pending_wal(self):
         """Resolve a WAL delta that still references this cluster's current
@@ -2331,9 +2339,7 @@ class FusedCluster:
 
         dj = jnp.asarray(deltas)
         mj = jnp.asarray(mask)
-        self._flush_pending_wal()
-        self._flush_pending_egress()
-        self._flush_pending_trace()
+        self._flush_stream_fences()
         packed = is_packed(self.state)
         st, fb = unpack_state(self.state), unpack_fabric(self.fab)
         if self._donate:

@@ -437,7 +437,6 @@ class ShardedFusedCluster:
                 round=jax.device_put(tr.round, repl),
                 stall=shard_lanes(tr.stall),
             )
-        self._trace_pending = None
         self._no_ops = jax.tree.map(shard_lanes, no_ops(n))
         self._shard_lanes = shard_lanes
         self._cache = {}
@@ -485,11 +484,16 @@ class ShardedFusedCluster:
         return k
 
     def run(self, rounds: int = 1, ops=None, do_tick: bool = True,
-            auto_propose: bool = False, auto_compact_lag=None, trace=None):
-        """trace: an optional runtime.trace.TraceStream — the stacked
-        per-shard rings push after the dispatch (one host drain sees every
-        shard's events, merged round-sorted by the stream); flushed before
-        the next donating dispatch like the FusedCluster fence."""
+            auto_propose: bool = False, auto_compact_lag=None,
+            wal=None, egress=None, trace=None):
+        """wal / egress / trace: the same optional runtime streams
+        FusedCluster.run takes — the WAL delta streams the slim-canonical
+        view of the sharded carry, the egress bundle the raw carry, and the
+        trace push drains the stacked per-shard rings (one host drain sees
+        every shard's events, merged round-sorted by the stream). All three
+        ride the INNER cluster's donation fences (_wal_pending /
+        _egress_pending / _trace_pending), so a diet auto-rebase between
+        dispatches flushes them exactly like the monolithic path."""
         from raft_tpu.ops.fused import fused_rounds
         from raft_tpu.ops import pallas_round as plr
         from raft_tpu.trace.device import TraceState
@@ -501,9 +505,14 @@ class ShardedFusedCluster:
                 lambda x: self._shard_lanes(jnp.asarray(x)), ops
             )
         )
-        if self._trace_pending is not None:
-            self._trace_pending.flush()
-            self._trace_pending = None
+        self.inner._flush_stream_fences()
+        if self.inner._diet:
+            # the monolithic path guards every dispatch in FusedCluster.run;
+            # this driver dispatches its own shard_map program, so the
+            # packed-index overflow guard (and its automatic pre-overflow
+            # rebase) must be invoked here — the sharded carry otherwise
+            # runs clamp-and-flag into ERR_DIET_OVERFLOW
+            self.inner._diet_headroom(rounds)
         met = self.inner.metrics
         ch = self.inner.chaos
         tr = self.inner.trace
@@ -690,7 +699,8 @@ class ShardedFusedCluster:
             return self.run(
                 rounds, ops=ops, do_tick=do_tick,
                 auto_propose=auto_propose,
-                auto_compact_lag=auto_compact_lag, trace=trace,
+                auto_compact_lag=auto_compact_lag,
+                wal=wal, egress=egress, trace=trace,
             )
         self.inner.state, self.inner.fab = res[0], res[1]
         j = 2
@@ -702,13 +712,22 @@ class ShardedFusedCluster:
             j += 1
         if has_tr:
             self.inner.trace = res[j]
-            if trace is not None:
-                trace.push(self.inner.trace)
-                if self._donate:
-                    # same fence as FusedCluster: the async host copies
-                    # must land before the next donating dispatch frees
-                    # the ring buffers
-                    self._trace_pending = trace
+        # stream pushes land on the INNER fences so the next donating
+        # dispatch — or an inner rebase — resolves the async host copies
+        # before the buffers they reference are freed (FusedCluster.run's
+        # exact discipline)
+        if wal is not None:
+            wal.push(self.inner._wal_view())
+            if self._donate:
+                self.inner._wal_pending = wal
+        if egress is not None:
+            egress.push(self.inner.state)
+            if self._donate:
+                self.inner._egress_pending = egress
+        if trace is not None and has_tr:
+            trace.push(self.inner.trace)
+            if self._donate:
+                self.inner._trace_pending = trace
 
     def _fall_back(self, err):
         """Log the pallas -> XLA engine fallback once via the metrics host

@@ -42,9 +42,38 @@ for, with the O(active) sweep this stream was built to feed.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import numpy as np
 
 from raft_tpu.ops import ready_mask
+
+
+class EgressView(NamedTuple):
+    """One shard's lane window of the cursor columns the delta kernel
+    reads (ops/ready_mask.py delta_bundle) — a registered pytree, so the
+    per-shard EgressStream dispatches the SAME jitted kernel at the
+    [lanes_per_shard] shape: one compile serves every (shard, block)."""
+
+    term: object
+    lead: object
+    state: object
+    committed: object
+    applied: object
+    last: object
+    rs_count: object
+
+
+def shard_egress_view(state, lo: int, hi: int) -> EgressView:
+    """Slice a (possibly diet-packed) state's externally visible cursor
+    columns to one shard's lane window; slices are lazy device views, so
+    only the shard's rows ride the delta dispatch and D2H copy."""
+    return EgressView(
+        term=state.term[lo:hi], lead=state.lead[lo:hi],
+        state=state.state[lo:hi], committed=state.committed[lo:hi],
+        applied=state.applied[lo:hi], last=state.last[lo:hi],
+        rs_count=state.rs_count[lo:hi],
+    )
 
 
 class EgressStream:
@@ -93,3 +122,87 @@ class EgressStream:
         self.lanes_active += int(bundle.count)
         if self.sink is not None:
             self.sink(block_id, bundle)
+
+
+class ShardedEgressStream:
+    """Per-(shard, block) egress addressing for the mesh driver
+    (parallel/mesh.py): one sub-EgressStream per shard, each pushed the
+    shard's EgressView lane window, each holding its OWN host-side
+    PrevCursors baseline — so every shard's bundle is the exact delta of
+    its own lanes, and `merge_delta_bundles` reassembles a block's S
+    bundles into the monolithic bundle (byte-identical to an unsharded
+    EgressStream of the same state; the compaction's ascending-prefix
+    invariant makes the offset concat exact).
+
+    sink(shard, block_id, DeltaBundle) fires per shard in shard order."""
+
+    def __init__(self, n_shards: int, lanes_per_shard: int | None = None,
+                 sink=None):
+        self.n_shards = n_shards
+        self.lanes_per_shard = lanes_per_shard
+        self.streams = [
+            EgressStream(
+                sink=None if sink is None else (
+                    lambda bid, b, s=s: sink(s, bid, b)
+                )
+            )
+            for s in range(n_shards)
+        ]
+
+    @property
+    def enabled(self) -> bool:
+        return self.streams[0].enabled
+
+    @property
+    def blocks(self) -> int:
+        return self.streams[0].blocks
+
+    @property
+    def lanes_scanned(self) -> int:
+        return sum(es.lanes_scanned for es in self.streams)
+
+    @property
+    def lanes_active(self) -> int:
+        return sum(es.lanes_active for es in self.streams)
+
+    @property
+    def bytes(self) -> int:
+        return sum(es.bytes for es in self.streams)
+
+    def push(self, state):
+        lps = self.lanes_per_shard
+        if lps is None:
+            lps = state.term.shape[0] // self.n_shards
+        for s, es in enumerate(self.streams):
+            es.push(shard_egress_view(state, s * lps, (s + 1) * lps))
+
+    def flush(self):
+        for es in self.streams:
+            es.flush()
+
+
+def merge_delta_bundles(bundles: list) -> "ready_mask.DeltaBundle":
+    """Reassemble one block's per-shard DeltaBundles (shard order) into the
+    monolithic bundle. Cursor columns concatenate lane-contiguously; the
+    dense active prefix rebuilds by offsetting each shard's prefix into
+    global lanes — compact_mask emits ascending lane indexes, so the
+    shard-order concat of ascending per-shard prefixes IS the monolithic
+    ascending prefix, sentinel tail included."""
+    lens = [int(b.changed.shape[0]) for b in bundles]
+    n = sum(lens)
+    changed = np.concatenate([np.asarray(b.changed) for b in bundles])
+    active = np.full((n,), n, np.int32)
+    cnt, off = 0, 0
+    for b, ln in zip(bundles, lens):
+        c = int(b.count)
+        active[cnt : cnt + c] = np.asarray(b.active[:c]) + off
+        cnt += c
+        off += ln
+    cols = {
+        f: np.concatenate([np.asarray(getattr(b, f)) for b in bundles])
+        for f in ("term", "lead", "state", "committed", "applied", "last",
+                  "rs_count")
+    }
+    return ready_mask.DeltaBundle(
+        changed=changed, active=active, count=np.int32(cnt), **cols
+    )

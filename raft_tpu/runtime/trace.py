@@ -53,6 +53,10 @@ class TraceStream:
         self.events_total = 0
         self.dropped = 0
         self._batches: list[np.ndarray] = []
+        # per-shard retention for (shard, block)-addressed consumers
+        # (parallel/mesh.py): shard s's events in append order, BEFORE the
+        # cross-shard round-sort merge folds them into the global stream
+        self._shard_batches: dict[int, list[np.ndarray]] = {}
         self._counted_dropped = 0
 
     def push(self, trace) -> None:
@@ -82,6 +86,15 @@ class TraceStream:
             return np.zeros((0, 4), np.int64)
         return np.concatenate(self._batches, axis=0)
 
+    def shard_events(self, s: int) -> np.ndarray:
+        """Shard s's resolved events ([M, 4] int64, append order) — the
+        per-(shard, block) payload view; shards of a monolithic (unstacked)
+        push all land on shard 0."""
+        parts = self._shard_batches.get(s)
+        if not parts:
+            return np.zeros((0, 4), np.int64)
+        return np.concatenate(parts, axis=0)
+
     def _resolve_pending(self) -> None:
         if self._pending is None:
             return
@@ -107,9 +120,11 @@ class TraceStream:
             if kept <= 0:
                 continue
             slots = np.arange(w - kept, w, dtype=np.int64) % r
-            parts.append(
-                np.stack([c[s][slots].astype(np.int64) for c in rings], axis=1)
+            part = np.stack(
+                [c[s][slots].astype(np.int64) for c in rings], axis=1
             )
+            parts.append(part)
+            self._shard_batches.setdefault(s, []).append(part)
         if parts:
             ev = np.concatenate(parts, axis=0)
             if len(parts) > 1:  # merge shard streams round-sorted, stable

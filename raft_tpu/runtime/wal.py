@@ -80,3 +80,73 @@ class WalStream:
         self.bytes += sum(a.nbytes for a in delta.values())
         if self.sink is not None:
             self.sink(block_id, delta)
+
+
+class _ShardView:
+    """One shard's lane window of a streamed state: getattr-compatible with
+    WalStream.push (which reads FIELDS attributes), zero copies — each
+    attribute is a lazy device-array slice, so only the shard's own rows
+    ride the D2H transfer."""
+
+    __slots__ = ("_state", "_lo", "_hi")
+
+    def __init__(self, state, lo, hi):
+        self._state, self._lo, self._hi = state, lo, hi
+
+    def __getattr__(self, name):
+        return getattr(self._state, name)[self._lo : self._hi]
+
+
+class ShardedWalStream:
+    """Per-(shard, block) WAL addressing for the mesh driver
+    (parallel/mesh.py): one sub-WalStream per shard, each pushed the
+    shard's lane window of the block delta, so durability payloads are
+    addressed (shard, block) — the unit a per-chip storage agent would
+    own — while the double-buffer/fence discipline stays WalStream's.
+
+    sink(shard, block_id, delta) fires once per shard per push, in shard
+    order within a push. `merge_shard_deltas` reassembles one block's S
+    per-shard deltas into the monolithic delta (byte-identical to an
+    unsharded WalStream push of the same state — asserted by
+    tests/test_mesh.py)."""
+
+    def __init__(self, n_shards: int, lanes_per_shard: int | None = None,
+                 sink=None):
+        self.n_shards = n_shards
+        self.lanes_per_shard = lanes_per_shard
+        self.streams = [
+            WalStream(
+                sink=None if sink is None else (
+                    lambda bid, d, s=s: sink(s, bid, d)
+                )
+            )
+            for s in range(n_shards)
+        ]
+
+    @property
+    def blocks(self) -> int:
+        return self.streams[0].blocks
+
+    @property
+    def bytes(self) -> int:
+        return sum(ws.bytes for ws in self.streams)
+
+    def push(self, state):
+        lps = self.lanes_per_shard
+        if lps is None:
+            lps = state.term.shape[0] // self.n_shards
+        for s, ws in enumerate(self.streams):
+            ws.push(_ShardView(state, s * lps, (s + 1) * lps))
+
+    def flush(self):
+        for ws in self.streams:
+            ws.flush()
+
+
+def merge_shard_deltas(deltas: list[dict]) -> dict:
+    """Concatenate one block's per-shard WAL deltas (shard order) back into
+    the monolithic per-block delta: lanes are contiguous per shard, so a
+    plain per-field concat is byte-identical to an unsharded push."""
+    return {
+        f: np.concatenate([d[f] for d in deltas]) for f in deltas[0]
+    }

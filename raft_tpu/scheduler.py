@@ -54,6 +54,142 @@ from raft_tpu.config import Shape
 from raft_tpu.ops.fused import _SCAN_UNROLL, FusedCluster, LocalOps
 
 
+class BlockPlan:
+    """The blocked-dispatch plan, factored out of BlockedFusedCluster so the
+    mesh driver (parallel/mesh.py) reuses it per shard: validates the
+    (groups, block, chunk, pipeline) factorization up front, owns the
+    global-lane ops slicing + identity LRU, the per-block stream-list
+    checks, and the round-major sweep schedule. It holds no device state —
+    the driver owns the blocks; the plan owns the bookkeeping every blocked
+    driver would otherwise re-implement."""
+
+    _OPS_CACHE_SLOTS = 2
+
+    def __init__(
+        self,
+        n_groups: int,
+        n_voters: int,
+        block_groups: int | None = None,
+        round_chunk: int = 1,
+        pipeline_depth: int | None = None,
+        cfg: dict | None = None,
+    ):
+        block_groups = block_groups or n_groups
+        if n_groups % block_groups:
+            raise ValueError("n_groups must be a multiple of block_groups")
+        if round_chunk < 1:
+            raise ValueError("round_chunk must be >= 1")
+        if pipeline_depth is not None and pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1 (or None)")
+        # up-front RAFT_TPU_UNROLL x K x round_chunk composition check for
+        # the pallas megakernel: a K that does not divide round_chunk
+        # compiles an extra remainder-tail kernel per chunk, and
+        # unroll x K explodes the unrolled program — fail HERE with a
+        # clear error, not mid-dispatch inside Mosaic. Only a pinned K
+        # (ctor kwarg or RAFT_TPU_PALLAS_ROUNDS) is checkable this early;
+        # an autotuned K re-validates at resolve time.
+        from raft_tpu.ops import pallas_round as plr
+
+        cfg = cfg or {}
+        if plr.resolve_engine(cfg.get("engine")) == "pallas":
+            k_req = cfg.get("rounds_per_call")
+            if k_req is None:
+                k_req = plr.env_rounds_per_call()
+            if k_req is not None:
+                plr.validate_round_plan(
+                    k_req, unroll=_SCAN_UNROLL, round_chunk=round_chunk
+                )
+        self.g, self.v = n_groups, n_voters
+        self.block_groups = block_groups
+        self.k = n_groups // block_groups
+        self.lanes_per_block = block_groups * n_voters
+        self.round_chunk = round_chunk
+        self.pipeline_depth = pipeline_depth
+        # small identity LRU: [(ops object, its per-block slices), ...],
+        # most-recent-first, capacity _OPS_CACHE_SLOTS. Holding the ops
+        # references pins their ids, so the identity test can never
+        # false-positive on a recycled address. Two slots (not one) so the
+        # common alternation pattern — a driver flipping between two
+        # prepared ops objects round after round — hits every time instead
+        # of silently re-slicing K subtrees per call.
+        self._ops_cache: list = []
+
+    def prepare_ops(self, ops: LocalOps) -> list[LocalOps]:
+        """Slice a global-lane LocalOps into K per-block bindings ONCE."""
+        per = []
+        for i in range(self.k):
+            lo = i * self.lanes_per_block
+            per.append(
+                jax.tree.map(
+                    lambda x, lo=lo: x[lo : lo + self.lanes_per_block], ops
+                )
+            )
+        return per
+
+    def bind_ops(self, ops, prepare=None) -> list | None:
+        """`prepare` lets the owning driver route cache misses through its
+        OWN prepare_ops (so instance-level wrappers/overrides are honored);
+        defaults to this plan's slicer."""
+        if ops is None:
+            return None
+        if isinstance(ops, list):  # already per-block (prepare_ops). NOT
+            # tuple: LocalOps itself is a NamedTuple.
+            if len(ops) != self.k:
+                raise ValueError(
+                    f"per-block ops list must have one entry per resident "
+                    f"block: got {len(ops)}, expected {self.k}"
+                )
+            return list(ops)
+        for j, (obj, per) in enumerate(self._ops_cache):
+            if obj is ops:
+                if j:  # refresh LRU order
+                    self._ops_cache.insert(0, self._ops_cache.pop(j))
+                return per
+        per = (prepare or self.prepare_ops)(ops)
+        self._ops_cache.insert(0, (ops, per))
+        del self._ops_cache[self._OPS_CACHE_SLOTS:]
+        return per
+
+    def check_streams(self, streams, what: str, kind: str) -> list:
+        try:
+            k = len(streams)
+        except TypeError:
+            raise TypeError(
+                f"{what} must be a sequence of K {kind}s, one per resident "
+                f"block (this scheduler holds K={self.k})"
+            ) from None
+        if k != self.k:
+            raise ValueError(
+                f"{what} must hold one stream per resident block: got {k} "
+                f"stream(s), expected K={self.k} "
+                f"({self.g} groups / {self.block_groups} per block)"
+            )
+        streams = list(streams)
+        # uniqueness, not just length: the same stream object listed for
+        # two blocks would silently interleave both blocks' deltas into one
+        # sink sequence (and double-resolve its single pending slot) — a
+        # config error, never a runtime surprise
+        seen: dict[int, int] = {}
+        for i, s in enumerate(streams):
+            j = seen.setdefault(id(s), i)
+            if j != i:
+                raise ValueError(
+                    f"{what}[{i}] is the same {kind} object as {what}[{j}]: "
+                    f"each resident block needs its own stream (sharing one "
+                    f"would interleave two blocks' deltas in its sink)"
+                )
+        return streams
+
+    def sweep(self, rounds: int):
+        """The round-major schedule: yields (step, first, last) chunks,
+        step <= round_chunk, covering `rounds` rounds."""
+        done = 0
+        while done < rounds:
+            step = min(self.round_chunk, rounds - done)
+            yield step, done == 0, done + step >= rounds
+            done += step
+
+
 class BlockedFusedCluster:
     """`n_groups` total raft groups held as K = n_groups/block_groups
     resident FusedClusters stepped with one shared compiled kernel.
@@ -88,49 +224,26 @@ class BlockedFusedCluster:
         pipeline_depth: int | None = None,
         **cfg,
     ):
-        block_groups = block_groups or n_groups
-        if n_groups % block_groups:
-            raise ValueError("n_groups must be a multiple of block_groups")
-        if round_chunk < 1:
-            raise ValueError("round_chunk must be >= 1")
-        if pipeline_depth is not None and pipeline_depth < 1:
-            raise ValueError("pipeline_depth must be >= 1 (or None)")
-        # up-front RAFT_TPU_UNROLL x K x round_chunk composition check for
-        # the pallas megakernel: a K that does not divide round_chunk
-        # compiles an extra remainder-tail kernel per chunk, and
-        # unroll x K explodes the unrolled program — fail HERE with a
-        # clear error, not mid-dispatch inside Mosaic. Only a pinned K
-        # (ctor kwarg or RAFT_TPU_PALLAS_ROUNDS) is checkable this early;
-        # an autotuned K re-validates at resolve time.
-        from raft_tpu.ops import pallas_round as plr
-
-        if plr.resolve_engine(cfg.get("engine")) == "pallas":
-            k_req = cfg.get("rounds_per_call")
-            if k_req is None:
-                k_req = plr.env_rounds_per_call()
-            if k_req is not None:
-                plr.validate_round_plan(
-                    k_req, unroll=_SCAN_UNROLL, round_chunk=round_chunk
-                )
-        self.g, self.v = n_groups, n_voters
-        self.block_groups = block_groups
-        self.k = n_groups // block_groups
-        self.lanes_per_block = block_groups * n_voters
-        self.round_chunk = round_chunk
-        self.pipeline_depth = pipeline_depth
+        # geometry + ops-slicing + sweep bookkeeping live in the shared
+        # BlockPlan (also driven per shard by parallel/mesh.py)
+        self.plan = BlockPlan(
+            n_groups, n_voters, block_groups,
+            round_chunk=round_chunk, pipeline_depth=pipeline_depth, cfg=cfg,
+        )
+        self.g, self.v = self.plan.g, self.plan.v
+        self.block_groups = self.plan.block_groups
+        self.k = self.plan.k
+        self.lanes_per_block = self.plan.lanes_per_block
+        self.round_chunk = self.plan.round_chunk
+        self.pipeline_depth = self.plan.pipeline_depth
         self._inflight: deque = deque()
-        # small identity LRU: [(ops object, its per-block slices), ...],
-        # most-recent-first, capacity _OPS_CACHE_SLOTS. Holding the ops
-        # references pins their ids, so the identity test can never
-        # false-positive on a recycled address. Two slots (not one) so the
-        # common alternation pattern — a driver flipping between two
-        # prepared ops objects round after round — hits every time instead
-        # of silently re-slicing K subtrees per call.
-        self._ops_cache: list = []
+        # alias, not copy: _bind_ops mutates the plan's LRU in place
+        self._ops_cache = self.plan._ops_cache
         # distinct seeds decorrelate election timeouts across blocks
         self.blocks = [
             FusedCluster(
-                block_groups, n_voters, seed=seed + 7919 * i, shape=shape, **cfg
+                self.block_groups, n_voters, seed=seed + 7919 * i,
+                shape=shape, **cfg
             )
             for i in range(self.k)
         ]
@@ -148,52 +261,13 @@ class BlockedFusedCluster:
         times with zero further host-side slicing (run() also caches the
         slices of the last raw LocalOps it saw, so callers that re-inject
         the same object get this for free)."""
-        per = []
-        for i in range(self.k):
-            lo = i * self.lanes_per_block
-            per.append(
-                jax.tree.map(
-                    lambda x, lo=lo: x[lo : lo + self.lanes_per_block], ops
-                )
-            )
-        return per
+        return self.plan.prepare_ops(ops)
 
     def _bind_ops(self, ops) -> list | None:
-        if ops is None:
-            return None
-        if isinstance(ops, list):  # already per-block (prepare_ops). NOT
-            # tuple: LocalOps itself is a NamedTuple.
-            if len(ops) != self.k:
-                raise ValueError(
-                    f"per-block ops list must have one entry per resident "
-                    f"block: got {len(ops)}, expected {self.k}"
-                )
-            return list(ops)
-        for j, (obj, per) in enumerate(self._ops_cache):
-            if obj is ops:
-                if j:  # refresh LRU order
-                    self._ops_cache.insert(0, self._ops_cache.pop(j))
-                return per
-        per = self.prepare_ops(ops)
-        self._ops_cache.insert(0, (ops, per))
-        del self._ops_cache[self._OPS_CACHE_SLOTS:]
-        return per
+        return self.plan.bind_ops(ops, self.prepare_ops)
 
     def _check_streams(self, streams, what: str, kind: str) -> list:
-        try:
-            k = len(streams)
-        except TypeError:
-            raise TypeError(
-                f"{what} must be a sequence of K {kind}s, one per resident "
-                f"block (this scheduler holds K={self.k})"
-            ) from None
-        if k != self.k:
-            raise ValueError(
-                f"{what} must hold one stream per resident block: got {k} "
-                f"stream(s), expected K={self.k} "
-                f"({self.g} groups / {self.block_groups} per block)"
-            )
-        return list(streams)
+        return self.plan.check_streams(streams, what, kind)
 
     def _check_wal(self, wal) -> list:
         return self._check_streams(wal, "wal", "WalStream")
@@ -251,9 +325,7 @@ class BlockedFusedCluster:
             self._throttle(b)
             return
         done = 0
-        while done < rounds:
-            step = min(self.round_chunk, rounds - done)
-            first, last = done == 0, done + step >= rounds
+        for step, first, last in self.plan.sweep(rounds):
             for i, b in enumerate(self.blocks):
                 o = None
                 if per_ops is not None and (first or not ops_first):

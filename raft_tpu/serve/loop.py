@@ -119,7 +119,13 @@ class ServeLoop:
                 "rides the DeltaBundle sink (unset RAFT_TPU_EGRESS=0)"
             )
         self.cluster = cluster
-        self.blocked = hasattr(cluster, "blocks")  # BlockedFusedCluster
+        # cluster-protocol duck test, not an isinstance/attr-name check on
+        # one concrete class: anything exposing the blocked driving surface
+        # (global-lane prepare_ops + per-block geometry) is driven
+        # block-wise — BlockedFusedCluster and the mesh driver
+        # (parallel/mesh.py MeshBlockedCluster) both qualify; a bare
+        # FusedCluster (no prepare_ops) is driven whole.
+        self.blocked = callable(getattr(cluster, "prepare_ops", None))
         base = cluster.blocks[0] if self.blocked else cluster
         self.g, self.v = cluster.g, cluster.v
         self.n = self.g * self.v

@@ -60,7 +60,11 @@ smokes() {
   # byte-diet A/B smoke (diet on vs off over xla / pallas K=1 / pallas
   # K=AB_K with every observability plane live: one identical trajectory
   # digest across all six arms, >= 30% smaller carry bytes/lane with diet
-  # on, round-time regression gate arms on TPU only)
+  # on, round-time regression gate arms on TPU only) + the multi-chip A/B
+  # smoke (mesh-blocked driver vs the monolithic blocked scheduler on the
+  # forced 8-device CPU mesh: one identical trajectory digest, per-(shard,
+  # block) WAL/egress payloads byte-identical after host-side merge; the
+  # mesh throughput-gain gate arms on real multi-chip TPU only)
   run_bench benches/metrics_smoke.py \
     && run_bench benches/dispatch_ab.py \
     && run_bench benches/egress_ab.py \
@@ -68,7 +72,8 @@ smokes() {
     && run_bench benches/chaos_soak.py --smoke \
     && run_bench benches/serve_bench.py --smoke \
     && run_bench benches/trace_ab.py \
-    && run_bench benches/diet_ab.py --smoke
+    && run_bench benches/diet_ab.py --smoke \
+    && run_bench benches/multichip_ab.py --smoke
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
@@ -77,7 +82,8 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
     # processes keep every process's XLA:CPU compile count far under the
     # crash threshold and the wall time drops ~4x.
     run -n 6 --dist loadfile --max-worker-restart 0 \
-      $(ls tests/test_*.py | grep -v test_sharded) \
+      $(ls tests/test_*.py | grep -v -e test_sharded -e test_mesh) \
+      && run tests/test_mesh.py \
       && run tests/test_sharded.py \
       && smokes
   else
@@ -125,6 +131,11 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
     # distinct dtype signatures) plus one K=4 interpreted megakernel on a
     # packed carry
     run_chunk tests/test_diet.py
+    # the mesh-blocked driver gets its own process before test_sharded:
+    # its sharded x blocked twins are all 8-device shard_map programs
+    # (plus one subprocess A/B child trio), same crash profile as
+    # test_sharded, same autouse no-persistent-cache fixture
+    run_chunk tests/test_mesh.py
     run_chunk tests/test_sharded.py
     smokes
   fi
