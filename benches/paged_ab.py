@@ -1,0 +1,273 @@
+"""Paged entry log A/B: the page-table HBM entry pool (RAFT_TPU_PAGED=1)
+vs the flat `[N, W]` log window, on a Zipfian ragged-depth workload.
+
+The paged layer exists for exactly this profile (ROADMAP item 3): a few
+hot groups run deep replication windows while most groups idle shallow,
+so a flat window makes every lane pay max-W resident bytes for the hot
+minority's depth. Each child elects all groups under a SHALLOW
+compaction lag (every lane fits its resident window), then drives
+proposals whose per-group rate follows a Zipf law at a deep lag: hot
+groups ride at the deep compaction cap and spill into the pool, cold
+groups stay inside their resident tail and never touch it. The paged
+arm pins a pool of about one page per two lanes — a sixth of full
+provisioning (AB_POOL_PAGES override); the Zipfian tail is what makes
+that safe, and error_bits would flag (never silently drop) if not.
+
+Arm matrix (fresh subprocess per arm, planes enabled like diet_ab.py):
+paged off/on x engine (xla, pallas K=1, pallas K=AB_K). One bench JSON
+line per arm plus a summary, with the probes in `extra`:
+
+  - ms_per_round: wall clock over AB_ITERS timed Zipfian sweeps
+  - resident_bytes_per_lane: nbytes of the between-dispatch carry
+    (state + fabric + the paged sidecar: resident tail, page table,
+    pool share) / lanes — the quantity paging exists to shrink
+  - paged_*: pool occupancy / fault / exhaustion counters (paged arm)
+
+Asserted invariants:
+  - all six arms end on ONE identical sha256 digest of the host_state
+    trajectory INCLUDING the log columns — paging is invisible, across
+    engines, at every K
+  - error_bits stays zero everywhere (no silent ERR_PAGE_EXHAUSTED)
+  - the pallas children really ran pallas: no engine fallback
+  - paged-on resident bytes/lane STRICTLY lower than paged-off, on every
+    engine, on every backend (CPU included)
+  - [TPU only] paged-on ms/round <= AB_TOL x paged-off per engine
+    (groups*ticks/s flat or better)
+
+Exit 0 = pass, 1 = regression. `--smoke` shrinks the workload for CI.
+Env: AB_GROUPS, AB_VOTERS, AB_ROUNDS, AB_ITERS, AB_TOL, AB_K,
+AB_POOL_PAGES, RAFT_TPU_* (forwarded to the children verbatim).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DIGEST_FIELDS = (
+    "term", "vote", "lead", "state", "committed", "last",
+    "log_term", "log_type", "log_bytes", "error_bits",
+)
+
+W, PAGE_WINDOW, PAGE_ENTRIES = 16, 8, 4
+
+
+def default_pool(groups: int, v: int) -> int:
+    """About one page per two lanes — full provisioning would be
+    kmax = ceil((W - W_res) / PE) + 1 = 3 pages per lane, but only the
+    Zipf-hot groups outrun their resident window at all."""
+    return max(16, groups * v // 2 + 8)
+
+
+def child():
+    import time
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.config import Shape
+    from raft_tpu.metrics.host import ENGINE_EVENTS
+    from raft_tpu.ops import fused
+
+    engine = os.environ.get("RAFT_TPU_ENGINE", "xla")
+    groups = int(os.environ.get("AB_GROUPS", 4096))
+    v = int(os.environ.get("AB_VOTERS", 3))
+    shape = Shape(
+        n_lanes=groups * v, max_peers=v, log_window=W,
+        max_msg_entries=2, max_inflight=2, max_read_index=2,
+    )
+    c = fused.FusedCluster(groups, v, seed=42, shape=shape)
+    # warm-up compacts SHALLOW (every lane stays inside the resident
+    # window); the Zipfian phase then lets hot groups ride a deep lag
+    lag, deep_lag = PAGE_WINDOW // 2, W - 4
+    rounds = int(os.environ.get("AB_ROUNDS", 16))
+    iters = int(os.environ.get("AB_ITERS", 8))
+
+    c.run(rounds, auto_propose=True, auto_compact_lag=lag)  # compile
+    jax.block_until_ready(c.state.term)
+    warm = 0
+    while len(c.leader_lanes()) < groups:
+        c.run(rounds, auto_propose=True, auto_compact_lag=lag)
+        warm += rounds
+        if warm > 40 * 16:
+            raise RuntimeError("A/B warm-up stalled before full election")
+    jax.block_until_ready(c.state.term)
+
+    # Zipf-ranked proposal rates: group at rank r proposes every 2^min(r,
+    # bucket_cap) sweeps (rank 0 = hottest, proposing 2 entries per sweep).
+    # Deterministic, so every arm drives the bit-identical trajectory; the
+    # rank->group assignment is a seeded shuffle so hot groups are spread
+    # across the batch (and across shards/blocks if this shape is reused).
+    rng = np.random.default_rng(7)
+    rank_of = rng.permutation(groups)
+    leader_of = {}
+    for lane in c.leader_lanes():
+        leader_of.setdefault(int(lane) // v, int(lane))
+
+    def zipf_sweep(sweep: int):
+        prop = {}
+        for g, lane in leader_of.items():
+            period = 1 << min(int(rank_of[g]).bit_length(), 5)
+            if sweep % period == 0:
+                prop[lane] = 2 if rank_of[g] == 0 else 1
+        return c.ops(prop_n=prop)
+
+    for s in range(4):  # shape the Zipfian depth profile before timing
+        c.run(rounds, ops=zipf_sweep(s), auto_compact_lag=deep_lag)
+    jax.block_until_ready(c.state.term)
+
+    t0 = time.perf_counter()
+    for s in range(iters):
+        c.run(rounds, ops=zipf_sweep(s), auto_compact_lag=deep_lag)
+    jax.block_until_ready(c.state.term)
+    ms_per_round = (time.perf_counter() - t0) / (rounds * iters) * 1e3
+
+    lanes = groups * v
+    resident = sum(x.nbytes for x in jax.tree.leaves(c.state)) + sum(
+        x.nbytes for x in jax.tree.leaves(c.fab)
+    )
+    if c.paged is not None:
+        resident += sum(x.nbytes for x in jax.tree.leaves(c.paged))
+    stats = c.paged_stats() or {}
+
+    # digest over host_state() INCLUDING the log columns: the paged arm
+    # must reconstruct the exact window the flat arm carries natively
+    st = c.host_state()
+    digest = hashlib.sha256()
+    for name in DIGEST_FIELDS:
+        digest.update(np.ascontiguousarray(np.asarray(getattr(st, name))).tobytes())
+    c.check_no_errors()
+    print(json.dumps({
+        "config": f"paged_ab:{engine}:paged={os.environ.get('RAFT_TPU_PAGED', '0')}",
+        "value": round(ms_per_round, 4),
+        "unit": "ms/round",
+        "extra": {
+            "engine_requested": engine,
+            "engine_after": c.engine,
+            "fallbacks": ENGINE_EVENTS.get("engine_pallas_fallback"),
+            "paged": c.paged is not None,
+            "ms_per_round": ms_per_round,
+            "resident_bytes_per_lane": resident / lanes,
+            "groups_ticks_per_s": groups * 1e3 / max(ms_per_round, 1e-9),
+            "digest": digest.hexdigest(),
+            "backend": jax.default_backend(),
+            **stats,
+        },
+    }), flush=True)
+
+
+def run_child(engine: str, paged: str, extra_env: dict | None = None) -> dict:
+    groups = int(os.environ.get("AB_GROUPS", 4096))
+    v = int(os.environ.get("AB_VOTERS", 3))
+    env = dict(
+        os.environ,
+        RAFT_TPU_ENGINE=engine,
+        RAFT_TPU_PAGED=paged,
+        # the acceptance matrix runs with every observability plane live
+        RAFT_TPU_METRICS="1",
+        RAFT_TPU_CHAOS="1",
+        RAFT_TPU_TRACELOG="1",
+    )
+    if paged == "1":
+        env.setdefault("RAFT_TPU_PAGE_WINDOW", str(PAGE_WINDOW))
+        env.setdefault("RAFT_TPU_PAGE_ENTRIES", str(PAGE_ENTRIES))
+        env.setdefault(
+            "RAFT_TPU_POOL_PAGES",
+            os.environ.get("AB_POOL_PAGES", str(default_pool(groups, v))),
+        )
+    if extra_env:
+        env.update(extra_env)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    if "--smoke" in sys.argv:
+        os.environ.setdefault("AB_GROUPS", "8")
+        os.environ.setdefault("AB_ROUNDS", "4")
+        os.environ.setdefault("AB_ITERS", "2")
+    tol = float(os.environ.get("AB_TOL", 1.05))
+    ab_k = int(os.environ.get("AB_K", 4))
+    arms = {}
+    for eng, kenv in (
+        ("xla", None),
+        ("pallas", {"RAFT_TPU_PALLAS_ROUNDS": "1"}),
+        (f"pallas K={ab_k}", {"RAFT_TPU_PALLAS_ROUNDS": str(ab_k)}),
+    ):
+        for paged in ("0", "1"):
+            r = run_child(eng.split()[0], paged, kenv)
+            print(json.dumps(r), flush=True)
+            arms[(eng, paged)] = r
+
+    fails = []
+    base = arms[("xla", "0")]["extra"]
+    on_tpu = base["backend"] == "tpu"
+    for key, r in arms.items():
+        ex = r["extra"]
+        if ex["digest"] != base["digest"]:
+            fails.append(
+                f"{key}: trajectory digest diverged from xla paged-off — "
+                "paging is not invisible"
+            )
+        if ex["engine_requested"] == "pallas" and (
+            ex["engine_after"] != "pallas" or ex["fallbacks"]
+        ):
+            fails.append(
+                f"{key}: child fell back to {ex['engine_after']} "
+                f"({ex['fallbacks']} fallback(s))"
+            )
+        if ex.get("paged_exhausted"):
+            fails.append(
+                f"{key}: pool exhausted {ex['paged_exhausted']} times — "
+                "the Zipfian tail no longer fits the undersized pool"
+            )
+    for eng in ("xla", "pallas", f"pallas K={ab_k}"):
+        off = arms[(eng, "0")]["extra"]
+        on = arms[(eng, "1")]["extra"]
+        if on["resident_bytes_per_lane"] >= off["resident_bytes_per_lane"]:
+            fails.append(
+                f"{eng}: paged resident bytes/lane not strictly lower "
+                f"({off['resident_bytes_per_lane']:.1f} -> "
+                f"{on['resident_bytes_per_lane']:.1f})"
+            )
+        ratio = arms[(eng, "1")]["value"] / max(arms[(eng, "0")]["value"], 1e-9)
+        if on_tpu and ratio > tol:
+            fails.append(
+                f"{eng}: paging regressed round time "
+                f"(ratio {ratio:.3f} > tol {tol})"
+            )
+    on_x = arms[("xla", "1")]["extra"]
+    print(json.dumps({
+        "metric": "paged_ab",
+        "ok": not fails,
+        "resident_bytes_per_lane_off": base["resident_bytes_per_lane"],
+        "resident_bytes_per_lane_on": on_x["resident_bytes_per_lane"],
+        "shrink_pct": round(
+            100 * (1 - on_x["resident_bytes_per_lane"]
+                   / base["resident_bytes_per_lane"]), 1,
+        ),
+        "pool_in_use": on_x.get("paged_pool_in_use"),
+        "pool_pages": on_x.get("paged_pool_pages"),
+        "page_faults": on_x.get("paged_page_faults"),
+        "megakernel_k": ab_k,
+        "tpu_gates": on_tpu,
+        "tol": tol,
+    }), flush=True)
+    for f in fails:
+        print(f"FAIL: {f}", file=sys.stderr)
+    sys.exit(1 if fails else 0)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -13,7 +13,14 @@ PROBE_DIET=0/1 forces the diet-v2 packed carry (RAFT_TPU_DIET) off/on for
 every rung, and each rung's JSON line carries live_bytes_per_lane (the
 utils/profiling.py live-buffer probe over the resident carry) — run the
 ladder twice with the knob flipped and the pair is the byte-diet
-acceptance artifact (ISSUE 9: >= 30% lower bytes/lane with diet on)."""
+acceptance artifact (ISSUE 9: >= 30% lower bytes/lane with diet on).
+
+PROBE_PAGED=0/1 does the same for the paged entry log (RAFT_TPU_PAGED,
+ISSUE 11 / BENCH_r06): each rung's JSON line grows pool-occupancy
+(paged_pool_in_use / paged_pool_pages / paged_page_faults /
+paged_exhausted) and paged_bytes_per_lane columns, so a flipped pair of
+ladders is the paged acceptance artifact. Pin RAFT_TPU_PAGE_WINDOW /
+RAFT_TPU_POOL_PAGES to probe sub-full-provisioning pools."""
 
 from __future__ import annotations
 
@@ -31,6 +38,31 @@ from raft_tpu.utils.compile_cache import enable_persistent_cache
 if jax.default_backend() != "cpu":
     enable_persistent_cache()
 import jax.numpy as jnp
+
+
+def paged_columns(c) -> dict:
+    """Pool-occupancy / sidecar bytes-per-lane columns for the
+    PROBE_PAGED=1 arm (the BENCH_r06 rung), summed over resident blocks;
+    {"paged": 0} when RAFT_TPU_PAGED is off. Works on FusedCluster,
+    BlockedFusedCluster and MeshBlockedCluster rungs alike (the mesh's
+    blocks are sharded wrappers around an inner FusedCluster)."""
+    from raft_tpu.ops import paged as pgmod
+
+    pools = []
+    for b in getattr(c, "blocks", [c]):
+        b = getattr(b, "inner", b)
+        if getattr(b, "paged", None) is not None:
+            pools.append(b.paged)
+    if not pools:
+        return {"paged": 0}
+    stats = [pgmod.paged_stats(p) for p in pools]
+    n_lanes = sum(p.pt.shape[0] for p in pools)
+    side = sum(pgmod.paged_bytes_per_lane(p) * p.pt.shape[0] for p in pools)
+    out = {"paged": 1, "paged_bytes_per_lane": round(side / n_lanes, 1)}
+    for k in ("paged_pool_in_use", "paged_pool_pages", "paged_page_faults",
+              "paged_exhausted"):
+        out[k] = sum(s[k] for s in stats)
+    return out
 
 
 def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
@@ -91,6 +123,7 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
                 "compile_s": round(compile_s, 1),
                 "diet": int(os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")),
                 "live_bytes_per_lane": round(live_per_lane, 1),
+                **paged_columns(c),
                 **mem,
             }
         ),
@@ -155,6 +188,7 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
                 "compile_s": round(compile_s, 1),
                 "diet": int(os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")),
                 "live_bytes_per_lane": round(live_per_lane, 1),
+                **paged_columns(c),
                 **mem,
             }
         ),
@@ -230,6 +264,7 @@ def measure_mesh(n_groups, n_voters, block_groups, block=32, iters=5,
                 "compile_s": round(compile_s, 1),
                 "diet": int(os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")),
                 "live_bytes_per_lane": round(live_per_lane, 1),
+                **paged_columns(c),
                 **mem,
             }
         ),
@@ -243,6 +278,11 @@ if __name__ == "__main__":
         # the ladder doubles as the diet-v2 acceptance artifact: force the
         # packed-carry knob off/on for every rung from one place
         os.environ["RAFT_TPU_DIET"] = os.environ["PROBE_DIET"]
+    if os.environ.get("PROBE_PAGED") is not None:
+        # same pattern for the paged entry log (ISSUE 11): flip
+        # RAFT_TPU_PAGED for every rung and each JSON line grows the
+        # pool-occupancy + paged_bytes_per_lane columns
+        os.environ["RAFT_TPU_PAGED"] = os.environ["PROBE_PAGED"]
     voters = int(os.environ.get("PROBE_VOTERS", 3))
     w = int(os.environ.get("PROBE_WINDOW", 16))
     e = int(os.environ.get("PROBE_ENTRIES", 2))

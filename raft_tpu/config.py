@@ -18,6 +18,12 @@ from __future__ import annotations
 
 import dataclasses
 
+# Diet-v2 stores rebased index columns as uint16; the post-rebase index
+# space is a few windows plus the between-rebase growth budget, so the
+# window itself must stay far under 2^16. Named here so the validation
+# message and the pack-boundary docs point at one constant.
+MAX_LOG_WINDOW = 1 << 14
+
 
 @dataclasses.dataclass(frozen=True)
 class Shape:
@@ -33,7 +39,22 @@ class Shape:
       log_window: entries resident on device per lane ("W", circular).
         Mirrors the bounded in-memory log the reference keeps between
         compactions (storage.go:98-120 + log_unstable.go); older entries
-        live host-side. Must be a power of two.
+        live host-side. Must be a power of two. The default stays 64 —
+        deep enough that the serve/chaos planes never hit
+        ERR_WINDOW_OVERFLOW out of the box — while the benches and the
+        residency probes pin W=16 explicitly (that is the measured
+        capacity shape, not the default; see benches/scaling_probe.py).
+        Under RAFT_TPU_PAGED only page_window entries of W stay in the
+        resident carry; the rest live in the paged HBM pool.
+      page_window: paged entry log (RAFT_TPU_PAGED) resident entries per
+        lane ("W_res", power of two, 2 <= W_res < W). 0 -> derived at
+        cluster construction: env RAFT_TPU_PAGE_WINDOW, else min(8, W/2).
+      page_entries: entries per pool page ("PE", power of two <= W).
+        0 -> derived: env RAFT_TPU_PAGE_ENTRIES, else min(4, page_window).
+      pool_pages: total pages in the shared HBM entry pool ("P"; page 0 is
+        a reserved trash row, ids are uint16). 0 -> derived: env
+        RAFT_TPU_POOL_PAGES, else full provisioning (never exhausts) —
+        see ops/paged.py resolve_page_plan.
       max_msg_entries: entries carried per MsgApp ("E") — the static-shape
         version of Config.MaxSizePerMsg's "limit in entries" role
         (reference: raft.go:188-192).
@@ -57,6 +78,13 @@ class Shape:
     # exists so the int16 claim is validated where the configuration is.
     max_entry_bytes: int = 32767
     outbox: int = 0  # 0 -> derived
+    # Paged entry log geometry (RAFT_TPU_PAGED, ops/paged.py). 0 -> derived
+    # at cluster construction (env knob, then a safe default); nonzero
+    # values are validated here so a bad explicit geometry fails at
+    # config time from every cluster constructor, never at dispatch.
+    page_window: int = 0
+    page_entries: int = 0
+    pool_pages: int = 0
 
     def __post_init__(self):
         if self.log_window & (self.log_window - 1):
@@ -78,17 +106,44 @@ class Shape:
                 "max_peers must be in 1..32 (diet-v2 packs the [N, V] bool "
                 "masks into one bitset word per lane)"
             )
-        if self.log_window > 1 << 14:
+        if self.log_window > MAX_LOG_WINDOW:
             raise ValueError(
-                "log_window must be <= 16384 (diet-v2 stores rebased index "
-                "columns as uint16; the post-rebase space is a few windows "
-                "plus the between-rebase growth budget)"
+                f"log_window must be <= MAX_LOG_WINDOW={MAX_LOG_WINDOW} "
+                "(diet-v2 stores rebased index columns as uint16; the "
+                "post-rebase space is a few windows plus the between-rebase "
+                "growth budget)"
             )
         if not 1 <= self.max_entry_bytes <= 32767:
             raise ValueError(
                 "max_entry_bytes must be in 1..32767 (diet-v2 stores entry "
                 "size columns as int16)"
             )
+        # paged entry log geometry: each nonzero field validates on its own
+        # here (config-time, constructor-independent); the cross-field plan
+        # (derived defaults, pool-vs-lanes sizing) is resolved and validated
+        # by ops/paged.py validate_page_plan from the cluster constructors.
+        if self.page_window:
+            if self.page_window & (self.page_window - 1):
+                raise ValueError("page_window must be a power of two")
+            if not 2 <= self.page_window < self.log_window:
+                raise ValueError(
+                    "page_window must be in 2..log_window/2 (the paged "
+                    "resident window is a strict subset of log_window)"
+                )
+        if self.page_entries:
+            if self.page_entries & (self.page_entries - 1):
+                raise ValueError("page_entries must be a power of two")
+            if not 1 <= self.page_entries <= self.log_window:
+                raise ValueError(
+                    "page_entries must be in 1..log_window (a page never "
+                    "holds more than one window)"
+                )
+        if self.pool_pages:
+            if not 2 <= self.pool_pages <= (1 << 16):
+                raise ValueError(
+                    "pool_pages must be in 2..65536 (page ids are uint16 "
+                    "with page 0 reserved as the trash row)"
+                )
 
     @property
     def n(self) -> int:

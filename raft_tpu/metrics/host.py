@@ -170,6 +170,23 @@ TRACE_COUNTERS = (
     "trace_events_dropped",
 )
 
+# paged-entry-log counter families (host plane — computed lazily from the
+# PagedLog sidecar by FusedCluster.paged_stats / metrics_snapshot, never
+# per dispatch: the device arrays are monotone accumulators, the host
+# plane just mirrors the latest snapshot):
+#   paged_pool_in_use      gauge: pool pages currently mapped by any lane's
+#                          page table (occupancy, not cumulative)
+#   paged_page_faults      cumulative pages gathered from the pool at
+#                          dispatch entry (page_in), summed over lanes
+#   paged_exhausted        cumulative page_out clamp events (lane x
+#                          dispatch); nonzero means ERR_PAGE_EXHAUSTED is
+#                          set on some lane — raise pool_pages
+PAGED_COUNTERS = (
+    "paged_pool_in_use",
+    "paged_page_faults",
+    "paged_exhausted",
+)
+
 
 class HostCounters:
     """Plain host-side counter bag speaking the snapshot schema — the
@@ -402,4 +419,33 @@ def record_engine_fallback(key: str, err) -> None:
             key,
             type(err).__name__ if isinstance(err, BaseException) else "error",
             err,
+        )
+
+
+# --------------------------------------------------------------------------
+# paged entry log (host plane)
+
+# Process-wide mirror of the PagedLog device accumulators. Updated by
+# record_paged_stats at the host sync points that already touch the device
+# (metrics_snapshot, check_no_errors, benches) — the gauges are levels
+# (set, not inc) so re-recording the same snapshot is idempotent.
+PAGED_EVENTS = HostCounters()
+
+
+def record_paged_stats(stats: dict) -> None:
+    """Mirror one ops/paged.py paged_stats() snapshot onto the host plane;
+    warn (rate-limited, never silent) when exhaustion clamps appeared."""
+    from raft_tpu.logging import warn_rate_limited
+
+    for name in PAGED_COUNTERS:
+        PAGED_EVENTS.set(name, int(stats.get(name, 0)))
+    if stats.get("paged_exhausted", 0):
+        warn_rate_limited(
+            "paged_exhausted",
+            60.0,
+            "paged entry pool exhausted: %d lane-dispatch clamp events so "
+            "far (ERR_PAGE_EXHAUSTED set on the affected lanes; raise "
+            "Shape.pool_pages / RAFT_TPU_POOL_PAGES — pool holds %d pages)",
+            int(stats.get("paged_exhausted", 0)),
+            int(stats.get("paged_pool_pages", 0)),
         )

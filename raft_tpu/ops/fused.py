@@ -68,6 +68,7 @@ from raft_tpu.metrics import device as metmod
 from raft_tpu.trace import device as trmod
 from raft_tpu.ops import log as lg
 from raft_tpu.ops import onehot as ohm
+from raft_tpu.ops import paged as pgmod
 from raft_tpu.ops import progress as pg
 from raft_tpu.ops import quorum as qr
 from raft_tpu.ops import step as stepmod
@@ -1664,6 +1665,7 @@ def fused_rounds(
     chaos: "chmod.ChaosState | None" = None,
     trace: "trmod.TraceState | None" = None,
     trace_lane_offset=None,
+    paged: "pgmod.PagedLog | None" = None,
 ):
     """n_rounds fused rounds in one dispatch. `ops` applies to the first
     round only (one-shot injections) unless ops_first_round_only=False.
@@ -1691,7 +1693,15 @@ def fused_rounds(
     round's per-lane transitions are detected from the (pre, post) fat
     state diff and ring-appended (trace/device.py record_round), and the
     carry is appended to the return tuple. trace_lane_offset (a traced
-    scalar, sharded dispatch) globalizes the event lane stamps."""
+    scalar, sharded dispatch) globalizes the event lane stamps.
+
+    paged: optional paged entry log sidecar (ops/paged.py); when set the
+    incoming state carries only the resident [N, W_res] log tail — the
+    full [N, W] window is reconstructed here (page_in), the scan runs on
+    it unchanged, and the result re-splits (page_out) before returning,
+    with the updated PagedLog appended LAST in the result tuple. None
+    compiles the exact unpaged program plus a stale-slot scrub so raw
+    carries and stream bytes match paged mode bit-for-bit."""
     from raft_tpu.state import fat_state, is_packed, slim_state
 
     if chaos is not None and straddle is not None:
@@ -1709,6 +1719,10 @@ def fused_rounds(
     else:
         state = slim_state(state)
         fab = slim_fabric(fab)
+    if paged is not None:
+        # reconstruct the full [N, W] window from resident tail + pool;
+        # the scan below is byte-identical to the unpaged program
+        state, paged = pgmod.page_in(state, paged)
     peer_mute = None
     if mute is not None:
         # loop-invariant across the scan: hoist the [N,V] sender-mute matrix
@@ -1786,6 +1800,14 @@ def fused_rounds(
         jnp.arange(n_rounds, dtype=I32),
         unroll=min(_SCAN_UNROLL, n_rounds),
     )
+    if paged is not None:
+        # re-split into resident tail + pool (page_out output is
+        # canonical-by-construction: stale slots read back as zeros)
+        state, paged = pgmod.page_out(state, paged)
+    else:
+        # unpaged exit keeps the same canonical layout so raw carries,
+        # WAL deltas and digests match across paged on/off
+        state = lg.scrub_stale_slots(state)
     res = (state, fab)
     if metrics is not None:
         res += (metrics,)
@@ -1793,6 +1815,8 @@ def fused_rounds(
         res += (chaos,)
     if trace is not None:
         res += (trace,)
+    if paged is not None:
+        res += (paged,)
     return res
 
 
@@ -1816,7 +1840,7 @@ _fused_rounds_jit = jax.jit(
     fused_rounds,
     static_argnames=_FUSED_STATIC,
     donate_argnums=(0, 1),
-    donate_argnames=("metrics", "chaos", "trace"),
+    donate_argnames=("metrics", "chaos", "trace", "paged"),
 )
 
 # copying twin: inputs survive the dispatch (stale host references stay
@@ -1932,6 +1956,20 @@ class FusedCluster:
         # construction (default OFF); trace=None keeps the whole flight
         # recorder out of the jaxpr — asserted by tests/test_trace.py
         self.trace = trmod.init_trace(n) if trmod.tracelog_enabled() else None
+        # paged entry log (RAFT_TPU_PAGED, ops/paged.py — read once at
+        # construction like the diet): the geometry resolves/validates NOW
+        # (raise, never fall back), then the full-window carry splits into
+        # resident tail + pool sidecar. paged=None keeps the split out of
+        # the jaxpr entirely.
+        self.paged = None
+        self._page_plan = None
+        # sub-pool segment count for the host-boundary paged ops: 1 here;
+        # ShardedFusedCluster sets n_shards so host views interpret the
+        # dispatch-allocated shard-local page ids correctly
+        self._paged_segs = 1
+        if pgmod.paged_enabled():
+            self._page_plan = pgmod.validate_page_plan(self.shape, n)
+            self.state, self.paged = pgmod.split_state(self.state, self._page_plan)
 
     # -- driving ----------------------------------------------------------
 
@@ -1996,6 +2034,7 @@ class FusedCluster:
                     metrics=self.metrics,
                     chaos=self.chaos,
                     trace=self.trace,
+                    paged=self.paged,
                 )
         else:
             res = _fused_rounds_nodonate_jit(
@@ -2012,6 +2051,7 @@ class FusedCluster:
                 metrics=self.metrics,
                 chaos=self.chaos,
                 trace=self.trace,
+                paged=self.paged,
             )
         self.state, self.fab = res[0], res[1]
         i = 2
@@ -2023,6 +2063,9 @@ class FusedCluster:
             i += 1
         if self.trace is not None:
             self.trace = res[i]
+            i += 1
+        if self.paged is not None:
+            self.paged = res[i]
         if wal is not None:
             # the WAL streams the slim-canonical view (byte-identical diet
             # on/off); unpack_state is the identity when the carry is slim,
@@ -2117,6 +2160,7 @@ class FusedCluster:
             metrics=self.metrics,
             chaos=self.chaos,
             trace=self.trace,
+            paged=self.paged,
         )
         try:
             plr.maybe_force_fail()
@@ -2239,6 +2283,7 @@ class FusedCluster:
             interpret=False,
             metrics=self.metrics,
             chaos=self.chaos,
+            paged=self.paged,
         )
         args = (self.state, self.fab, self._no_ops, self.mute)
         jax.block_until_ready(
@@ -2341,7 +2386,16 @@ class FusedCluster:
         mj = jnp.asarray(mask)
         self._flush_stream_fences()
         packed = is_packed(self.state)
-        st, fb = unpack_state(self.state), unpack_fabric(self.fab)
+        carry = self.state
+        if self.paged is not None:
+            # rebase deltas are W-aligned but need not be M-aligned in
+            # page-key space, so the page table cannot be shifted in
+            # place: page in to the full window first, page out after
+            # (page_out realloc-from-scratch rebuilds pool + tables)
+            carry, self.paged = pgmod.page_in_host(
+                carry, self.paged, self._paged_segs
+            )
+        st, fb = unpack_state(carry), unpack_fabric(self.fab)
         if self._donate:
             with _no_persistent_cache():
                 st = slim_state(_rebase_indexes_donate_jit(st, mj, dj))
@@ -2351,6 +2405,8 @@ class FusedCluster:
             fb = slim_fabric(rebase_fabric(fat_fabric(fb), dj))
         if packed:
             st, fb = pack_state(st), pack_fabric(fb)
+        if self.paged is not None:
+            st, self.paged = pgmod.page_out_host(st, self.paged, self._paged_segs)
         self.state, self.fab = st, fb
         # any rebase (manual fast-forward included) moves the index space
         # out from under the headroom counter — force a device re-sync on
@@ -2415,10 +2471,15 @@ class FusedCluster:
         """The state view the WAL/host planes stream: slim-canonical
         dtypes, absolute int32 index columns, [N, V] bool masks. The
         identity when diet is off, so streamed bytes are identical diet
-        on/off (asserted by tests/test_diet.py)."""
+        on/off (asserted by tests/test_diet.py). Under RAFT_TPU_PAGED the
+        full [N, W] window reconstructs from the pool first, so streamed
+        bytes are identical paged on/off too (tests/test_paged.py)."""
         from raft_tpu.state import unpack_state
 
-        return unpack_state(self.state)
+        carry = self.state
+        if self.paged is not None:
+            carry = pgmod.page_in_view(carry, self.paged, self._paged_segs)
+        return unpack_state(carry)
 
     def host_state(self):
         """Host-reader view of the carry (see _wal_view); raw `self.state`
@@ -2431,9 +2492,12 @@ class FusedCluster:
         host_state() used by the confchange driver."""
         from raft_tpu.state import is_packed, pack_state, slim_state
 
-        self.state = (
-            pack_state(st) if is_packed(self.state) else slim_state(st)
-        )
+        st = pack_state(st) if is_packed(self.state) else slim_state(st)
+        if self.paged is not None:
+            # split the adopted full-window state back into resident tail
+            # + pool (page_out canonicalizes stale slots on the way)
+            st, self.paged = pgmod.page_out_host(st, self.paged, self._paged_segs)
+        self.state = st
 
     @classmethod
     def restore_from_wal(
@@ -2471,7 +2535,13 @@ class FusedCluster:
         # unpacked view) — restore into that layout, then re-pack if the
         # freshly-built carry is diet-v2 packed
         packed = is_packed(c.state)
-        st = unpack_state(c.state)
+        carry = c.state
+        if c.paged is not None:
+            # restore into the FULL window, then re-split below: the WAL
+            # delta's log columns are [N, W], and the split repopulates
+            # the page pool + tables from the restored entries
+            carry = pgmod.page_in_view(carry, c.paged, c._paged_segs)
+        st = unpack_state(carry)
         upd = {}
         for f in WalStream.FIELDS:  # the stream schema IS the restore set
             cur = getattr(st, f)
@@ -2486,7 +2556,10 @@ class FusedCluster:
                 np.asarray(log_bytes), dtype=st.log_bytes.dtype
             )
         st = slim_state(dc.replace(st, **upd))
-        c.state = pack_state(st) if packed else st
+        st = pack_state(st) if packed else st
+        if c.paged is not None:
+            st, c.paged = pgmod.page_out_host(st, c.paged, c._paged_segs)
+        c.state = st
         return c
 
     # -- inspection -------------------------------------------------------
@@ -2498,7 +2571,29 @@ class FusedCluster:
         if self.metrics is None:
             return None
         self._metrics_acc.pull(self.metrics)
-        return self._metrics_acc.snapshot()
+        snap = self._metrics_acc.snapshot()
+        if self.paged is not None:
+            # paged-pool pressure rides the same snapshot (this is already
+            # a host sync point, so the lazy occupancy sum costs nothing
+            # extra); also mirrors onto metrics/host.py PAGED_COUNTERS
+            for k, val in (self.paged_stats() or {}).items():
+                snap["counters"][k] = val
+        return snap
+
+    def paged_stats(self) -> dict | None:
+        """Occupancy/fault/exhaustion snapshot of the paged entry log
+        (ops/paged.py paged_stats; None when RAFT_TPU_PAGED=0). Mirrors
+        onto the metrics host plane (metrics/host.py PAGED_COUNTERS) and
+        fires the rate-limited exhaustion warning. Forces a device sync —
+        call at host sync points (benches, snapshots), never per
+        dispatch."""
+        if self.paged is None:
+            return None
+        from raft_tpu.metrics.host import record_paged_stats
+
+        stats = pgmod.paged_stats(self.paged)
+        record_paged_stats(stats)
+        return stats
 
     def leader_lanes(self):
         import numpy as np
@@ -2563,6 +2658,10 @@ class FusedCluster:
         import numpy as np
 
         bits = np.asarray(self.state.error_bits)
+        if self.paged is not None and (bits & pgmod.ERR_PAGE_EXHAUSTED).any():
+            # surface the exhaustion on the host plane (counter + rate-
+            # limited warning) before the assertion below reports it
+            self.paged_stats()
         assert (bits == 0).all(), (
             f"error_bits set: lanes {np.nonzero(bits)[0].tolist()}"
         )

@@ -46,6 +46,38 @@ INDEX_OVERFLOW_MARGIN = 1 << 30
 # so the error_bits flag family reads as one table)
 from raft_tpu.state import ERR_DIET_OVERFLOW  # noqa: E402,F401
 
+# paged entry log pool exhaustion (ops/paged.py page_out clamp)
+from raft_tpu.state import ERR_PAGE_EXHAUSTED  # noqa: E402,F401
+
+
+def scrub_stale_slots(state: RaftState) -> RaftState:
+    """Zero every log slot outside the live window (idx <= snap_index).
+
+    The circular window leaves compacted/overwritten entries as garbage in
+    their slots; nothing device-side reads them, but the paged entry log
+    needs a canonical zeros-outside-window layout so that a paged round
+    trip (page_out -> page_in, which reconstructs absent slots as zeros)
+    is bit-identical to never having paged at all. Both engines run this
+    on the UNPAGED exit path too, so raw carries, WAL deltas and digests
+    match across paged on/off. Works on slim and diet-packed columns alike
+    (mask math is done in int32; the column dtypes are preserved).
+    """
+    n, w = state.log_term.shape
+    s = jnp.arange(w, dtype=I32)[None, :]
+    last = state.last.astype(I32)[:, None]
+    idx = last - ((last - s) & (w - 1))
+    stale = idx <= state.snap_index.astype(I32)[:, None]
+
+    def z(col):
+        return jnp.where(stale, jnp.zeros((), col.dtype), col)
+
+    return dataclasses.replace(
+        state,
+        log_term=z(state.log_term),
+        log_type=z(state.log_type),
+        log_bytes=z(state.log_bytes),
+    )
+
 
 def _err(state: RaftState, cond, bit: int) -> RaftState:
     return dataclasses.replace(

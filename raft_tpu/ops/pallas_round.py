@@ -96,6 +96,8 @@ except Exception:  # pragma: no cover - interpret mode works without SMEM
 from raft_tpu.chaos import device as chmod
 from raft_tpu.metrics import device as metmod
 from raft_tpu.ops import fused as fmod
+from raft_tpu.ops import log as lgmod
+from raft_tpu.ops import paged as pgmod
 from raft_tpu.state import fat_state, is_packed, slim_state, unpack_state
 from raft_tpu.trace import device as trmod
 
@@ -407,6 +409,7 @@ def pallas_rounds(
     chaos=None,
     trace=None,
     trace_lane_offset=None,
+    paged=None,
 ):
     """n_rounds fused rounds as a scan of K-round megakernel pallas_calls
     over group-aligned lane tiles (rounds_per_call = K), plus one
@@ -420,7 +423,14 @@ def pallas_rounds(
     boundary states only exist per round at K=1, so a trace-enabled run
     routes to rounds_per_call=1 (the kernel itself is unchanged, no VMEM
     growth, and the event stream is bit-identical to the XLA engine's by
-    construction)."""
+    construction).
+
+    paged: the paged entry log (ops/paged.py) reconstructs the full
+    [N, W] window BEFORE the kernel specs are built and re-splits after
+    the scan, all inside this jit — the megakernel itself is untouched
+    (it sees the same full-window tiles as ever), so K>1 bit-identity is
+    structural; what the pool reduces is the between-dispatch resident
+    carry, not in-kernel VMEM."""
     maybe_force_fail()
     validate_round_plan(rounds_per_call)
     # diet-v2: a packed carry (bitset masks + u16 indexes) rides the
@@ -434,6 +444,8 @@ def pallas_rounds(
     else:
         state = slim_state(state)
         fab = fmod.slim_fabric(fab)
+    if paged is not None:
+        state, paged = pgmod.page_in(state, paged)
     n = state.term.shape[0]
     check_tile(n, v, tile_lanes)
 
@@ -766,8 +778,14 @@ def pallas_rounds(
         # a second, remainder-sized megakernel program in the same trace
         carry = run_block(make_call(rem), rem, carry, n_full == 0)
     flat_s, flat_f, metrics, chaos, trace = carry
+    state_out = jax.tree.unflatten(tree_s, flat_s)
+    if paged is not None:
+        state_out, paged = pgmod.page_out(state_out, paged)
+    else:
+        # canonical layout on the unpaged exit too, mirroring fused_rounds
+        state_out = lgmod.scrub_stale_slots(state_out)
     res = (
-        jax.tree.unflatten(tree_s, flat_s),
+        state_out,
         jax.tree.unflatten(tree_f, flat_f),
     )
     if metrics is not None:
@@ -776,6 +794,8 @@ def pallas_rounds(
         res += (chaos,)
     if trace is not None:
         res += (trace,)
+    if paged is not None:
+        res += (paged,)
     return res
 
 
@@ -798,7 +818,7 @@ _pallas_rounds_jit = jax.jit(
     pallas_rounds,
     static_argnames=_PALLAS_STATIC,
     donate_argnums=(0, 1),
-    donate_argnames=("metrics", "chaos", "trace"),
+    donate_argnames=("metrics", "chaos", "trace", "paged"),
 )
 _pallas_rounds_nodonate_jit = jax.jit(
     pallas_rounds, static_argnames=_PALLAS_STATIC

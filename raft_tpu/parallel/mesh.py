@@ -108,6 +108,14 @@ class MeshBlockedCluster:
         self.straddle = straddle
         self._inflight: deque = deque()
         self._ops_cache = self.plan._ops_cache
+        # paged geometry fails here, before any block/shard allocates (the
+        # validate_round_plan contract; scheduler.py does the same) — and
+        # the per-shard sub-pool split is checked against the mesh size
+        if shape is not None:
+            from raft_tpu.ops import paged as pgmod
+
+            if pgmod.paged_enabled():
+                pgmod.validate_page_plan(shape, self.lanes_per_block)
         # the scheduler's block-seed scheme: trajectories match an
         # equal-total-groups BlockedFusedCluster bit for bit
         self.blocks = [
@@ -411,5 +419,24 @@ class MeshBlockedCluster:
                 c.block_groups, n_voters, d_i, seed=seed + 7919 * i,
                 shape=shape, log_bytes=lb_i, **cfg
             )
-            b.inner.state = jax.tree.map(b._shard_lanes, rc.state)
+            if rc.paged is not None:
+                # the mono restore allocated page ids against ONE global
+                # pool, but in-dispatch paging runs shard-local: round-trip
+                # through the full window and re-split with n_shards
+                # sub-pools so every page id lands in its shard's local id
+                # space, then re-shard (device_put on the lane sharding —
+                # shard_lanes routes by leading dim == n_lanes and would
+                # replicate the pool)
+                from raft_tpu.ops import paged as pgmod
+
+                full = pgmod.page_in_view(rc.state, rc.paged, 1)
+                res_st, pg_new = pgmod.page_out_host(
+                    full, rc.paged, b.n_shards
+                )
+                b.inner.state = jax.tree.map(b._shard_lanes, res_st)
+                b.inner.paged = jax.tree.map(
+                    lambda x: jax.device_put(x, b.lane_sharding), pg_new
+                )
+            else:
+                b.inner.state = jax.tree.map(b._shard_lanes, rc.state)
         return c

@@ -437,6 +437,31 @@ class ShardedFusedCluster:
                 round=jax.device_put(tr.round, repl),
                 stall=shard_lanes(tr.stall),
             )
+        if self.inner.paged is not None:
+            # every paged leaf is axis-0 group-adjacent: pt/faults/
+            # exhausted lead with N, the [P, PE] pool splits into
+            # per-shard sub-pools with their own local free ranges (page
+            # ids are shard-local; they never cross the boundary because
+            # page_out/page_in both run inside shard_map on local shapes).
+            # shard_lanes routes by leading dim == n and would silently
+            # REPLICATE the pool — device_put on the lane sharding
+            # directly instead.
+            pool_pages = self.inner.paged.pool_term.shape[0]
+            if pool_pages % self.n_shards:
+                raise ValueError(
+                    f"pool_pages={pool_pages} must divide evenly over "
+                    f"{self.n_shards} devices (each shard owns a local "
+                    "sub-pool with its own trash page; pin Shape.pool_pages "
+                    "/ RAFT_TPU_POOL_PAGES to a multiple of the mesh size)"
+                )
+            self.inner.paged = jax.tree.map(
+                lambda x: jax.device_put(x, self.lane_sharding),
+                self.inner.paged,
+            )
+            # host-boundary paged ops (rebase / WAL view / adopt) must
+            # interpret the dispatch-allocated shard-local page ids
+            # against the matching sub-pool, not the global pool
+            self.inner._paged_segs = self.n_shards
         self._no_ops = jax.tree.map(shard_lanes, no_ops(n))
         self._shard_lanes = shard_lanes
         self._cache = {}
@@ -516,9 +541,10 @@ class ShardedFusedCluster:
         met = self.inner.metrics
         ch = self.inner.chaos
         tr = self.inner.trace
+        pg = self.inner.paged
         has_met, has_ch = met is not None, ch is not None
-        has_tr = tr is not None
-        extras = [x for x in (met, ch, tr) if x is not None]
+        has_tr, has_pg = tr is not None, pg is not None
+        extras = [x for x in (met, ch, tr, pg) if x is not None]
         engine = self.inner.engine
         tile = interp = None
         rpc = 1
@@ -545,6 +571,15 @@ class ShardedFusedCluster:
                 mt = ex[0] if has_met else None
                 c = ex[int(has_met)] if has_ch else None
                 t = ex[int(has_met) + int(has_ch)] if has_tr else None
+                # the paged sidecar's shard slice is self-describing: the
+                # engines derive every geometry number from the local leaf
+                # shapes + the meta fields, so page ids stay shard-local
+                # for free
+                p_in = (
+                    ex[int(has_met) + int(has_ch) + int(has_tr)]
+                    if has_pg
+                    else None
+                )
                 t_loc = lane_off = None
                 if has_tr:
                     # the shard sees a [1, R] slice of the stacked ring
@@ -569,6 +604,7 @@ class ShardedFusedCluster:
                         auto_compact_lag=auto_compact_lag,
                         interpret=interp, metrics=mt, chaos=c,
                         trace=t_loc, trace_lane_offset=lane_off,
+                        paged=p_in,
                     )
                 else:
                     res = fused_rounds(
@@ -578,6 +614,7 @@ class ShardedFusedCluster:
                         auto_compact_lag=auto_compact_lag,
                         straddle=self._spec, metrics=mt, chaos=c,
                         trace=t_loc, trace_lane_offset=lane_off,
+                        paged=p_in,
                     )
                 out = [res[0], res[1]]
                 j = 2
@@ -618,6 +655,7 @@ class ShardedFusedCluster:
                     j += 1
                 if has_tr:
                     t2 = res[j]
+                    j += 1
                     # re-stack the shard's [R] ring back into its [1, R]
                     # row of the stacked column (round stays replicated —
                     # every shard steps the same count)
@@ -628,6 +666,10 @@ class ShardedFusedCluster:
                         ring_arg=t2.ring_arg[None],
                         wr=t2.wr[None], round=t2.round, stall=t2.stall,
                     ))
+                if has_pg:
+                    # per-lane counters, pool rows, page tables: all
+                    # shard-local, no psum — ids never leave their shard
+                    out.append(res[j])
                 return tuple(out)
 
             in_specs = [
@@ -672,6 +714,12 @@ class ShardedFusedCluster:
                 )
                 in_specs.append(tr_specs)
                 out_specs.append(tr_specs)
+            if has_pg:
+                # every paged leaf is axis-0 group-adjacent (pt/counters
+                # by lane, the pool by sub-pool row) — see __init__
+                pg_specs = jax.tree.map(lambda _: P("groups"), pg)
+                in_specs.append(pg_specs)
+                out_specs.append(pg_specs)
             fn = shard_map(
                 stepper,
                 mesh=self.mesh,
@@ -712,6 +760,9 @@ class ShardedFusedCluster:
             j += 1
         if has_tr:
             self.inner.trace = res[j]
+            j += 1
+        if has_pg:
+            self.inner.paged = res[j]
         # stream pushes land on the INNER fences so the next donating
         # dispatch — or an inner rebase — resolves the async host copies
         # before the buffers they reference are freed (FusedCluster.run's

@@ -22,6 +22,15 @@ The sink contract mirrors the reference's append-thread ordering rule
 (raft.go:160-185): deltas arrive in block order, each internally consistent
 (one atomic device state), so replaying sink outputs rebuilds a valid
 HardState + log prefix for every lane.
+
+The paged entry log (RAFT_TPU_PAGED, ops/paged.py) is invisible here in
+both directions: push() streams the cluster's _wal_view(), which
+reconstructs the full [N, W] log columns from the resident tail + page
+pool, so deltas are byte-identical paged on/off; and restore_from_wal
+re-splits the restored full-window state, repopulating the pool and the
+per-lane page tables from the delta's log columns (the page ids
+themselves are never persisted — they are a storage artifact rebuilt
+from scratch at every page_out).
 """
 
 from __future__ import annotations

@@ -239,6 +239,14 @@ class BlockedFusedCluster:
         self._inflight: deque = deque()
         # alias, not copy: _bind_ops mutates the plan's LRU in place
         self._ops_cache = self.plan._ops_cache
+        # paged entry log geometry fails HERE, before any block allocates
+        # a carry — the validate_round_plan contract (raise, never fall
+        # back); each FusedCluster below re-validates transitively
+        if shape is not None:
+            from raft_tpu.ops import paged as pgmod
+
+            if pgmod.paged_enabled():
+                pgmod.validate_page_plan(shape, self.lanes_per_block)
         # distinct seeds decorrelate election timeouts across blocks
         self.blocks = [
             FusedCluster(

@@ -262,6 +262,12 @@ def fat_state(state: "RaftState") -> "RaftState":
 # stays zero (ops/log.py re-exports the flag beside its ERR_* family).
 
 ERR_DIET_OVERFLOW = 64
+# paged entry log (ops/paged.py): page pool ran out during page_out — the
+# overflowing lane's paged tail is clamped (dropped pages read back as
+# zero/absent entries), never silently wrapped. Same contract shape as
+# ERR_DIET_OVERFLOW: error_bits itself is never packed, so the flag is
+# representable under every storage mode.
+ERR_PAGE_EXHAUSTED = 128
 
 # inclusive value range per packed storage dtype
 _DIET_RANGE = {

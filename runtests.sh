@@ -73,7 +73,8 @@ smokes() {
     && run_bench benches/serve_bench.py --smoke \
     && run_bench benches/trace_ab.py \
     && run_bench benches/diet_ab.py --smoke \
-    && run_bench benches/multichip_ab.py --smoke
+    && run_bench benches/multichip_ab.py --smoke \
+    && run_bench benches/paged_ab.py --smoke
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
@@ -131,6 +132,11 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
     # distinct dtype signatures) plus one K=4 interpreted megakernel on a
     # packed carry
     run_chunk tests/test_diet.py
+    # the paged entry-log suite mirrors the diet profile one storage
+    # layer down: paged off/on twins per engine are distinct carry
+    # signatures, plus one K=4 interpreted megakernel on a paged carry
+    # and an 8-device sharded identity run
+    run_chunk tests/test_paged.py
     # the mesh-blocked driver gets its own process before test_sharded:
     # its sharded x blocked twins are all 8-device shard_map programs
     # (plus one subprocess A/B child trio), same crash profile as
