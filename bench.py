@@ -23,6 +23,8 @@ import json
 import os
 import time
 
+from raft_tpu import config
+
 import jax
 
 from raft_tpu.utils.compile_cache import cache_dir_from_env, enable_persistent_cache
@@ -227,10 +229,10 @@ def main():
         # off the registry
         from raft_tpu.metrics.host import JsonlWriter, prometheus_text
 
-        jsonl = os.environ.get("RAFT_TPU_METRICS_JSONL")
+        jsonl = config.env_raw("RAFT_TPU_METRICS_JSONL")
         if jsonl:
             JsonlWriter(jsonl).write(met, source="bench", engine=engine)
-        prom = os.environ.get("RAFT_TPU_METRICS_PROM")
+        prom = config.env_raw("RAFT_TPU_METRICS_PROM")
         if prom:
             with open(prom, "w") as f:
                 f.write(prometheus_text(met))

@@ -36,7 +36,10 @@ import os
 import subprocess
 import sys
 
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu import config
 
 DIGEST_FIELDS = (
     "term", "vote", "lead", "state", "committed", "last",
@@ -54,7 +57,7 @@ def child():
     from raft_tpu.metrics.host import ENGINE_EVENTS
     from raft_tpu.ops import fused
 
-    engine = os.environ.get("RAFT_TPU_ENGINE", "xla")
+    engine = config.env_str("RAFT_TPU_ENGINE", default="xla")
     groups = int(os.environ.get("AB_GROUPS", 4096))
     v = int(os.environ.get("AB_VOTERS", 3))
     w, e = 16, 2
@@ -101,7 +104,7 @@ def child():
         digest.update(np.ascontiguousarray(getattr(st, name)).tobytes())
     c.check_no_errors()
     print(json.dumps({
-        "config": f"diet_ab:{engine}:diet={os.environ.get('RAFT_TPU_DIET', '0')}",
+        "config": f"diet_ab:{engine}:diet={config.env_str('RAFT_TPU_DIET', default='0')}",
         "value": round(ms_per_round, 4),
         "unit": "ms/round",
         "extra": {

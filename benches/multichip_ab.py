@@ -41,7 +41,10 @@ import os
 import subprocess
 import sys
 
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu import config
 
 DIGEST_FIELDS = (
     "term", "vote", "lead", "state", "committed", "last",
@@ -210,7 +213,7 @@ def child():
             "trace_dropped": trace_dropped,
             "committed": c.total_committed(),
             "counters": None if snap is None else snap["counters"],
-            "diet": os.environ.get("RAFT_TPU_DIET", "0"),
+            "diet": config.env_str("RAFT_TPU_DIET", default="0"),
             "backend": jax.default_backend(),
         },
     }), flush=True)
@@ -224,8 +227,8 @@ def run_child(mode: str) -> dict:
         RAFT_TPU_METRICS="1",
         RAFT_TPU_CHAOS="1",
         RAFT_TPU_TRACELOG="1",
-        RAFT_TPU_DIET=os.environ.get("RAFT_TPU_DIET", "1"),
-        RAFT_TPU_DONATE=os.environ.get("RAFT_TPU_DONATE", "1"),
+        RAFT_TPU_DIET=config.env_str("RAFT_TPU_DIET", default="1"),
+        RAFT_TPU_DONATE=config.env_str("RAFT_TPU_DONATE", default="1"),
     )
     # CPU runs simulate the 8-device mesh; a real TPU mesh is never forced
     flags = env.get("XLA_FLAGS", "")

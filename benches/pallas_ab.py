@@ -37,7 +37,10 @@ import os
 import subprocess
 import sys
 
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu import config
 
 DIGEST_FIELDS = (
     "term", "vote", "lead", "state", "committed", "last",
@@ -57,7 +60,7 @@ def child():
     from raft_tpu.ops import pallas_round as plr
     from raft_tpu.utils.profiling import device_memory_stats, live_buffer_bytes
 
-    engine = os.environ["RAFT_TPU_ENGINE"]
+    engine = config.env_str("RAFT_TPU_ENGINE")
     groups = int(os.environ.get("AB_GROUPS", 4096))
     v = int(os.environ.get("AB_VOTERS", 3))
     w, e = 16, 2

@@ -16,6 +16,8 @@ import os
 
 import time
 
+from raft_tpu import config
+
 import jax
 
 from raft_tpu.utils.compile_cache import cache_dir_from_env, enable_persistent_cache
@@ -92,8 +94,8 @@ def main():
         "groups": groups, "voters": voters, "w": w, "e": e,
         "block": block, "compile_s": round(compile_s, 1),
         "leaders": leaders,
-        "unroll": os.environ.get("RAFT_TPU_UNROLL", "1"),
-        "route": os.environ.get("RAFT_TPU_ROUTE", "auto"),
+        "unroll": config.env_str("RAFT_TPU_UNROLL", default="1"),
+        "route": config.env_str("RAFT_TPU_ROUTE", default="auto"),
         "donate": donation_enabled(),
         "live_buffer_bytes": live,
         "peak_bytes_in_use": None if mem is None else mem.get("peak_bytes_in_use"),

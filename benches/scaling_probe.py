@@ -29,7 +29,10 @@ import os
 import sys
 import time
 
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu import config
 
 import jax
 
@@ -121,7 +124,7 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
                 "groups_ticks_per_s": round(n_groups * block / best, 1),
                 "us_per_lane_round": round(1e6 * best / block / lanes, 2),
                 "compile_s": round(compile_s, 1),
-                "diet": int(os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")),
+                "diet": int(config.env_flag("RAFT_TPU_DIET", default=False)),
                 "live_bytes_per_lane": round(live_per_lane, 1),
                 **paged_columns(c),
                 **mem,
@@ -186,7 +189,7 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
                 "groups_ticks_per_s": round(n_groups * block / best, 1),
                 "us_per_lane_round": round(1e6 * best / block / lanes, 2),
                 "compile_s": round(compile_s, 1),
-                "diet": int(os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")),
+                "diet": int(config.env_flag("RAFT_TPU_DIET", default=False)),
                 "live_bytes_per_lane": round(live_per_lane, 1),
                 **paged_columns(c),
                 **mem,
@@ -262,7 +265,7 @@ def measure_mesh(n_groups, n_voters, block_groups, block=32, iters=5,
                 "groups_ticks_per_s": round(n_groups * block / best, 1),
                 "us_per_lane_round": round(1e6 * best / block / lanes, 2),
                 "compile_s": round(compile_s, 1),
-                "diet": int(os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")),
+                "diet": int(config.env_flag("RAFT_TPU_DIET", default=False)),
                 "live_bytes_per_lane": round(live_per_lane, 1),
                 **paged_columns(c),
                 **mem,

@@ -1,0 +1,24 @@
+"""Static program auditor: jaxpr/HLO invariant checks, a recompile
+sentinel, and the repo lint gate.
+
+- ``registry`` — declarative manifest of every compiled entry point
+  (env profile, invariants, compile budget per entry).
+- ``jaxpr_audit`` — elision / donation / dtype-discipline / constant-
+  capture / host-hygiene checks over traced jaxprs and lowered HLO;
+  purely static (make_jaxpr + jit.lower, nothing executes).
+- ``recompile`` — two-pass compile-watch sentinel over the canonical
+  bench smoke: per-entry warmup budgets, zero steady-state compiles.
+- ``lint`` — AST rules: RAFT_TPU_* env reads must route through
+  config.py, knobs must cross-check against README's env tables, and
+  host-plane modules stay off the device outside resolve points.
+
+``python -m raft_tpu.analysis`` runs all of it and emits ANALYSIS.json
+(wired into runtests.sh as the static chunk before the serial ladder).
+
+Import note: this ``__init__`` intentionally imports no submodule —
+``python -m raft_tpu.analysis`` runs it before ``__main__``, and
+``__main__`` must pin JAX_PLATFORMS/XLA_FLAGS before anything pulls
+jax in.
+"""
+
+__all__ = ["jaxpr_audit", "lint", "recompile", "registry"]

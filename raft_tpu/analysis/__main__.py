@@ -1,0 +1,95 @@
+"""``python -m raft_tpu.analysis`` — the repo's static analysis gate.
+
+Runs, in order:
+
+1. the repo lint (AST only, no jax),
+2. the jaxpr/HLO audit over every registry entry point,
+3. the recompile sentinel (unless ``--no-sentinel``),
+
+writes the combined report to ANALYSIS.json (``--json`` to move it),
+prints a one-line-per-finding summary, and exits non-zero on any
+finding. ``--lint-only`` stops after step 1 for the fastest gate.
+
+Env pinning happens BEFORE jax is imported: unless the caller already
+chose, the gate runs on the CPU platform with 8 host devices so the
+sharded stepper entry is auditable anywhere (the same arrangement
+runtests.sh uses).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _pin_env():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m raft_tpu.analysis")
+    ap.add_argument("--json", default="ANALYSIS.json",
+                    help="report path (default: ANALYSIS.json in cwd)")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="run only the AST lint (no jax import)")
+    ap.add_argument("--no-sentinel", action="store_true",
+                    help="skip the recompile sentinel (audit + lint only)")
+    args = ap.parse_args(argv)
+
+    _pin_env()
+    findings = []
+    report = {"findings": [], "lint": None, "entries": None,
+              "recompile": None}
+
+    from raft_tpu.analysis.lint import run_lint
+
+    lint_findings, lint_report = run_lint()
+    findings += lint_findings
+    report["lint"] = lint_report
+
+    if not args.lint_only:
+        from raft_tpu.analysis import jaxpr_audit
+        from raft_tpu.analysis.registry import build_records
+
+        entries = []
+        for entry, rec in build_records():
+            fs = jaxpr_audit.audit_record(
+                rec, expect_on=entry.expect_on, diet=entry.diet
+            )
+            findings += fs
+            entries.append({
+                "name": entry.name,
+                "profile": entry.profile,
+                "compile_budget": entry.compile_budget,
+                "findings": len(fs),
+            })
+        report["entries"] = entries
+
+        if not args.no_sentinel:
+            from raft_tpu.analysis.recompile import run_sentinel
+
+            sentinel_findings, sentinel_report = run_sentinel()
+            findings += sentinel_findings
+            report["recompile"] = sentinel_report
+
+    report["findings"] = [f.as_dict() for f in findings]
+    report["ok"] = not findings
+    with open(args.json, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+    n_entries = len(report["entries"] or [])
+    print(f"raft_tpu.analysis: {len(findings)} finding(s) "
+          f"across {n_entries} entry point(s); report -> {args.json}")
+    for f in findings:
+        print(f"  [{f.check}] {f.entry}: {f.detail}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,342 @@
+"""Static jaxpr/HLO invariant checks over the registry's entry points.
+
+Every check here runs WITHOUT executing a round: programs are traced with
+`jax.make_jaxpr` and lowered with `jit.lower(...)`, never called. The
+checks encode the compile-time contracts the rest of the repo asserts
+ad-hoc in whichever test first needed them (see ISSUE/README):
+
+- **elision** — with a plane's env knob off, zero primitives attributable
+  to that plane anywhere in the program. Proven via the shared trace-time
+  CallCounters (raft_tpu/testing/counters.py): the plane's device fn bumps
+  its counter when TRACED, so a flat counter across the `make_jaxpr` of an
+  entry point means the plane contributed nothing to the jaxpr. A plane
+  that is ON must bump (positive sanity — a counter that never moves
+  can't prove elision).
+- **donation** — a donating twin's lowering must carry an input-output
+  alias for every donated carry leaf; a donated leaf that LOST its alias
+  (jax lowers it with a "donated buffers were not usable" warning and no
+  `tf.aliasing_output` attribute) is a silent HBM doubling. The copying
+  twin must alias nothing.
+- **dtype discipline** — under RAFT_TPU_DIET=1 the packed carry columns
+  (uint16 indexes/terms, int8 ids, int16 sizes, uint8/16/32 bitsets) must
+  ride the scan carry / pallas operands in their packed dtypes. The
+  in-body widen/compute/narrow cycle is by design; what must never happen
+  is a packed column riding the BETWEEN-rounds carry widened to int32 —
+  so the check asserts every narrow leaf of the actual carry appears
+  among the program's scan-carry/kernel-operand avals.
+- **constant capture** — no jaxpr consts feeding a `pallas_call` (the
+  jax 0.4.37 lifted-literal hazard from PR 4: enum scalars and array
+  literals become constvars that Mosaic rejects or bakes into the
+  kernel), and no large (>16 KiB) const anywhere in the program (a
+  captured table silently re-uploads per executable).
+- **host-boundary hygiene** — no host callbacks/infeed/outfeed inside a
+  round-dispatch program: the round must be pure device code; a stray
+  `debug_callback`/`pure_callback` forces a host sync per dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import warnings
+
+import jax
+
+from raft_tpu.testing import counters as ctr
+
+# dtypes the diet-v2 pack boundary may produce; anything in the carry with
+# one of these is a "packed column" the program must preserve
+NARROW_DTYPES = ("uint8", "uint16", "uint32", "int8", "int16")
+
+# one const bigger than this anywhere in a program is a capture bug (the
+# engine passes all real data as arguments; consts should be iota/scalars)
+MAX_CONST_BYTES = 16 * 1024
+
+# primitives that cross the host boundary inside a device program
+_HOST_PRIMS = ("infeed", "outfeed")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One invariant violation. `entry` names the manifest entry point,
+    `check` the auditor pass, `detail` the human-readable evidence."""
+
+    entry: str
+    check: str
+    detail: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# --------------------------------------------------------------------------
+# program tracing
+
+
+def trace_entry(rec) -> "jax.core.ClosedJaxpr":
+    """Trace a registry program record to its closed jaxpr without
+    executing it. Static kwargs close over the fn; array args/kwargs are
+    passed as tracer arguments so real data never becomes a jaxpr const
+    (which would defeat the constant-capture check)."""
+    fn = functools.partial(rec["fn"], **rec.get("static", {}))
+    return jax.make_jaxpr(fn)(*rec["args"], **rec.get("kwargs", {}))
+
+
+def traced_counter_deltas(rec) -> tuple["jax.core.ClosedJaxpr", dict]:
+    """(closed_jaxpr, {plane: trace-time counter delta}) for one record."""
+    before = ctr.snapshot()
+    jaxpr = trace_entry(rec)
+    after = ctr.snapshot()
+    return jaxpr, {k: after[k] - before.get(k, 0) for k in after}
+
+
+# --------------------------------------------------------------------------
+# jaxpr walking
+
+
+def iter_jaxprs(jaxpr):
+    """Yield (jaxpr, constvar_set) for the top jaxpr and every sub-jaxpr
+    reachable through eqn params (scan/cond/pjit/pallas bodies)."""
+    seen = set()
+
+    def walk(jx):
+        if id(jx) in seen:
+            return
+        seen.add(id(jx))
+        yield jx, set(jx.constvars)
+        for eqn in jx.eqns:
+            for sub in _sub_jaxprs(eqn):
+                yield from walk(sub)
+
+    yield from walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        for item in v if isinstance(v, (tuple, list)) else (v,):
+            if hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                yield item.jaxpr  # ClosedJaxpr
+            elif hasattr(item, "eqns"):
+                yield item  # raw Jaxpr
+
+
+def iter_eqns(jaxpr):
+    for jx, _ in iter_jaxprs(jaxpr):
+        yield from jx.eqns
+
+
+def _aval_key(aval) -> tuple:
+    return (tuple(aval.shape), str(aval.dtype))
+
+
+def storage_avals(jaxpr) -> set:
+    """The program's "storage" avals: scan-carry avals (what HBM holds
+    between rounds) plus pallas_call operand avals (what the kernel is
+    fed). These are the positions where the diet's packed dtypes must
+    survive."""
+    out = set()
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name == "scan":
+            nc = eqn.params.get("num_consts", 0)
+            ncar = eqn.params.get("num_carry", 0)
+            body = eqn.params.get("jaxpr")
+            invars = body.jaxpr.invars if hasattr(body, "jaxpr") else body.invars
+            for v in invars[nc : nc + ncar]:
+                out.add(_aval_key(v.aval))
+        elif name == "pallas_call":
+            for v in eqn.invars:
+                if hasattr(v, "aval"):
+                    out.add(_aval_key(v.aval))
+    return out
+
+
+def narrow_carry_avals(tree) -> set:
+    """The (shape, dtype) set of every packed-dtype leaf in an actual
+    carry pytree — what the program's storage avals must cover."""
+    out = set()
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "dtype") and str(leaf.dtype) in NARROW_DTYPES:
+            out.add((tuple(leaf.shape), str(leaf.dtype)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# checks (each returns a list of Findings)
+
+
+def check_elision(name, deltas, expect_on: dict) -> list:
+    """expect_on: {plane: bool} — planes expected IN the program must have
+    bumped their trace-time counter during the trace; planes expected OFF
+    must not have."""
+    out = []
+    for plane, on in expect_on.items():
+        d = deltas.get(plane, 0)
+        if on and d <= 0:
+            out.append(Finding(name, "elision", (
+                f"plane '{plane}' is enabled but its device fn was never "
+                "traced into the program (counter flat) — the plane "
+                "silently dropped out"
+            )))
+        if not on and d > 0:
+            out.append(Finding(name, "elision", (
+                f"plane '{plane}' is disabled but its device fn was traced "
+                f"{d}x into the program — elision is broken, the knob no "
+                "longer compiles the plane out"
+            )))
+    return out
+
+
+def check_dtype_discipline(name, jaxpr, carry) -> list:
+    """Every packed (narrow-dtype) leaf of the real carry must appear among
+    the program's scan-carry / pallas-operand avals with its packed shape
+    and dtype. A missing one means some path widened it (usually to int32)
+    for the ride between rounds — the silent byte-diet regression."""
+    have = storage_avals(jaxpr)
+    if not have:
+        return []  # no scan/kernel in this program — nothing rides a carry
+    out = []
+    for shape, dtype in sorted(narrow_carry_avals(carry)):
+        if (shape, dtype) not in have:
+            out.append(Finding(name, "dtype", (
+                f"packed carry column {dtype}{list(shape)} does not appear "
+                "in any scan carry / kernel operand — a cast widened it "
+                "between rounds (diet regression)"
+            )))
+    return out
+
+
+def check_constant_capture(name, jaxpr) -> list:
+    out = []
+    for jx, constvars in iter_jaxprs(jaxpr):
+        for eqn in jx.eqns:
+            if eqn.primitive.name != "pallas_call":
+                continue
+            for v in eqn.invars:
+                if not hasattr(v, "aval"):
+                    continue
+                if v in constvars:
+                    out.append(Finding(name, "capture", (
+                        f"pallas_call operand {v.aval.str_short()} is a "
+                        "lifted jaxpr const (captured closure/enum "
+                        "constant) — pass it as an argument or register "
+                        "the literal (types.register_literal_enums)"
+                    )))
+    top = jaxpr if hasattr(jaxpr, "consts") else None
+    if top is not None:
+        for c in top.consts:
+            nbytes = getattr(c, "nbytes", 0)
+            if nbytes > MAX_CONST_BYTES:
+                out.append(Finding(name, "capture", (
+                    f"program captures a {nbytes}-byte const "
+                    f"{getattr(c, 'dtype', '?')}{list(getattr(c, 'shape', ()))}"
+                    " — real data must ride as an argument, not a closure"
+                )))
+    return out
+
+
+def check_host_hygiene(name, jaxpr) -> list:
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        pname = eqn.primitive.name
+        if "callback" in pname or pname in _HOST_PRIMS:
+            out.append(Finding(name, "hygiene", (
+                f"primitive '{pname}' inside the round-dispatch program — "
+                "a host round-trip per dispatch; move it to the host plane "
+                "or behind a stream drain"
+            )))
+    return out
+
+
+# --------------------------------------------------------------------------
+# donation (lowered-HLO level)
+
+
+def lower_text_and_warnings(rec) -> tuple[str, list]:
+    """Lower the record's jit twin for its example args; returns the
+    StableHLO text and any 'donated buffers were not usable' warnings
+    jax emitted during lowering (each one is a donated leaf that lost
+    its alias)."""
+    jit = rec["jit"]
+    kwargs = {**rec.get("static", {}), **rec.get("kwargs", {})}
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        lowered = jit.lower(*rec["args"], **kwargs)
+    text = lowered.as_text()
+    dropped = [
+        str(w.message)
+        for w in caught
+        if "donated buffers were not usable" in str(w.message).lower()
+    ]
+    return text, dropped
+
+
+def donated_leaf_count(rec) -> int:
+    """Leaves of the donated portion of the example args: positional
+    donate_argnums (0, 1) = (state, fab) plus the donated plane kwargs
+    that are not None."""
+    donated = [rec["args"][i] for i in rec.get("donate_argnums", ())]
+    for k in rec.get("donate_argnames", ()):
+        val = rec.get("kwargs", {}).get(k)
+        if val is not None:
+            donated.append(val)
+    return len(jax.tree.leaves(donated))
+
+
+def check_donation(name, rec) -> list:
+    """Donating twin: every donated carry leaf aliases an output (count
+    `tf.aliasing_output`/`jax.buffer_donor` markers, catch jax's
+    unusable-donation warning). Copying twin: aliases nothing."""
+    text, dropped = lower_text_and_warnings(rec)
+    aliased = text.count("tf.aliasing_output") + text.count("jax.buffer_donor")
+    out = []
+    if rec["donate"]:
+        expected = donated_leaf_count(rec)
+        if dropped:
+            out.append(Finding(name, "donation", (
+                f"{len(dropped)} donated leaf group(s) lost their alias "
+                f"(silent HBM doubling): {dropped[0]}"
+            )))
+        if aliased < expected:
+            out.append(Finding(name, "donation", (
+                f"lowering aliases {aliased} buffers but the donated carry "
+                f"has {expected} leaves — some donated leaf is not updated "
+                "in place"
+            )))
+    else:
+        if aliased:
+            out.append(Finding(name, "donation", (
+                f"copying twin aliases {aliased} buffers — stale host "
+                "references to the pre-dispatch carry would read garbage"
+            )))
+    return out
+
+
+# --------------------------------------------------------------------------
+# one record end-to-end
+
+
+def audit_record(rec, *, expect_on=None, diet: bool = False) -> list:
+    """Run every applicable static check on one program record; returns
+    the finding list (empty = clean). Purely static: make_jaxpr +
+    jit.lower only, nothing executes."""
+    name = rec["name"]
+    checks = rec.get("checks")
+    jaxpr, deltas = traced_counter_deltas(rec)
+    out = []
+
+    def want(c):
+        return checks is None or c in checks
+
+    if want("elision") and expect_on:
+        out += check_elision(name, deltas, expect_on)
+    if want("dtype") and diet:
+        carry = [rec["args"][0], rec["args"][1]]
+        out += check_dtype_discipline(name, jaxpr, carry)
+    if want("capture"):
+        out += check_constant_capture(name, jaxpr)
+    if want("hygiene"):
+        out += check_host_hygiene(name, jaxpr)
+    if want("donation") and rec.get("jit") is not None:
+        out += check_donation(name, rec)
+    return out

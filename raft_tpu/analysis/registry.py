@@ -1,0 +1,358 @@
+"""Declarative manifest of the repo's compiled entry points.
+
+Every jitted program the engines dispatch — the XLA fused scan, the
+pallas K-round megakernel, the quorum kernels, the egress ready/delta
+kernels, the diet rebase jits, the paged host-boundary ops, and the
+shard_mapped sharded stepper — appears here as one Entry: a builder
+that constructs the audit record(s) under a pinned env profile, the
+invariants the auditor must hold against it, and the recompile budget
+the compile-watch sentinel (analysis/recompile.py) enforces.
+
+The builders construct real clusters/operands but never dispatch a
+round: tracing (jax.make_jaxpr) and lowering (.lower()) are the only
+jax entry points the auditor touches, and autotune is pinned off in
+every profile so the pallas resolvers stay dispatch-free
+(FusedCluster._resolve_pallas_tile / _resolve_pallas_rounds fall to
+default_tile / K=1 when RAFT_TPU_PALLAS_AUTOTUNE=0).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+
+__all__ = [
+    "Entry",
+    "ENTRIES",
+    "env_profile",
+    "PROFILES",
+    "build_records",
+    "entry_names",
+]
+
+
+@contextlib.contextmanager
+def env_profile(knobs: dict):
+    """Pin RAFT_TPU_* knobs for the duration of a builder; a None value
+    unsets the variable. Restores the caller's environment on exit so
+    profiles compose with whatever the invoking shell pinned."""
+    saved = {k: os.environ.get(k) for k in knobs}
+    try:
+        for k, v in knobs.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        yield
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
+# autotune stays off in every profile: the (tile, K) sweep dispatches
+# warmup blocks, and the auditor must never execute a round
+_BASE = {
+    "RAFT_TPU_PALLAS_AUTOTUNE": "0",
+    "RAFT_TPU_PALLAS_TILE": None,
+    "RAFT_TPU_PALLAS_ROUNDS": None,
+    "RAFT_TPU_UNROLL": None,
+    "RAFT_TPU_ROUTE": None,
+}
+
+PROFILES = {
+    # every optional plane compiled in, packed diet carry, donating twins:
+    # the maximal jaxpr — elision (planes present), dtype discipline
+    # (packed avals as scan carry), donation, capture, hygiene
+    "planes_on": dict(
+        _BASE,
+        RAFT_TPU_METRICS="1",
+        RAFT_TPU_CHAOS="1",
+        RAFT_TPU_TRACELOG="1",
+        RAFT_TPU_DIET="1",
+        RAFT_TPU_DONATE="1",
+        RAFT_TPU_PAGED="0",
+    ),
+    # every plane off, copying twins: the minimal jaxpr — elision (no
+    # plane op may survive) and the no-alias check on the copying twin
+    "planes_off": dict(
+        _BASE,
+        RAFT_TPU_METRICS="0",
+        RAFT_TPU_CHAOS="0",
+        RAFT_TPU_TRACELOG="0",
+        RAFT_TPU_DIET="0",
+        RAFT_TPU_DONATE="0",
+        RAFT_TPU_PAGED="0",
+    ),
+    # the paged entry log on (default geometry), metrics riding along
+    "paged": dict(
+        _BASE,
+        RAFT_TPU_METRICS="1",
+        RAFT_TPU_CHAOS="0",
+        RAFT_TPU_TRACELOG="0",
+        RAFT_TPU_DIET="0",
+        RAFT_TPU_DONATE="1",
+        RAFT_TPU_PAGED="1",
+        RAFT_TPU_PAGE_WINDOW=None,
+        RAFT_TPU_PAGE_ENTRIES=None,
+        RAFT_TPU_POOL_PAGES=None,
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry:
+    """One manifest row: name must equal the record's name (the sentinel
+    keys its per-entry compile budget by it), profile keys PROFILES,
+    build returns the audit record list, expect_on is the plane→bool
+    map the elision check asserts, diet gates the dtype-discipline
+    check, and compile_budget is the max fresh XLA compilations the
+    recompile sentinel tolerates for this entry across the canonical
+    smoke (warmup included)."""
+
+    name: str
+    profile: str
+    build: object
+    compile_budget: int = 1
+    expect_on: dict | None = None
+    diet: bool = False
+
+
+# -- builders --------------------------------------------------------------
+# Small geometry: 4 groups x 3 voters = 12 lanes traces in well under a
+# second per entry and exercises every plane. The sharded stepper needs
+# the 8-device host platform (runtests.sh / __main__ set XLA_FLAGS).
+
+
+def _cluster(engine, **kw):
+    from raft_tpu.ops.fused import FusedCluster
+
+    return FusedCluster(n_groups=4, n_voters=3, engine=engine, **kw)
+
+
+def _round_xla():
+    return _cluster("xla").audit_programs()
+
+
+def _round_xla_off():
+    recs = _cluster("xla").audit_programs()
+    for r in recs:
+        r["name"] = r["name"] + ".planes_off"
+    return recs
+
+
+def _round_pallas():
+    return _cluster("pallas", rounds_per_call=2).audit_programs()
+
+
+def _sharded_step():
+    import jax
+
+    from raft_tpu.parallel.sharded import ShardedFusedCluster
+
+    if len(jax.devices()) < 2:  # pragma: no cover - single-device hosts
+        return []
+    return ShardedFusedCluster(n_groups=16, n_voters=3).audit_programs()
+
+
+def _quorum_operands():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    n, v = 256, 3
+    match = jnp.asarray(rng.integers(0, 1 << 20, (n, v)), jnp.int32)
+    m_in = jnp.asarray(rng.random((n, v)) < 0.8)
+    m_out = jnp.asarray(rng.random((n, v)) < 0.4)
+    return match, m_in, m_out
+
+
+def _quorum_pallas():
+    from raft_tpu.ops import quorum_pallas as qp
+
+    match, m_in, m_out = _quorum_operands()
+    return [dict(
+        name="quorum.pallas",
+        fn=qp.joint_committed_pallas,
+        jit=qp.joint_committed_pallas,
+        args=(match, m_in, m_out),
+        kwargs={},
+        static=dict(interpret=True),
+        donate=False,
+        donate_argnums=(),
+        donate_argnames=(),
+        # operands are plain i32/bool batch tensors, no packed carry;
+        # the pallas-specific invariants (constant capture, hygiene)
+        # still apply
+        checks=("capture", "hygiene", "donation"),
+    )]
+
+
+def _quorum_xla():
+    import jax
+
+    from raft_tpu.ops import quorum as qr
+
+    match, m_in, m_out = _quorum_operands()
+    return [dict(
+        name="quorum.xla",
+        fn=qr.joint_committed,
+        jit=jax.jit(qr.joint_committed),
+        args=(match, m_in, m_out),
+        kwargs={},
+        static={},
+        donate=False,
+        donate_argnums=(),
+        donate_argnames=(),
+        checks=("capture", "hygiene", "donation"),
+    )]
+
+
+def _egress_cursors(state):
+    import numpy as np
+
+    from raft_tpu.ops import ready_mask as rm
+
+    n = state.term.shape[0]
+    z = np.zeros((n,), np.int32)
+    f = np.zeros((n,), bool)
+    host = rm.HostCursors(
+        prev_term=z, prev_vote=z, prev_commit=z, prev_lead=z,
+        prev_state=z, host_pending=f, is_async=f, inprog=z,
+        snap_inprog=z, applying=z,
+    )
+    prev = rm.PrevCursors(term=z, lead=z, state=z, committed=z,
+                          applied=z, last=z)
+    return host, prev
+
+
+def _egress_entries():
+    import jax.numpy as jnp
+
+    from raft_tpu.ops import ready_mask as rm
+
+    cl = _cluster("xla")
+    host, prev = _egress_cursors(cl.state)
+    host = rm.HostCursors(*(jnp.asarray(a) for a in host))
+    prev = rm.PrevCursors(*(jnp.asarray(a) for a in prev))
+    common = dict(
+        kwargs={}, static={}, donate=False,
+        donate_argnums=(), donate_argnames=(),
+        checks=("capture", "hygiene", "donation"),
+    )
+    return [
+        dict(common, name="egress.ready_bundle", fn=rm.ready_bundle,
+             jit=rm._bundle_jit, args=(cl.state, host)),
+        dict(common, name="egress.delta", fn=rm.delta_bundle,
+             jit=rm._delta_jit, args=(cl.state, prev)),
+    ]
+
+
+def _rebase_entries():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from raft_tpu.ops import fused as fmod
+    from raft_tpu.state import unpack_state
+    from raft_tpu.ops.fused import unpack_fabric, fat_fabric
+
+    cl = _cluster("xla")
+    st = unpack_state(cl.state)
+    fb = fat_fabric(unpack_fabric(cl.fab))
+    n = st.term.shape[0]
+    mask = jnp.asarray(np.ones((n,), bool))
+    delta = jnp.asarray(np.zeros((n,), np.int32))
+    common = dict(
+        kwargs={}, static={},
+        checks=("capture", "hygiene", "donation"),
+    )
+    return [
+        dict(common, name="rebase.indexes", fn=fmod._rebase_indexes,
+             jit=fmod._rebase_indexes_donate_jit,
+             args=(st, mask, delta), donate=True,
+             donate_argnums=(0,), donate_argnames=()),
+        dict(common, name="rebase.fabric", fn=fmod._rebase_fabric,
+             jit=fmod._rebase_fabric_donate_jit,
+             args=(fb, delta), donate=True,
+             donate_argnums=(0,), donate_argnames=()),
+    ]
+
+
+def _paged_entries():
+    from raft_tpu.ops import paged as pgmod
+
+    cl = _cluster("xla")
+    assert cl.paged is not None, "paged profile must enable RAFT_TPU_PAGED"
+    # page_out takes the FULL-window carry; recovering it from cl.state
+    # via page_in_host would dispatch a program, which the auditor never
+    # does — build a twin cluster with paging off for the full carry and
+    # pair it with a fresh all-resident sidecar instead
+    with env_profile({"RAFT_TPU_PAGED": "0"}):
+        full = _cluster("xla")
+    paged0 = pgmod.init_paged(cl._page_plan, full.state)
+    common = dict(
+        kwargs={}, static={}, donate=False,
+        donate_argnums=(), donate_argnames=(),
+        checks=("capture", "hygiene", "donation"),
+    )
+    return [
+        dict(common, name="paged.page_in", fn=pgmod.page_in,
+             jit=pgmod.page_in_host, args=(cl.state, cl.paged)),
+        dict(common, name="paged.page_out", fn=pgmod.page_out,
+             jit=pgmod.page_out_host, args=(full.state, paged0)),
+    ]
+
+
+_ALL_ON = {"metrics": True, "chaos": True, "trace": True, "paged": False}
+_ALL_OFF = {"metrics": False, "chaos": False, "trace": False, "paged": False}
+
+ENTRIES = (
+    Entry("round.xla", "planes_on", _round_xla,
+          compile_budget=1, expect_on=_ALL_ON, diet=True),
+    Entry("round.xla.planes_off", "planes_off", _round_xla_off,
+          compile_budget=1, expect_on=_ALL_OFF),
+    Entry("round.pallas", "planes_on", _round_pallas,
+          compile_budget=1, expect_on=_ALL_ON, diet=True),
+    Entry("sharded.step.xla", "planes_on", _sharded_step,
+          compile_budget=1),
+    Entry("quorum.pallas", "planes_on", _quorum_pallas, compile_budget=1),
+    Entry("quorum.xla", "planes_on", _quorum_xla, compile_budget=1),
+    Entry("egress.ready_bundle", "planes_off", _egress_entries,
+          compile_budget=1),
+    Entry("egress.delta", "planes_off", _egress_entries, compile_budget=1),
+    Entry("rebase.indexes", "planes_off", _rebase_entries,
+          compile_budget=1),
+    Entry("rebase.fabric", "planes_off", _rebase_entries, compile_budget=1),
+    Entry("paged.page_in", "paged", _paged_entries, compile_budget=1),
+    Entry("paged.page_out", "paged", _paged_entries, compile_budget=1),
+)
+
+
+def entry_names():
+    return tuple(e.name for e in ENTRIES)
+
+
+def build_records():
+    """Materialize every manifest entry under its env profile. Returns
+    [(entry, record)] with exactly one record per Entry: builders that
+    return several records (the shared egress/rebase/paged builders)
+    are keyed back to their row by record name. Builders run once per
+    (profile, build) pair so shared builders construct one cluster."""
+    built = {}
+    out = []
+    for e in ENTRIES:
+        key = (e.profile, e.build)
+        if key not in built:
+            with env_profile(PROFILES[e.profile]):
+                built[key] = {r["name"]: r for r in e.build()}
+        rec = built[key].get(e.name)
+        if rec is None:
+            # single-device host: the sharded builder returns no record
+            continue
+        out.append((e, rec))
+    return out

@@ -55,13 +55,14 @@ read back by the host into metrics-plane-style histograms
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import config
 from raft_tpu.state import wipe_volatile
+from raft_tpu.testing.counters import CallCounter
 from raft_tpu.types import MessageType as MT, StateType
 
 I32 = jnp.int32
@@ -85,6 +86,11 @@ _SALT_DUP_HB = 6
 _SALT_DUP_VOTE = 7
 _SALT_DUP_VRESP = 8
 _SALT_TICK_SKEW = 9
+
+# trace-time counter: bumps once per begin_round() traced into a program;
+# flat while RAFT_TPU_CHAOS=0 (the elision claim, checked by the static
+# auditor's plane-elision pass)
+_CALLS = CallCounter("chaos")
 
 
 def _dc(cls):
@@ -144,7 +150,7 @@ PROBE_FIELDS = (
 def chaos_enabled() -> bool:
     """Read RAFT_TPU_CHAOS lazily (default OFF — chaos is opt-in, unlike
     metrics); the value is baked into each cluster at construction."""
-    return os.environ.get("RAFT_TPU_CHAOS", "0") not in ("0", "", "off")
+    return config.env_flag("RAFT_TPU_CHAOS", default=False)
 
 
 def probability(p: float) -> int:
@@ -161,7 +167,7 @@ def init_chaos(n: int, v: int, seed: int = 1) -> ChaosState:
     two same-seed processes replay the identical fault timeline."""
     if n % v:
         raise ValueError("chaos plane requires group-aligned lanes (N = G*V)")
-    base = int(os.environ.get("RAFT_TPU_CHAOS_SEED", "0") or 0)
+    base = config.env_int("RAFT_TPU_CHAOS_SEED", default=0)
     sid = (((seed + base) * 2654435761) ^ 0x5EEDC0DE) & 0xFFFFFFFF
 
     # every field gets its OWN buffer: the carry is donated whole and XLA
@@ -280,6 +286,7 @@ def begin_round(chaos: ChaosState, state, inb, ops, v: int, *, lane_offset=None)
     None = lanes 0..n-1 (the monolithic fused_rounds path).
 
     Returns (chaos, state, inb, ops, tick_mask)."""
+    _CALLS.bump()
     n = state.id.shape[0]
     rnd = chaos.round
     seed = chaos.seed

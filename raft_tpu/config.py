@@ -17,6 +17,54 @@ construction (reference: raft.go:124-336). The TPU engine splits that into:
 from __future__ import annotations
 
 import dataclasses
+import os
+
+# ---------------------------------------------------------------------------
+# Environment knobs.
+#
+# Every RAFT_TPU_* read in the package goes through these accessors — that is
+# a lint rule (raft_tpu/analysis/lint.py), not a convention: a stray
+# os.environ.get() elsewhere fails `python -m raft_tpu.analysis`. Centralizing
+# the reads keeps flag semantics uniform (what counts as "off"), gives the
+# README env-table cross-check one source of truth, and leaves exactly one
+# place to add knob instrumentation.
+#
+# Flag grammar: unset -> the knob's default; "0", "" and "off" are false;
+# anything else is true. Tri-state knobs (default/on/off with an
+# auto-detection arm, e.g. RAFT_TPU_DONATE) use env_raw and keep their
+# three-way logic at the call site.
+
+_FALSY = ("0", "", "off")
+
+
+def env_raw(name: str, default: str | None = None) -> str | None:
+    """Raw tri-state read: None (unset) vs the literal string value."""
+    return os.environ.get(name, default)
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean knob: unset -> default; "0"/""/"off" -> False; else True."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw not in _FALSY
+
+
+def env_str(name: str, default: str = "") -> str:
+    """String knob with a default for unset/empty."""
+    return os.environ.get(name) or default
+
+
+def env_int(name: str, default: int = 0) -> int:
+    """Integer knob: unset/empty -> default; non-integer raises with the
+    knob name so a typo'd export fails loudly at the read site."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from None
 
 # Diet-v2 stores rebased index columns as uint16; the post-rebase index
 # space is a few windows plus the between-rebase growth budget, so the

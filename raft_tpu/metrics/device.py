@@ -53,13 +53,20 @@ steady-state distribution across a million lanes.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import config
+from raft_tpu.testing.counters import CallCounter
+
 I32 = jnp.int32
+
+# trace-time counter: bumps once per commit_round() traced into a program;
+# flat while RAFT_TPU_METRICS=0 (the elision claim, checked by the static
+# auditor's plane-elision pass)
+_CALLS = CallCounter("metrics")
 
 
 def _dc(cls):
@@ -121,7 +128,7 @@ def init_metrics(n: int) -> MetricsState:
 def metrics_enabled() -> bool:
     """Read RAFT_TPU_METRICS lazily (default ON) so tests can toggle it
     per-cluster; the value is baked into each cluster at construction."""
-    return os.environ.get("RAFT_TPU_METRICS", "1") not in ("0", "", "off")
+    return config.env_flag("RAFT_TPU_METRICS", default=True)
 
 
 class EventBag:
@@ -198,6 +205,7 @@ def arm_sample(metrics: MetricsState, appended, last_index) -> MetricsState:
 def commit_round(metrics: MetricsState, bag: EventBag) -> MetricsState:
     """Fold the round's event bag into the carry and advance the round
     counter."""
+    _CALLS.bump()
     return dataclasses.replace(
         metrics,
         counters=metrics.counters + bag.reduce(),

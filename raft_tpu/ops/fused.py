@@ -63,6 +63,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import config
 from raft_tpu.chaos import device as chmod
 from raft_tpu.metrics import device as metmod
 from raft_tpu.trace import device as trmod
@@ -489,20 +490,14 @@ def route_fabric_straddle(
 # fields), so kernel count and compile time grow quadratically in the
 # voter count — benched and wins at v<=7; if larger v is ever supported,
 # fold v into this heuristic (big v + small n should stay "transpose").
-_ROUTE_IMPL = os.environ.get("RAFT_TPU_ROUTE", "auto")
+_ROUTE_IMPL = config.env_str("RAFT_TPU_ROUTE", default="auto")
 _AUTO_SHIFT_MIN_LANES = 256
 
 # rounds-per-scan-iteration in fused_rounds (RAFT_TPU_UNROLL): unrolling
 # lets XLA fuse across adjacent rounds' slim<->fat casts and drop per-
 # iteration while-loop overhead, at the cost of a proportionally bigger
 # program (compile time) — A/B'd on chip, see BASELINE.md round 5.
-try:
-    _SCAN_UNROLL = max(1, int(os.environ.get("RAFT_TPU_UNROLL", "1")))
-except ValueError:
-    raise ValueError(
-        "RAFT_TPU_UNROLL must be an integer >= 1, got "
-        f"{os.environ.get('RAFT_TPU_UNROLL')!r}"
-    ) from None
+_SCAN_UNROLL = max(1, config.env_int("RAFT_TPU_UNROLL", default=1))
 
 
 def aligned_peer_mute(mute, v: int):
@@ -1537,7 +1532,7 @@ def donation_enabled() -> bool:
     hook is active (PALLAS_AXON_POOL_IPS set and JAX_PLATFORMS not
     pinning cpu) the unset-env default flips to OFF. An explicit
     RAFT_TPU_DONATE=1 still wins."""
-    v = os.environ.get("RAFT_TPU_DONATE")
+    v = config.env_raw("RAFT_TPU_DONATE")
     if v is not None:
         return v not in ("0", "", "off")
     if (
@@ -2083,6 +2078,72 @@ class FusedCluster:
             if self._donate:
                 self._trace_pending = trace
 
+    def audit_programs(self, rounds: int = 2):
+        """Enumerate this cluster's round-dispatch entry points as audit
+        records for the static program auditor (raft_tpu/analysis). Each
+        record carries the unjitted fn (for make_jaxpr), the jit twin the
+        engine actually dispatches (for lowered-HLO donation checks), the
+        live carry pytrees as example arguments, and the donation
+        signature. Nothing here dispatches a round: the auditor only
+        traces and lowers."""
+        from raft_tpu.ops import pallas_round as plr
+
+        static = dict(
+            v=self.v,
+            n_rounds=rounds,
+            do_tick=True,
+            auto_propose=False,
+            auto_compact_lag=None,
+            ops_first_round_only=True,
+        )
+        kwargs = dict(
+            metrics=self.metrics,
+            chaos=self.chaos,
+            trace=self.trace,
+            paged=self.paged,
+        )
+        args = (self.state, self.fab, self._no_ops, self.mute)
+        if self.engine == "pallas":
+            rpc = self._resolve_pallas_rounds()
+            tile = self._resolve_pallas_tile()
+            if self._pallas_interpret is None:
+                self._pallas_interpret = plr.default_interpret()
+            return [dict(
+                name="round.pallas",
+                fn=plr.pallas_rounds,
+                jit=(
+                    plr._pallas_rounds_jit
+                    if self._donate
+                    else plr._pallas_rounds_nodonate_jit
+                ),
+                args=args,
+                kwargs=kwargs,
+                static=dict(
+                    static,
+                    tile_lanes=tile,
+                    rounds_per_call=rpc,
+                    interpret=self._pallas_interpret,
+                ),
+                donate=self._donate,
+                donate_argnums=(0, 1),
+                donate_argnames=("metrics", "chaos", "trace", "paged"),
+            )]
+        return [dict(
+            name="round.xla",
+            fn=fused_rounds,
+            jit=(
+                _fused_rounds_jit
+                if self._donate
+                else _fused_rounds_nodonate_jit
+            ),
+            args=args,
+            kwargs=kwargs,
+            static=static,
+            donate=self._donate,
+            donate_argnums=(0, 1),
+            donate_argnames=("metrics", "chaos", "trace", "paged"),
+        )]
+
     def _flush_stream_fences(self):
         """Resolve every in-flight D2H stream copy (WAL, egress, trace)
         before a donating dispatch — or a rebase — invalidates the buffers
@@ -2200,8 +2261,7 @@ class FusedCluster:
         key = plr.shape_key(self.shape, backend)
         t = self._tile_req
         if t is None:
-            env = os.environ.get("RAFT_TPU_PALLAS_TILE")
-            t = int(env) if env else None
+            t = config.env_int("RAFT_TPU_PALLAS_TILE", default=0) or None
         if t is None:
             t = plr.cached_tile(key)
         if t is None:
@@ -2245,8 +2305,10 @@ class FusedCluster:
                 # but still sweeps K
                 pinned = self._tile_req
                 if pinned is None:
-                    env = os.environ.get("RAFT_TPU_PALLAS_TILE")
-                    pinned = int(env) if env else None
+                    pinned = (
+                        config.env_int("RAFT_TPU_PALLAS_TILE", default=0)
+                        or None
+                    )
                 tiles = None
                 if pinned is not None:
                     plr.check_tile(n, self.v, pinned)

@@ -51,32 +51,33 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import config
 from raft_tpu.config import Shape
 from raft_tpu.state import ERR_PAGE_EXHAUSTED, RaftState  # noqa: F401
+from raft_tpu.testing.counters import CallCounter
 
 I32 = jnp.int32
+
+# trace-time counter: bumps once per page_in() traced into a program; flat
+# while RAFT_TPU_PAGED=0 (the elision claim, checked by the static
+# auditor's plane-elision pass)
+_CALLS = CallCounter("paged")
 
 
 def paged_enabled() -> bool:
     """Read RAFT_TPU_PAGED lazily (default OFF); like diet_enabled, the
     value is baked into each cluster at construction — the carry split
     never flips mid-run."""
-    return os.environ.get("RAFT_TPU_PAGED", "0") not in ("0", "", "off")
+    return config.env_flag("RAFT_TPU_PAGED", default=False)
 
 
 def _next_pow2(x: int) -> int:
     return 1 << max(0, (x - 1).bit_length())
-
-
-def _env_int(name: str) -> int:
-    raw = os.environ.get(name, "").strip()
-    return int(raw) if raw else 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,13 +110,13 @@ def validate_page_plan(shape: Shape, n_lanes: int) -> PagePlan:
     if w < 4:
         raise ValueError("paged entry log needs log_window >= 4 "
                          "(page_window must be a strict subset of it)")
-    w_res = shape.page_window or _env_int("RAFT_TPU_PAGE_WINDOW") or min(8, w // 2)
+    w_res = shape.page_window or config.env_int("RAFT_TPU_PAGE_WINDOW") or min(8, w // 2)
     if w_res & (w_res - 1) or not 2 <= w_res < w:
         raise ValueError(
             f"page_window={w_res} must be a power of two in 2..log_window/2 "
             f"(log_window={w})"
         )
-    pe = shape.page_entries or _env_int("RAFT_TPU_PAGE_ENTRIES") or min(4, w_res)
+    pe = shape.page_entries or config.env_int("RAFT_TPU_PAGE_ENTRIES") or min(4, w_res)
     if pe & (pe - 1) or not 1 <= pe <= w:
         raise ValueError(
             f"page_entries={pe} must be a power of two in 1..log_window "
@@ -124,7 +125,7 @@ def validate_page_plan(shape: Shape, n_lanes: int) -> PagePlan:
     plan = PagePlan(w=w, w_res=w_res, pe=pe, m=0, pool_pages=0)
     kmax = plan.kmax
     m = _next_pow2(kmax)
-    pool = shape.pool_pages or _env_int("RAFT_TPU_POOL_PAGES")
+    pool = shape.pool_pages or config.env_int("RAFT_TPU_POOL_PAGES")
     if pool == 0:
         # Full provisioning: every lane can hold its kmax pages at once,
         # +8 keeps the total divisible by any mesh shard count <= 8 while
@@ -193,6 +194,7 @@ def page_in(state: RaftState, paged: PagedLog):
     bumped. Slots outside `(snap, last]` come back as zeros — i.e. the
     canonical scrubbed layout (ops/log.py scrub_stale_slots). Index math
     runs in int32 regardless of the (possibly uint16-packed) carry dtypes."""
+    _CALLS.bump()
     w, w_res = paged.w, paged.w_res
     p, pe = paged.pool_term.shape
     m = paged.pt.shape[1]

@@ -79,7 +79,6 @@ RAFT_TPU_PALLAS_AUTOTUNE=0 skips every sweep (default_tile, K=1).
 from __future__ import annotations
 
 import dataclasses
-import os
 
 import jax
 import jax.numpy as jnp
@@ -93,6 +92,7 @@ except Exception:  # pragma: no cover - interpret mode works without SMEM
     pltpu = None
     _SMEM = None
 
+from raft_tpu import config
 from raft_tpu.chaos import device as chmod
 from raft_tpu.metrics import device as metmod
 from raft_tpu.ops import fused as fmod
@@ -152,7 +152,7 @@ class TileError(ValueError):
 
 def resolve_engine(engine: str | None = None) -> str:
     """kwarg > RAFT_TPU_ENGINE env > "xla". Unknown names raise."""
-    e = engine if engine is not None else os.environ.get("RAFT_TPU_ENGINE")
+    e = engine if engine is not None else config.env_raw("RAFT_TPU_ENGINE")
     e = (e or "xla").lower()
     if e not in ENGINES:
         raise ValueError(f"unknown engine {e!r}: expected one of {ENGINES}")
@@ -162,25 +162,21 @@ def resolve_engine(engine: str | None = None) -> str:
 def default_interpret() -> bool:
     """Interpret-mode default: RAFT_TPU_PALLAS_INTERPRET if set, else
     everything but real TPU hardware interprets (Mosaic is TPU-only)."""
-    env = os.environ.get("RAFT_TPU_PALLAS_INTERPRET")
+    env = config.env_raw("RAFT_TPU_PALLAS_INTERPRET")
     if env not in (None, ""):
         return env not in ("0", "off")
     return jax.default_backend() != "tpu"
 
 
 def autotune_enabled() -> bool:
-    return os.environ.get("RAFT_TPU_PALLAS_AUTOTUNE", "1") not in (
-        "0",
-        "",
-        "off",
-    )
+    return config.env_flag("RAFT_TPU_PALLAS_AUTOTUNE", default=True)
 
 
 def env_rounds_per_call() -> int | None:
     """RAFT_TPU_PALLAS_ROUNDS: pin the megakernel K. None when unset;
     parse failures raise the same clear error shape as RAFT_TPU_UNROLL
     (ops/fused.py:388-394) instead of surfacing mid-dispatch."""
-    raw = os.environ.get("RAFT_TPU_PALLAS_ROUNDS")
+    raw = config.env_raw("RAFT_TPU_PALLAS_ROUNDS")
     if raw in (None, ""):
         return None
     try:
@@ -267,7 +263,7 @@ def maybe_force_fail() -> None:
     time (pallas_rounds) and at dispatch time (FusedCluster._run_pallas,
     the sharded stepper) — a warm jit cache skips tracing entirely, and
     the fallback must still fire."""
-    if os.environ.get("RAFT_TPU_PALLAS_FORCE_FAIL", "0") not in ("0", ""):
+    if config.env_flag("RAFT_TPU_PALLAS_FORCE_FAIL", default=False):
         raise RuntimeError(
             "pallas lowering forced to fail (RAFT_TPU_PALLAS_FORCE_FAIL)"
         )

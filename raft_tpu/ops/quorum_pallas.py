@@ -42,12 +42,13 @@ callers operating outside the fused round).
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu import config
 
 I32 = jnp.int32
 # plain int so kernels don't capture a traced constant
@@ -240,7 +241,7 @@ def joint_committed_dispatch(
     if e is None:
         e = (
             "pallas"
-            if os.environ.get("RAFT_TPU_QUORUM_PALLAS", "1") not in ("0", "")
+            if config.env_flag("RAFT_TPU_QUORUM_PALLAS", default=True)
             else "xla"
         )
     if e not in ("xla", "pallas"):

@@ -40,29 +40,30 @@ stays flat and the scalar path serves alone).
 
 from __future__ import annotations
 
-import os
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import config
+from raft_tpu.testing.counters import CallCounter
+
 I32 = jnp.int32
 
 # kernel dispatch count; the elision tests assert it stays flat while
-# RAFT_TPU_EGRESS=0 (the jaxpr-level claim: no mask program ever exists)
-_KERNEL_CALLS = 0
+# RAFT_TPU_EGRESS=0 (the jaxpr-level claim: no mask program ever exists).
+# Shared CallCounter idiom (raft_tpu/testing/counters.py) — this one bumps
+# at DISPATCH time (host wrapper invokes the jitted kernel).
+_CALLS = CallCounter("egress")
+kernel_calls = _CALLS.calls
 
 
 def egress_enabled() -> bool:
     """Read RAFT_TPU_EGRESS lazily (default ON) so tests can toggle it;
     the value is baked into each consumer at construction, like the
     metrics plane (raft_tpu/metrics/device.py metrics_enabled)."""
-    return os.environ.get("RAFT_TPU_EGRESS", "1") not in ("0", "", "off")
-
-
-def kernel_calls() -> int:
-    return _KERNEL_CALLS
+    return config.env_flag("RAFT_TPU_EGRESS", default=True)
 
 
 class HostCursors(NamedTuple):
@@ -248,8 +249,7 @@ def compute_bundle(state, host: HostCursors) -> ReadyBundle:
     """Dispatch the batched predicate and resolve it to host numpy: ONE
     device program and one overlapped transfer set for all N lanes
     (copy_to_host_async on every leaf before the first blocking read)."""
-    global _KERNEL_CALLS
-    _KERNEL_CALLS += 1
+    _CALLS.bump()
     dev = _bundle_jit(
         state, HostCursors(*(jnp.asarray(a) for a in host))
     )
@@ -262,8 +262,7 @@ def compute_delta(state, prev: PrevCursors | None) -> DeltaBundle:
     """Dispatch the fused-engine delta kernel; the result arrays stay on
     device so the caller can start copy_to_host_async and resolve a block
     later (runtime/egress.py EgressStream)."""
-    global _KERNEL_CALLS
-    _KERNEL_CALLS += 1
+    _CALLS.bump()
     if prev is None:
         z = np.zeros(state.term.shape, np.int32)
         prev = PrevCursors(z, z, z, z, z, z)

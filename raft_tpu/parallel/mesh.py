@@ -131,6 +131,15 @@ class MeshBlockedCluster:
 
     # -- driving ----------------------------------------------------------
 
+    def audit_programs(self, rounds: int = 2):
+        """Audit records for the mesh driver (raft_tpu/analysis): every
+        block compiles the identical sharded stepper (same geometry,
+        same plane set), so the first block's record covers the mesh."""
+        recs = self.blocks[0].audit_programs(rounds)
+        for r in recs:
+            r["name"] = "mesh." + r["name"]
+        return recs
+
     def prepare_ops(self, ops: LocalOps) -> list[LocalOps]:
         """Slice a global-lane LocalOps into K per-block bindings ONCE
         (BlockedFusedCluster.prepare_ops contract; the per-shard split

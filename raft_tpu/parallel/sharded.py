@@ -16,7 +16,6 @@ device path.
 from __future__ import annotations
 
 import dataclasses
-import os
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +27,7 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+from raft_tpu import config
 from raft_tpu.cluster import (
     Cluster,
     deliver_flat,
@@ -480,8 +480,7 @@ class ShardedFusedCluster:
 
         t = self.inner._tile_req
         if t is None:
-            env = os.environ.get("RAFT_TPU_PALLAS_TILE")
-            t = int(env) if env else None
+            t = config.env_int("RAFT_TPU_PALLAS_TILE", default=0) or None
         if t is None:
             t = plr.default_tile(self.lanes_per_shard, self.v)
         plr.check_tile(self.lanes_per_shard, self.v, t)
@@ -508,6 +507,236 @@ class ShardedFusedCluster:
         self._shard_rounds = k
         return k
 
+    def _build_stepper(self, engine, rounds, do_tick, auto_propose,
+                       auto_compact_lag, rpc, tile=None, interp=None):
+        """Build the jitted shard_map stepper for one
+        (engine, rounds, tick/propose/compact, K) signature — the
+        program run() caches and dispatches. Factored out of run() so
+        the static auditor (raft_tpu/analysis) can enumerate and lower
+        the sharded entry point without dispatching a round."""
+        from raft_tpu.ops.fused import fused_rounds
+        from raft_tpu.ops import pallas_round as plr
+        from raft_tpu.trace.device import TraceState
+
+        met = self.inner.metrics
+        ch = self.inner.chaos
+        tr = self.inner.trace
+        pg = self.inner.paged
+        has_met, has_ch = met is not None, ch is not None
+        has_tr, has_pg = tr is not None, pg is not None
+        extras = [x for x in (met, ch, tr, pg) if x is not None]
+
+
+        def stepper(st, f, o, m, *ex):
+            mt = ex[0] if has_met else None
+            c = ex[int(has_met)] if has_ch else None
+            t = ex[int(has_met) + int(has_ch)] if has_tr else None
+            # the paged sidecar's shard slice is self-describing: the
+            # engines derive every geometry number from the local leaf
+            # shapes + the meta fields, so page ids stay shard-local
+            # for free
+            p_in = (
+                ex[int(has_met) + int(has_ch) + int(has_tr)]
+                if has_pg
+                else None
+            )
+            t_loc = lane_off = None
+            if has_tr:
+                # the shard sees a [1, R] slice of the stacked ring
+                # columns: collapse to the engines' monolithic [R] view
+                # and record with the shard's global lane offset so
+                # event lanes are cluster-global, not shard-local
+                t_loc = TraceState(
+                    ring_round=t.ring_round[0], ring_lane=t.ring_lane[0],
+                    ring_kind=t.ring_kind[0], ring_arg=t.ring_arg[0],
+                    wr=t.wr[0], round=t.round, stall=t.stall,
+                )
+                lane_off = (
+                    jax.lax.axis_index("groups")
+                    * jnp.int32(self.lanes_per_shard)
+                )
+            if engine == "pallas":
+                res = plr.pallas_rounds(
+                    st, f, o, m,
+                    v=self.v, tile_lanes=tile, n_rounds=rounds,
+                    rounds_per_call=rpc,
+                    do_tick=do_tick, auto_propose=auto_propose,
+                    auto_compact_lag=auto_compact_lag,
+                    interpret=interp, metrics=mt, chaos=c,
+                    trace=t_loc, trace_lane_offset=lane_off,
+                    paged=p_in,
+                )
+            else:
+                res = fused_rounds(
+                    st, f, o, m,
+                    v=self.v, n_rounds=rounds, do_tick=do_tick,
+                    auto_propose=auto_propose,
+                    auto_compact_lag=auto_compact_lag,
+                    straddle=self._spec, metrics=mt, chaos=c,
+                    trace=t_loc, trace_lane_offset=lane_off,
+                    paged=p_in,
+                )
+            out = [res[0], res[1]]
+            j = 2
+            if has_met:
+                mt2 = res[j]
+                j += 1
+                # each shard accumulated ONLY its own lanes' events on
+                # top of the replicated running totals; one psum of the
+                # scalar deltas per dispatch (not per round) rebuilds
+                # the replicated global totals — the EQuARX-style
+                # aggregate-before-export rule (PAPERS.md)
+                mt2 = dataclasses.replace(
+                    mt2,
+                    counters=mt.counters
+                    + jax.lax.psum(mt2.counters - mt.counters, "groups"),
+                    hist=mt.hist
+                    + jax.lax.psum(mt2.hist - mt.hist, "groups"),
+                    lat_sum=mt.lat_sum
+                    + jax.lax.psum(mt2.lat_sum - mt.lat_sum, "groups"),
+                    # every shard steps the same round count: recompute
+                    # from the replicated input
+                    round_ctr=mt.round_ctr + jnp.int32(rounds),
+                )
+                out.append(mt2)
+            if has_ch:
+                c2 = res[j]
+                # the recovery tallies are absolute recounts over the
+                # shard's own (group-aligned) lanes, so ONE psum per
+                # dispatch rebuilds the exact replicated global count
+                c2 = dataclasses.replace(
+                    c2,
+                    n_reelected=jax.lax.psum(c2.n_reelected, "groups"),
+                    n_recommitted=jax.lax.psum(
+                        c2.n_recommitted, "groups"
+                    ),
+                )
+                out.append(c2)
+                j += 1
+            if has_tr:
+                t2 = res[j]
+                j += 1
+                # re-stack the shard's [R] ring back into its [1, R]
+                # row of the stacked column (round stays replicated —
+                # every shard steps the same count)
+                out.append(TraceState(
+                    ring_round=t2.ring_round[None],
+                    ring_lane=t2.ring_lane[None],
+                    ring_kind=t2.ring_kind[None],
+                    ring_arg=t2.ring_arg[None],
+                    wr=t2.wr[None], round=t2.round, stall=t2.stall,
+                ))
+            if has_pg:
+                # per-lane counters, pool rows, page tables: all
+                # shard-local, no psum — ids never leave their shard
+                out.append(res[j])
+            return tuple(out)
+
+        in_specs = [
+            lane_specs(self.inner.state),
+            lane_specs(self.inner.fab),
+            lane_specs(self._no_ops),
+            P("groups"),
+        ]
+        out_specs = [
+            lane_specs(self.inner.state),
+            lane_specs(self.inner.fab),
+        ]
+        if has_met:
+            from raft_tpu.metrics.device import MetricsState
+
+            met_specs = MetricsState(
+                counters=P(), hist=P(), lat_sum=P(), round_ctr=P(),
+                samp_index=P("groups"), samp_round=P("groups"),
+            )
+            in_specs.append(met_specs)
+            out_specs.append(met_specs)
+        if has_ch:
+            from raft_tpu.chaos.device import ChaosState
+
+            ch_specs = ChaosState(
+                seed=P(), round=P(),
+                drop_num=P("groups"), dup_num=P("groups"),
+                part_send=P("groups"), part_recv=P("groups"),
+                tick_skew_num=P("groups"),
+                crash_at=P("groups"), restart_at=P("groups"),
+                heal_round=P(), base_committed=P("groups"),
+                reelect_round=P("groups"), recommit_round=P("groups"),
+                n_reelected=P(), n_recommitted=P(),
+            )
+            in_specs.append(ch_specs)
+            out_specs.append(ch_specs)
+        if has_tr:
+            tr_specs = TraceState(
+                ring_round=P("groups"), ring_lane=P("groups"),
+                ring_kind=P("groups"), ring_arg=P("groups"),
+                wr=P("groups"), round=P(), stall=P("groups"),
+            )
+            in_specs.append(tr_specs)
+            out_specs.append(tr_specs)
+        if has_pg:
+            # every paged leaf is axis-0 group-adjacent (pt/counters
+            # by lane, the pool by sub-pool row) — see __init__
+            pg_specs = jax.tree.map(lambda _: P("groups"), pg)
+            in_specs.append(pg_specs)
+            out_specs.append(pg_specs)
+        fn = shard_map(
+            stepper,
+            mesh=self.mesh,
+            in_specs=tuple(in_specs),
+            out_specs=tuple(out_specs),
+            **({"check_rep": False} if extras else {}),
+        )
+        donate = ()
+        if self._donate:
+            donate = (0, 1) + tuple(range(4, 4 + len(extras)))
+        return jax.jit(fn, donate_argnums=donate)
+
+    def audit_programs(self, rounds: int = 2):
+        """Audit records for the sharded stepper (raft_tpu/analysis).
+        Builds the exact jitted shard_map program run() would cache —
+        via _build_stepper, so the two can never drift — but only hands
+        it to the auditor for tracing/lowering; no dispatch happens."""
+        from raft_tpu.ops import pallas_round as plr
+
+        engine = self.inner.engine
+        tile = interp = None
+        rpc = 1
+        if engine == "pallas":
+            rpc = self._resolve_shard_rounds()
+            tile = self._resolve_shard_tile()
+            interp = plr.default_interpret()
+        jit = self._build_stepper(
+            engine, rounds, True, False, None, rpc, tile, interp,
+        )
+        extras = [
+            x
+            for x in (
+                self.inner.metrics, self.inner.chaos,
+                self.inner.trace, self.inner.paged,
+            )
+            if x is not None
+        ]
+        donate_argnums = (
+            (0, 1) + tuple(range(4, 4 + len(extras)))
+            if self._donate
+            else ()
+        )
+        return [dict(
+            name=f"sharded.step.{engine}",
+            fn=jit,
+            jit=jit,
+            args=(
+                self.inner.state, self.inner.fab, self._no_ops,
+                self.inner.mute, *extras,
+            ),
+            kwargs={},
+            static={},
+            donate=self._donate,
+            donate_argnums=donate_argnums,
+            donate_argnames=(),
+        )]
+
     def run(self, rounds: int = 1, ops=None, do_tick: bool = True,
             auto_propose: bool = False, auto_compact_lag=None,
             wal=None, egress=None, trace=None):
@@ -519,9 +748,7 @@ class ShardedFusedCluster:
         ride the INNER cluster's donation fences (_wal_pending /
         _egress_pending / _trace_pending), so a diet auto-rebase between
         dispatches flushes them exactly like the monolithic path."""
-        from raft_tpu.ops.fused import fused_rounds
         from raft_tpu.ops import pallas_round as plr
-        from raft_tpu.trace.device import TraceState
 
         ops = (
             self._no_ops
@@ -566,171 +793,10 @@ class ShardedFusedCluster:
                 rpc = 1
         key = (engine, rounds, do_tick, auto_propose, auto_compact_lag, rpc)
         if key not in self._cache:
-
-            def stepper(st, f, o, m, *ex):
-                mt = ex[0] if has_met else None
-                c = ex[int(has_met)] if has_ch else None
-                t = ex[int(has_met) + int(has_ch)] if has_tr else None
-                # the paged sidecar's shard slice is self-describing: the
-                # engines derive every geometry number from the local leaf
-                # shapes + the meta fields, so page ids stay shard-local
-                # for free
-                p_in = (
-                    ex[int(has_met) + int(has_ch) + int(has_tr)]
-                    if has_pg
-                    else None
-                )
-                t_loc = lane_off = None
-                if has_tr:
-                    # the shard sees a [1, R] slice of the stacked ring
-                    # columns: collapse to the engines' monolithic [R] view
-                    # and record with the shard's global lane offset so
-                    # event lanes are cluster-global, not shard-local
-                    t_loc = TraceState(
-                        ring_round=t.ring_round[0], ring_lane=t.ring_lane[0],
-                        ring_kind=t.ring_kind[0], ring_arg=t.ring_arg[0],
-                        wr=t.wr[0], round=t.round, stall=t.stall,
-                    )
-                    lane_off = (
-                        jax.lax.axis_index("groups")
-                        * jnp.int32(self.lanes_per_shard)
-                    )
-                if engine == "pallas":
-                    res = plr.pallas_rounds(
-                        st, f, o, m,
-                        v=self.v, tile_lanes=tile, n_rounds=rounds,
-                        rounds_per_call=rpc,
-                        do_tick=do_tick, auto_propose=auto_propose,
-                        auto_compact_lag=auto_compact_lag,
-                        interpret=interp, metrics=mt, chaos=c,
-                        trace=t_loc, trace_lane_offset=lane_off,
-                        paged=p_in,
-                    )
-                else:
-                    res = fused_rounds(
-                        st, f, o, m,
-                        v=self.v, n_rounds=rounds, do_tick=do_tick,
-                        auto_propose=auto_propose,
-                        auto_compact_lag=auto_compact_lag,
-                        straddle=self._spec, metrics=mt, chaos=c,
-                        trace=t_loc, trace_lane_offset=lane_off,
-                        paged=p_in,
-                    )
-                out = [res[0], res[1]]
-                j = 2
-                if has_met:
-                    mt2 = res[j]
-                    j += 1
-                    # each shard accumulated ONLY its own lanes' events on
-                    # top of the replicated running totals; one psum of the
-                    # scalar deltas per dispatch (not per round) rebuilds
-                    # the replicated global totals — the EQuARX-style
-                    # aggregate-before-export rule (PAPERS.md)
-                    mt2 = dataclasses.replace(
-                        mt2,
-                        counters=mt.counters
-                        + jax.lax.psum(mt2.counters - mt.counters, "groups"),
-                        hist=mt.hist
-                        + jax.lax.psum(mt2.hist - mt.hist, "groups"),
-                        lat_sum=mt.lat_sum
-                        + jax.lax.psum(mt2.lat_sum - mt.lat_sum, "groups"),
-                        # every shard steps the same round count: recompute
-                        # from the replicated input
-                        round_ctr=mt.round_ctr + jnp.int32(rounds),
-                    )
-                    out.append(mt2)
-                if has_ch:
-                    c2 = res[j]
-                    # the recovery tallies are absolute recounts over the
-                    # shard's own (group-aligned) lanes, so ONE psum per
-                    # dispatch rebuilds the exact replicated global count
-                    c2 = dataclasses.replace(
-                        c2,
-                        n_reelected=jax.lax.psum(c2.n_reelected, "groups"),
-                        n_recommitted=jax.lax.psum(
-                            c2.n_recommitted, "groups"
-                        ),
-                    )
-                    out.append(c2)
-                    j += 1
-                if has_tr:
-                    t2 = res[j]
-                    j += 1
-                    # re-stack the shard's [R] ring back into its [1, R]
-                    # row of the stacked column (round stays replicated —
-                    # every shard steps the same count)
-                    out.append(TraceState(
-                        ring_round=t2.ring_round[None],
-                        ring_lane=t2.ring_lane[None],
-                        ring_kind=t2.ring_kind[None],
-                        ring_arg=t2.ring_arg[None],
-                        wr=t2.wr[None], round=t2.round, stall=t2.stall,
-                    ))
-                if has_pg:
-                    # per-lane counters, pool rows, page tables: all
-                    # shard-local, no psum — ids never leave their shard
-                    out.append(res[j])
-                return tuple(out)
-
-            in_specs = [
-                lane_specs(self.inner.state),
-                lane_specs(self.inner.fab),
-                lane_specs(self._no_ops),
-                P("groups"),
-            ]
-            out_specs = [
-                lane_specs(self.inner.state),
-                lane_specs(self.inner.fab),
-            ]
-            if has_met:
-                from raft_tpu.metrics.device import MetricsState
-
-                met_specs = MetricsState(
-                    counters=P(), hist=P(), lat_sum=P(), round_ctr=P(),
-                    samp_index=P("groups"), samp_round=P("groups"),
-                )
-                in_specs.append(met_specs)
-                out_specs.append(met_specs)
-            if has_ch:
-                from raft_tpu.chaos.device import ChaosState
-
-                ch_specs = ChaosState(
-                    seed=P(), round=P(),
-                    drop_num=P("groups"), dup_num=P("groups"),
-                    part_send=P("groups"), part_recv=P("groups"),
-                    tick_skew_num=P("groups"),
-                    crash_at=P("groups"), restart_at=P("groups"),
-                    heal_round=P(), base_committed=P("groups"),
-                    reelect_round=P("groups"), recommit_round=P("groups"),
-                    n_reelected=P(), n_recommitted=P(),
-                )
-                in_specs.append(ch_specs)
-                out_specs.append(ch_specs)
-            if has_tr:
-                tr_specs = TraceState(
-                    ring_round=P("groups"), ring_lane=P("groups"),
-                    ring_kind=P("groups"), ring_arg=P("groups"),
-                    wr=P("groups"), round=P(), stall=P("groups"),
-                )
-                in_specs.append(tr_specs)
-                out_specs.append(tr_specs)
-            if has_pg:
-                # every paged leaf is axis-0 group-adjacent (pt/counters
-                # by lane, the pool by sub-pool row) — see __init__
-                pg_specs = jax.tree.map(lambda _: P("groups"), pg)
-                in_specs.append(pg_specs)
-                out_specs.append(pg_specs)
-            fn = shard_map(
-                stepper,
-                mesh=self.mesh,
-                in_specs=tuple(in_specs),
-                out_specs=tuple(out_specs),
-                **({"check_rep": False} if extras else {}),
+            self._cache[key] = self._build_stepper(
+                engine, rounds, do_tick, auto_propose, auto_compact_lag,
+                rpc, tile, interp,
             )
-            donate = ()
-            if self._donate:
-                donate = (0, 1) + tuple(range(4, 4 + len(extras)))
-            self._cache[key] = jax.jit(fn, donate_argnums=donate)
         try:
             with _no_persistent_cache(self._donate):
                 res = self._cache[key](

@@ -20,7 +20,6 @@ the reference log layer reads only Term/Index/size (log.go:109-456).
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any
 
 import jax
@@ -34,6 +33,7 @@ from raft_tpu.config import (
     DEFAULT_MAX_SIZE_PER_MSG,
     DEFAULT_MAX_UNCOMMITTED_SIZE,
     Shape,
+    env_flag,
 )
 from raft_tpu.types import StateType
 
@@ -307,7 +307,7 @@ def diet_enabled() -> bool:
     """Read RAFT_TPU_DIET lazily (default OFF) so tests/benches can toggle
     it per-cluster; like donation_enabled, the value is baked into each
     cluster at construction and the carry layout never flips mid-run."""
-    return os.environ.get("RAFT_TPU_DIET", "0") not in ("0", "", "off")
+    return env_flag("RAFT_TPU_DIET", default=False)
 
 
 def bitset_dtype(v: int):

@@ -1,0 +1,3 @@
+from raft_tpu.testing.counters import CallCounter, registered, snapshot
+
+__all__ = ["CallCounter", "registered", "snapshot"]

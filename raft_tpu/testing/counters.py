@@ -1,0 +1,70 @@
+"""Shared trace/dispatch-time call counters behind the `kernel_calls()` idiom.
+
+Several planes prove their compile-time elision claim the same way: a
+module-level counter bumps whenever the plane's device code is TRACED (or,
+for host-dispatched kernels, whenever the jitted kernel is invoked), and
+the elision tests assert the counter stays flat while the plane's env knob
+is off — the jaxpr-level claim that no plane primitive ever entered a
+program. The counter started as a copy-pasted `_KERNEL_CALLS = 0` global
+in trace/device.py and ops/ready_mask.py; this class is the one shared
+implementation, and the static program auditor (raft_tpu/analysis)
+consumes every registered counter to audit elision across ALL entry
+points rather than the ones a test happened to poke.
+
+Usage in a plane module::
+
+    from raft_tpu.testing.counters import CallCounter
+    _CALLS = CallCounter("metrics")
+    kernel_calls = _CALLS.calls      # back-compat: kernel_calls() -> int
+
+    def commit_round(...):
+        _CALLS.bump()                # once per traced call site
+        ...
+
+Two bump disciplines coexist (both prove the same elision claim):
+
+- trace-time (trace/device.py record_round, metrics/chaos/paged device
+  fns): bumps when the plane's jnp code is traced into a program — flat
+  counter means the plane contributed zero primitives to any jaxpr.
+- dispatch-time (ops/ready_mask.py compute_bundle/compute_delta): bumps
+  when the host wrapper invokes the jitted kernel — flat counter means
+  the kernel program was never even dispatched.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# registry of every live counter by plane name — the static auditor
+# (raft_tpu/analysis/jaxpr_audit.py) snapshots all of them around a trace
+_REGISTRY: dict[str, "CallCounter"] = {}
+_LOCK = threading.Lock()
+
+
+class CallCounter:
+    """A named call counter; `calls()` reads, `bump()` increments."""
+
+    __slots__ = ("name", "_calls")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._calls = 0
+        with _LOCK:
+            _REGISTRY[name] = self
+
+    def bump(self) -> None:
+        self._calls += 1
+
+    def calls(self) -> int:
+        return self._calls
+
+
+def registered() -> dict[str, CallCounter]:
+    """Live counters by plane name (auditor introspection hook)."""
+    with _LOCK:
+        return dict(_REGISTRY)
+
+
+def snapshot() -> dict[str, int]:
+    """Current count of every registered counter."""
+    return {name: c.calls() for name, c in registered().items()}

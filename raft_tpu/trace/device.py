@@ -38,12 +38,13 @@ pallas tracing, and the trace plane should not depend on it.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import config
+from raft_tpu.testing.counters import CallCounter
 from raft_tpu.types import StateType
 
 I32 = jnp.int32
@@ -100,17 +101,13 @@ class TraceState:
 def tracelog_enabled() -> bool:
     """Read RAFT_TPU_TRACELOG lazily (default OFF — tracing is opt-in like
     chaos); the value is baked into each cluster at construction."""
-    return os.environ.get("RAFT_TPU_TRACELOG", "0") not in ("0", "", "off")
+    return config.env_flag("RAFT_TPU_TRACELOG", default=False)
 
 
 def ring_capacity() -> int:
     """Ring slots per block (RAFT_TPU_TRACE_RING, default 4096 = 64 KiB of
     ring per block at 4 i32 columns)."""
-    raw = os.environ.get("RAFT_TPU_TRACE_RING", "4096")
-    try:
-        r = int(raw)
-    except ValueError as e:
-        raise ValueError(f"RAFT_TPU_TRACE_RING={raw!r} is not an int") from e
+    r = config.env_int("RAFT_TPU_TRACE_RING", default=4096)
     if r <= 0:
         raise ValueError(f"RAFT_TPU_TRACE_RING must be positive, got {r}")
     return r
@@ -132,13 +129,11 @@ def init_trace(n: int, ring: int | None = None) -> TraceState:
 
 
 # trace-time counter: bumps once per record_round() CALL SITE TRACED, i.e.
-# stays put when the plane is elided — the ready_mask.kernel_calls idiom,
-# asserted by tests/test_trace.py and benches/trace_ab.py
-_KERNEL_CALLS = 0
-
-
-def kernel_calls() -> int:
-    return _KERNEL_CALLS
+# stays put when the plane is elided — shared CallCounter idiom
+# (raft_tpu/testing/counters.py), asserted by tests/test_trace.py,
+# benches/trace_ab.py, and the static auditor's elision check
+_CALLS = CallCounter("trace")
+kernel_calls = _CALLS.calls
 
 
 def record_round(
@@ -161,8 +156,7 @@ def record_round(
     lane_offset: global index of lane 0 of this state window (sharded
          dispatch); None/0 = lanes are already global.
     """
-    global _KERNEL_CALLS
-    _KERNEL_CALLS += 1
+    _CALLS.bump()
 
     n = st0.term.shape[0]
     r = trace.ring_round.shape[0]

@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import os
 
+from raft_tpu import config
+
 
 def cache_dir_from_env() -> str | None:
     """The env-requested persistent cache dir, or None when unset.
@@ -24,8 +26,8 @@ def cache_dir_from_env() -> str | None:
     wire it so repeat runs skip the fused-kernel compile on ANY backend,
     CPU included); RAFT_TPU_CACHE_DIR is the older TPU-path spelling."""
     return (
-        os.environ.get("RAFT_TPU_COMPILE_CACHE")
-        or os.environ.get("RAFT_TPU_CACHE_DIR")
+        config.env_raw("RAFT_TPU_COMPILE_CACHE")
+        or config.env_raw("RAFT_TPU_CACHE_DIR")
         or None
     )
 

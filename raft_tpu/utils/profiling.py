@@ -16,14 +16,15 @@ the driver can turn any run into a profile without code changes.
 from __future__ import annotations
 
 import contextlib
-import os
 import time
+
+from raft_tpu import config
 
 
 @contextlib.contextmanager
 def trace(log_dir: str | None = None):
     """Profile the enclosed region. No-op when log_dir is None/empty, so
-    call sites can pass os.environ.get("RAFT_TPU_TRACE") unconditionally."""
+    call sites can pass env_trace_dir() unconditionally."""
     if not log_dir:
         yield
         return
@@ -118,7 +119,7 @@ class SpanRecorder:
 
 
 def env_trace_dir() -> str | None:
-    return os.environ.get("RAFT_TPU_TRACE") or None
+    return config.env_raw("RAFT_TPU_TRACE") or None
 
 
 def live_buffer_bytes() -> int:

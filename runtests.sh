@@ -39,6 +39,18 @@ run_bench() {
     python "$@"
 }
 
+static_gate() {
+  # static analysis gate (raft_tpu/analysis): repo lint + jaxpr/HLO
+  # invariant audit over every manifest entry point + the recompile
+  # sentinel, in its own process BEFORE any test chunk — a broken
+  # compile-time contract fails in ~a minute instead of surfacing as a
+  # flaky assert deep in the suite. Emits ANALYSIS.json next to the
+  # bench JSONs.
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python -m raft_tpu.analysis --json ANALYSIS.json
+}
+
 smokes() {
   # device-metrics smoke + the donation A/B dispatch smoke (fails if
   # donation-on regresses throughput or stops lowering live buffers) +
@@ -78,6 +90,7 @@ smokes() {
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
+  static_gate || exit 1
   if python -c "import xdist" >/dev/null 2>&1; then
     # pytest-xdist, one file per worker (--dist loadfile): 6 worker
     # processes keep every process's XLA:CPU compile count far under the
@@ -116,6 +129,10 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
       tests/test_snapshot.py tests/test_status.py tests/test_transfer.py \
       tests/test_unstable.py tests/test_util_ports.py tests/test_vote_states.py \
       tests/test_wal.py
+    # the auditor suite gets its own process: its all-green matrix
+    # builds every manifest entry (incl. the 8-device sharded stepper)
+    # and its purity gate counts compiles process-wide
+    run_chunk tests/test_analysis.py
     # the serving frontend gets its own process: its module-scoped
     # ServeLoop fixtures compile fused programs for two cluster shapes
     run_chunk tests/test_serve.py

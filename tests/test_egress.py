@@ -237,8 +237,14 @@ def test_egress_on_mask_ops_in_jaxpr():
         host_pending=np.zeros((n,), bool), is_async=np.zeros((n,), bool),
         inprog=z, snap_inprog=z, applying=z,
     )
-    jaxpr = str(jax.make_jaxpr(rm.ready_bundle)(b.state, host))
-    assert "cumsum" in jaxpr and "scatter" in jaxpr
+    from raft_tpu.analysis import jaxpr_audit
+
+    jaxpr = jax.make_jaxpr(rm.ready_bundle)(b.state, host)
+    prims = {eqn.primitive.name for eqn in jaxpr_audit.iter_eqns(jaxpr)}
+    assert any("cumsum" in p for p in prims)
+    assert any("scatter" in p for p in prims)
+    # ...and nothing host-side: the auditor's hygiene pass must stay clean
+    assert not jaxpr_audit.check_host_hygiene("egress.ready_bundle", jaxpr)
 
 
 # -- EgressStream on the fused engine ----------------------------------------

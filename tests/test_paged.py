@@ -252,6 +252,28 @@ def test_xla_digest_identity_and_narrow_carry(monkeypatch, page_window):
     assert on_log < off_log
 
 
+def test_paged_elision_via_auditor(monkeypatch):
+    """Paging is compile-time elided, not branch-skipped: with
+    RAFT_TPU_PAGED=0 the page gather never traces into the round program
+    (flat 'paged' counter via the shared auditor); with it on, the round
+    program pages the window in at the dispatch boundary."""
+    from raft_tpu.analysis import jaxpr_audit
+
+    _set_env(monkeypatch, paged="0")
+    rec = FusedCluster(G, V, seed=11, shape=_small_shape()).audit_programs()[0]
+    _, deltas = jaxpr_audit.traced_counter_deltas(rec)
+    assert not jaxpr_audit.check_elision(rec["name"], deltas,
+                                         {"paged": False})
+
+    _set_env(monkeypatch, paged="1")
+    rec = FusedCluster(G, V, seed=11, shape=_small_shape()).audit_programs()[0]
+    _, deltas = jaxpr_audit.traced_counter_deltas(rec)
+    assert not jaxpr_audit.check_elision(rec["name"], deltas,
+                                         {"paged": True})
+    # detector sanity: claiming paged-off against the paged program fails
+    assert jaxpr_audit.check_elision(rec["name"], deltas, {"paged": False})
+
+
 def test_paged_stats_and_metrics_plane(monkeypatch):
     from raft_tpu.metrics.host import PAGED_COUNTERS, PAGED_EVENTS
 

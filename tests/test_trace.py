@@ -258,49 +258,42 @@ def test_stream_disabled_is_noop():
 # -- compile-time elision gate ---------------------------------------------
 
 
-def _scan_carry_shapes(jaxpr):
-    shapes = set()
-    for eqn in jaxpr.jaxpr.eqns:
-        for v in list(eqn.invars) + list(eqn.outvars):
-            aval = getattr(v, "aval", None)
-            if aval is not None and hasattr(aval, "shape"):
-                shapes.add(tuple(aval.shape))
-    return shapes
-
-
 def test_trace_off_elides_from_jaxpr_and_dispatches_nothing(monkeypatch):
-    from raft_tpu.ops.fused import FusedCluster, fused_rounds, no_ops
+    from raft_tpu.analysis import jaxpr_audit
+    from raft_tpu.ops.fused import FusedCluster
 
     monkeypatch.delenv("RAFT_TPU_TRACELOG", raising=False)
     calls0 = trdev.kernel_calls()
     c = FusedCluster(1, 3, seed=2)
     assert c.trace is None
-    n = c.shape.n
-    off = jax.make_jaxpr(
-        lambda st, f: fused_rounds(st, f, no_ops(n), None, v=3, n_rounds=2)
-    )(c.state, c.fab)
-    # ring-shaped values must not exist anywhere in the traced program
-    assert not any(s == (trdev.ring_capacity(),) for s in _scan_carry_shapes(off))
+    rec = c.audit_programs()[0]
+    off, deltas = jaxpr_audit.traced_counter_deltas(rec)
+    assert not jaxpr_audit.check_elision(rec["name"], deltas,
+                                         {"trace": False})
+    # ring-shaped values must not ride the scan carry / kernel operands
+    assert not any(
+        shape == (trdev.ring_capacity(),)
+        for shape, _ in jaxpr_audit.storage_avals(off)
+    )
     c.run(2, trace=TraceStream())
     assert trdev.kernel_calls() == calls0
     assert c.metrics_snapshot() is not None  # metrics plane untouched
 
 
 def test_trace_on_carries_ring_through_scan(monkeypatch):
-    from raft_tpu.ops.fused import FusedCluster, fused_rounds, no_ops
+    from raft_tpu.analysis import jaxpr_audit
+    from raft_tpu.ops.fused import FusedCluster
 
     monkeypatch.setenv("RAFT_TPU_TRACELOG", "1")
     monkeypatch.setenv("RAFT_TPU_TRACE_RING", "257")  # collision-proof shape
     calls0 = trdev.kernel_calls()
     c = FusedCluster(1, 3, seed=2)
     assert c.trace is not None and c.trace.ring_round.shape == (257,)
-    n = c.shape.n
-    on = jax.make_jaxpr(
-        lambda st, f, tr: fused_rounds(
-            st, f, no_ops(n), None, v=3, n_rounds=2, trace=tr
-        )
-    )(c.state, c.fab, c.trace)
-    assert (257,) in _scan_carry_shapes(on)
+    rec = c.audit_programs()[0]
+    on, deltas = jaxpr_audit.traced_counter_deltas(rec)
+    assert not jaxpr_audit.check_elision(rec["name"], deltas,
+                                         {"trace": True})
+    assert (257,) in {shape for shape, _ in jaxpr_audit.storage_avals(on)}
     assert trdev.kernel_calls() > calls0
 
 
