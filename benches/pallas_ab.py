@@ -54,10 +54,10 @@ def child():
     import jax
     import numpy as np
 
+    from raft_tpu.analysis import ledger
     from raft_tpu.config import Shape
     from raft_tpu.metrics.host import ENGINE_EVENTS
     from raft_tpu.ops import fused
-    from raft_tpu.ops import pallas_round as plr
     from raft_tpu.utils.profiling import device_memory_stats, live_buffer_bytes
 
     engine = config.env_str("RAFT_TPU_ENGINE")
@@ -92,33 +92,11 @@ def child():
     jax.block_until_ready(c.state.term)
     ms_per_round = (time.perf_counter() - t0) / (rounds * iters) * 1e3
 
-    # bytes-moved probe: the compiled round block's own cost analysis
-    kw = dict(
-        v=v, n_rounds=rounds, do_tick=True, auto_propose=True,
-        auto_compact_lag=lag, ops_first_round_only=True,
-        metrics=c.metrics, chaos=c.chaos,
+    # bytes-moved probe: the compiled round block's own cost analysis,
+    # via the shared ledger helper (same lowering the static gate uses)
+    bytes_per_round = ledger.round_bytes_probe(
+        c, rounds, auto_propose=True, auto_compact_lag=lag
     )
-    bytes_per_round = None
-    try:
-        if c.engine == "pallas":
-            lowered = plr._pallas_rounds_nodonate_jit.lower(
-                c.state, c.fab, c._no_ops, c.mute,
-                tile_lanes=c._pallas_tile, interpret=c._pallas_interpret,
-                rounds_per_call=c._pallas_rounds or 1,
-                **kw,
-            )
-        else:
-            lowered = fused._fused_rounds_nodonate_jit.lower(
-                c.state, c.fab, c._no_ops, c.mute, straddle=None, **kw
-            )
-        cost = lowered.compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        ba = cost.get("bytes accessed") if cost else None
-        if ba is not None:
-            bytes_per_round = float(ba) / rounds
-    except Exception:
-        pass  # backends without cost analysis: probe stays None
 
     digest = hashlib.sha256()
     for name in DIGEST_FIELDS:

@@ -3,12 +3,24 @@
 Runs, in order:
 
 1. the repo lint (AST only, no jax),
-2. the jaxpr/HLO audit over every registry entry point,
+2. the jaxpr/HLO audit over every registry entry point (capture,
+   hygiene, donation, carry stability, donation escape, paged
+   roundtrips),
 3. the recompile sentinel (unless ``--no-sentinel``),
+4. the compiled-program resource ledger (``--ledger``): AOT-compile
+   every entry, extract cost/memory analysis, diff the per-lane
+   metrics against the checked-in LEDGER.json budgets.
 
-writes the combined report to ANALYSIS.json (``--json`` to move it),
+The ledger deliberately runs AFTER the sentinel: its ``lower().
+compile()`` calls hit the same process-wide jax caches, and running
+them first would make the sentinel's compile counters meaningless.
+
+Writes the combined report to ANALYSIS.json (``--json`` to move it),
 prints a one-line-per-finding summary, and exits non-zero on any
 finding. ``--lint-only`` stops after step 1 for the fastest gate.
+``--update-ledger`` re-baselines LEDGER.json from the current build
+instead of gating (implies ``--ledger``); the human-readable diff of
+the last ledger run lands in LEDGER_DIFF.txt next to the report.
 
 Env pinning happens BEFORE jax is imported: unless the caller already
 chose, the gate runs on the CPU platform with 8 host devices so the
@@ -39,12 +51,20 @@ def main(argv=None) -> int:
                     help="run only the AST lint (no jax import)")
     ap.add_argument("--no-sentinel", action="store_true",
                     help="skip the recompile sentinel (audit + lint only)")
+    ap.add_argument("--ledger", action="store_true",
+                    help="AOT-compile every entry and gate the per-lane "
+                         "cost/memory metrics against LEDGER.json")
+    ap.add_argument("--update-ledger", action="store_true",
+                    help="re-baseline LEDGER.json from the current build "
+                         "instead of gating (implies --ledger)")
     args = ap.parse_args(argv)
+    if args.update_ledger:
+        args.ledger = True
 
     _pin_env()
     findings = []
     report = {"findings": [], "lint": None, "entries": None,
-              "recompile": None}
+              "recompile": None, "ledger": None}
 
     from raft_tpu.analysis.lint import run_lint
 
@@ -56,18 +76,9 @@ def main(argv=None) -> int:
         from raft_tpu.analysis import jaxpr_audit
         from raft_tpu.analysis.registry import build_records
 
-        entries = []
-        for entry, rec in build_records():
-            fs = jaxpr_audit.audit_record(
-                rec, expect_on=entry.expect_on, diet=entry.diet
-            )
-            findings += fs
-            entries.append({
-                "name": entry.name,
-                "profile": entry.profile,
-                "compile_budget": entry.compile_budget,
-                "findings": len(fs),
-            })
+        pairs = build_records()
+        audit_findings, entries = jaxpr_audit.audit_entries(pairs)
+        findings += audit_findings
         report["entries"] = entries
 
         if not args.no_sentinel:
@@ -76,6 +87,21 @@ def main(argv=None) -> int:
             sentinel_findings, sentinel_report = run_sentinel()
             findings += sentinel_findings
             report["recompile"] = sentinel_report
+
+        if args.ledger:
+            from raft_tpu.analysis import ledger
+
+            ledger_findings, ledger_report = ledger.run_ledger(
+                pairs, update=args.update_ledger
+            )
+            findings += ledger_findings
+            report["ledger"] = ledger_report
+            diff_path = os.path.join(
+                os.path.dirname(os.path.abspath(args.json)),
+                "LEDGER_DIFF.txt",
+            )
+            with open(diff_path, "w") as fh:
+                fh.write(ledger_report.get("diff") or "(no diff)\n")
 
     report["findings"] = [f.as_dict() for f in findings]
     report["ok"] = not findings

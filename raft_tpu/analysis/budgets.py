@@ -1,0 +1,230 @@
+"""Resource-budget schema for the compiled-program ledger.
+
+The ledger (analysis/ledger.py) extracts per-entry resource metrics from
+every registry entry point's compiled program — XLA ``cost_analysis``
+(flops, bytes accessed) and ``memory_analysis`` (argument/output/temp/
+alias bytes, generated code size) — normalized to shape-invariant
+per-lane (and per-round, for the flow metrics) numbers so the same
+budget holds at 12 lanes on CPU CI and at 3M lanes on a chip. This
+module owns everything about those numbers EXCEPT their extraction:
+
+- the metric schema (names, which are hard, which direction fails),
+- per-metric tolerances and the ``RAFT_TPU_LEDGER_TOL`` scaling rule,
+- the LEDGER.json load/save format,
+- the baseline diff (``diff_entry``) and its human rendering.
+
+No jax import here: budget arithmetic must be loadable by tooling (and
+the seeded-regression tests) without touching a backend.
+
+LEDGER.json format (version 1)::
+
+    {
+      "version": 1,
+      "meta": {"backend": "cpu", "jax": "0.4.37"},
+      "entries": {
+        "round.xla": {"carry_bytes_per_lane": 199.0, ...},
+        ...
+      }
+    }
+
+Metric semantics:
+
+- ``carry_bytes_per_lane`` — bytes of the between-rounds carry (the HBM
+  residency claim) per lane, from the record's carry-leg avals. HARD:
+  growth past tolerance fails regardless of RAFT_TPU_LEDGER_TOL; this is
+  the diet's 38% and the paged window's savings, the north-star number.
+- ``temp_bytes_per_lane`` — XLA temp allocations per lane. HARD: a new
+  temp buffer is a silent HBM tax per dispatch.
+- ``arg_bytes_per_lane`` / ``out_bytes_per_lane`` — the program's
+  argument/result footprint per lane (aval-determined, so the tolerance
+  is essentially zero).
+- ``alias_bytes_per_lane`` — donated bytes aliased in-place per lane.
+  FLOOR metric: this one fails on *shrink* (a dropped donation alias is
+  an HBM doubling); growth is an improvement.
+- ``bytes_moved_per_round_per_lane`` / ``flops_per_round_per_lane`` —
+  cost-analysis flow metrics, normalized per round per lane.
+- ``generated_code_bytes`` — absolute executable size (not per-lane);
+  the loosest tolerance, it exists to catch code-size explosions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from raft_tpu.analysis.jaxpr_audit import Finding
+
+LEDGER_VERSION = 1
+
+# metrics whose value is fully determined by avals (not by the backend's
+# cost model) — the only ones compared when the baseline was produced on
+# a different backend than the current run
+AVAL_METRICS = (
+    "carry_bytes_per_lane",
+    "arg_bytes_per_lane",
+    "out_bytes_per_lane",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Tolerance:
+    """Failure rule for one metric. ``grow`` direction fails when
+    ``cur > base * (1 + rel) + atol``; ``shrink`` fails when
+    ``cur < base * (1 - rel) - atol``. ``hard`` metrics ignore the
+    RAFT_TPU_LEDGER_TOL multiplier — their budget is the contract."""
+
+    rel: float = 0.0
+    atol: float = 0.0
+    hard: bool = False
+    direction: str = "grow"  # "grow" | "shrink"
+
+    def scaled(self, scale: float) -> "Tolerance":
+        if self.hard or scale == 1.0:
+            return self
+        return dataclasses.replace(
+            self, rel=self.rel * scale, atol=self.atol * scale
+        )
+
+
+# schema order is render order
+TOLERANCES = {
+    "carry_bytes_per_lane": Tolerance(rel=0.0, atol=0.5, hard=True),
+    "temp_bytes_per_lane": Tolerance(rel=0.0, atol=2.0, hard=True),
+    "arg_bytes_per_lane": Tolerance(rel=0.0, atol=0.5, hard=True),
+    "out_bytes_per_lane": Tolerance(rel=0.0, atol=0.5, hard=True),
+    "alias_bytes_per_lane": Tolerance(
+        rel=0.0, atol=0.5, hard=True, direction="shrink"
+    ),
+    "bytes_moved_per_round_per_lane": Tolerance(rel=0.05, atol=64.0),
+    "flops_per_round_per_lane": Tolerance(rel=0.05, atol=64.0),
+    "generated_code_bytes": Tolerance(rel=0.5, atol=16384.0),
+}
+
+
+def scaled_tolerances(scale: float) -> dict:
+    """Apply the RAFT_TPU_LEDGER_TOL multiplier to every SOFT metric's
+    tolerance; hard budgets (carry, temps, interface bytes, aliases)
+    never loosen."""
+    return {k: t.scaled(scale) for k, t in TOLERANCES.items()}
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def default_ledger_path() -> str:
+    from raft_tpu import config
+
+    return config.env_str(
+        "RAFT_TPU_LEDGER_PATH", os.path.join(repo_root(), "LEDGER.json")
+    )
+
+
+def load_ledger(path: str) -> dict | None:
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        data = json.load(fh)
+    if data.get("version") != LEDGER_VERSION:
+        raise ValueError(
+            f"{path}: ledger version {data.get('version')!r}, this tree "
+            f"speaks {LEDGER_VERSION} — regenerate with --update-ledger"
+        )
+    return data
+
+
+def save_ledger(path: str, meta: dict, entries: dict) -> None:
+    data = {
+        "version": LEDGER_VERSION,
+        "meta": meta,
+        "entries": {
+            name: {k: entries[name][k] for k in sorted(entries[name])}
+            for name in sorted(entries)
+        },
+    }
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def _exceeds(base: float, cur: float, tol: Tolerance) -> bool:
+    if tol.direction == "shrink":
+        return cur < base * (1.0 - tol.rel) - tol.atol
+    return cur > base * (1.0 + tol.rel) + tol.atol
+
+
+def diff_entry(name: str, baseline: dict, current: dict,
+               tols: dict | None = None,
+               metrics: tuple | None = None) -> tuple[list, list]:
+    """Diff one entry's current metrics against its baseline. Returns
+    (findings, rows); rows are (metric, base, cur, status) for the human
+    rendering, status in {"ok", "FAIL", "improved", "new", "gone"}.
+    ``metrics`` restricts the comparison (the cross-backend case)."""
+    tols = tols or TOLERANCES
+    out, rows = [], []
+    keys = [k for k in tols if k in baseline or k in current]
+    if metrics is not None:
+        keys = [k for k in keys if k in metrics]
+    for k in keys:
+        base, cur = baseline.get(k), current.get(k)
+        if base is None:
+            rows.append((k, None, cur, "new"))
+            out.append(Finding(name, "ledger", (
+                f"metric {k}={cur} has no baseline in LEDGER.json — the "
+                "entry grew a new resource class; review it and run "
+                "--update-ledger"
+            )))
+            continue
+        if cur is None:
+            rows.append((k, base, None, "gone"))
+            out.append(Finding(name, "ledger", (
+                f"baseline metric {k}={base} is no longer measured — "
+                "stale budget row; run --update-ledger"
+            )))
+            continue
+        tol = tols[k]
+        if _exceeds(base, cur, tol):
+            rows.append((k, base, cur, "FAIL"))
+            verb = "shrank" if tol.direction == "shrink" else "grew"
+            kind = "hard budget" if tol.hard else "budget"
+            out.append(Finding(name, "ledger", (
+                f"{k} {verb} past its {kind}: {base} -> {cur} "
+                f"(rel={tol.rel}, atol={tol.atol})"
+            )))
+        elif _exceeds(cur, base, dataclasses.replace(
+                tol, direction="shrink" if tol.direction == "grow"
+                else "grow")):
+            # moved the GOOD way past tolerance: not a failure, but the
+            # baseline is stale enough to hide a future regression
+            rows.append((k, base, cur, "improved"))
+        else:
+            rows.append((k, base, cur, "ok"))
+    return out, rows
+
+
+def render_diff(per_entry_rows: dict) -> str:
+    """Human-readable ledger diff: one block per entry, one line per
+    metric, only entries with at least one non-"ok" row are expanded."""
+    lines = []
+    for name in sorted(per_entry_rows):
+        rows = per_entry_rows[name]
+        interesting = [r for r in rows if r[3] != "ok"]
+        if not interesting:
+            lines.append(f"{name}: ok ({len(rows)} metric(s))")
+            continue
+        lines.append(f"{name}:")
+        for metric, base, cur, status in rows:
+            def _fmt(v):
+                return "-" if v is None else f"{v:g}"
+            delta = ""
+            if isinstance(base, (int, float)) and isinstance(
+                    cur, (int, float)) and base:
+                delta = f" ({(cur - base) / base:+.1%})"
+            lines.append(
+                f"  {status:>8}  {metric}: {_fmt(base)} -> "
+                f"{_fmt(cur)}{delta}"
+            )
+    return "\n".join(lines) + "\n"

@@ -32,12 +32,28 @@ ad-hoc in whichever test first needed them (see ISSUE/README):
 - **host-boundary hygiene** — no host callbacks/infeed/outfeed inside a
   round-dispatch program: the round must be pure device code; a stray
   `debug_callback`/`pure_callback` forces a host sync per dispatch.
+- **carry stability** — the between-rounds carry is a FIXPOINT: every
+  carry leaf (the record's `carry_argnums`/`carry_argnames` legs) must
+  come back out of the program with the identical (shape, dtype) aval.
+  A silent uint16→int32 widen between rounds would quietly undo the
+  diet's savings while every value-level test stays green.
+- **donation escape** — the per-leaf refinement of the donation check:
+  parse the lowered @main signature and name exactly WHICH donated leaf
+  lost its `tf.aliasing_output` alias (the count check says how many;
+  this one says which column, which is what you need to fix it).
+- **paged roundtrip** — `page_out(full) -> (resident, paged)` and
+  `page_in(resident, paged) -> (full, paged)` must be aval-inverse:
+  each one's outputs match the other's inputs leaf for leaf, so the
+  host boundary can cycle the window forever without a reshape/upcast
+  creeping in (records declare the pairing via a `roundtrip` key).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import inspect
+import re
 import warnings
 
 import jax
@@ -248,6 +264,83 @@ def check_host_hygiene(name, jaxpr) -> list:
     return out
 
 
+def carry_leaves(rec) -> list:
+    """The record's between-rounds carry legs as flat leaves, in program
+    order (positional carry args first, then the carry kwargs in their
+    declared order). Falls back to the donation signature when a record
+    predates the explicit carry metadata — for the engine twins the
+    donated legs ARE the carry."""
+    argnums = rec.get("carry_argnums")
+    argnames = rec.get("carry_argnames")
+    if argnums is None and argnames is None:
+        argnums = rec.get("donate_argnums", ())
+        argnames = rec.get("donate_argnames", ())
+    legs = [rec["args"][i] for i in (argnums or ())]
+    kw = rec.get("kwargs", {})
+    legs += [kw.get(k) for k in (argnames or ())]
+    return jax.tree.leaves(legs)
+
+
+def check_carry_stability(name, jaxpr, rec) -> list:
+    """Carry-in avals must equal the program's leading out avals leaf for
+    leaf — the fused round, the rebase jits and the sharded stepper all
+    return their carry first, in argument order, so a positional prefix
+    compare proves the fixpoint."""
+    ins = [(tuple(leaf.shape), str(leaf.dtype))
+           for leaf in carry_leaves(rec)]
+    if not ins:
+        return []
+    outs = [(tuple(a.shape), str(a.dtype)) for a in jaxpr.out_avals]
+    if len(outs) < len(ins):
+        return [Finding(name, "carry", (
+            f"program returns {len(outs)} leaves but the carry has "
+            f"{len(ins)} — the round no longer round-trips its own carry"
+        ))]
+    out = []
+    for idx, (want, got) in enumerate(zip(ins, outs)):
+        if want != got:
+            out.append(Finding(name, "carry", (
+                f"carry leaf {idx} enters as {want[1]}{list(want[0])} but "
+                f"exits as {got[1]}{list(got[0])} — the carry fixpoint is "
+                "broken (a widen/reshape rides between rounds)"
+            )))
+    return out
+
+
+def check_paged_roundtrip(rec_a, rec_b) -> list:
+    """The two host-boundary programs must be aval-inverses: each one's
+    out avals equal the other's example-arg avals positionally (both
+    sides are (state, paged) pytrees of the same classes, so flatten
+    order lines up by construction)."""
+    def arg_avals(rec):
+        return [(tuple(l.shape), str(l.dtype))
+                for l in jax.tree.leaves(rec["args"])]
+
+    def out_avals(rec):
+        return [(tuple(a.shape), str(a.dtype))
+                for a in trace_entry(rec).out_avals]
+
+    out = []
+    for src, dst in ((rec_a, rec_b), (rec_b, rec_a)):
+        name = f"{src['name']}->{dst['name']}"
+        got, want = out_avals(src), arg_avals(dst)
+        if len(got) != len(want):
+            out.append(Finding(name, "roundtrip", (
+                f"{src['name']} returns {len(got)} leaves but "
+                f"{dst['name']} consumes {len(want)} — the paged "
+                "roundtrip no longer closes"
+            )))
+            continue
+        for idx, (g, w) in enumerate(zip(got, want)):
+            if g != w:
+                out.append(Finding(name, "roundtrip", (
+                    f"leaf {idx}: {src['name']} emits {g[1]}{list(g[0])} "
+                    f"but {dst['name']} expects {w[1]}{list(w[0])} — a "
+                    "reshape/upcast crept into the paged window cycle"
+                )))
+    return out
+
+
 # --------------------------------------------------------------------------
 # donation (lowered-HLO level)
 
@@ -283,11 +376,14 @@ def donated_leaf_count(rec) -> int:
     return len(jax.tree.leaves(donated))
 
 
-def check_donation(name, rec) -> list:
+def check_donation(name, rec, lowered=None) -> list:
     """Donating twin: every donated carry leaf aliases an output (count
     `tf.aliasing_output`/`jax.buffer_donor` markers, catch jax's
-    unusable-donation warning). Copying twin: aliases nothing."""
-    text, dropped = lower_text_and_warnings(rec)
+    unusable-donation warning). Copying twin: aliases nothing.
+    ``lowered`` lets the caller share one (text, dropped) lowering with
+    the escape check."""
+    text, dropped = lowered if lowered is not None \
+        else lower_text_and_warnings(rec)
     aliased = text.count("tf.aliasing_output") + text.count("jax.buffer_donor")
     out = []
     if rec["donate"]:
@@ -313,6 +409,106 @@ def check_donation(name, rec) -> list:
 
 
 # --------------------------------------------------------------------------
+# donation escape (per-leaf alias accounting in the lowered signature)
+
+_MAIN_ARG_RE = re.compile(r"%arg(\d+):")
+
+
+def _main_arg_attrs(text: str) -> dict | None:
+    """{flat arg position: signature span text} for the lowered
+    program's public @main signature; None when the signature can't be
+    found. Each span runs from this ``%argN:`` to the next — attr dicts
+    can nest braces inside quoted strings (mhlo.sharding does), so
+    span-slicing beats brace-matching."""
+    m = re.search(r"func\.func public @main\((.*?)\)\s*->", text, re.S)
+    if m is None:
+        return None
+    sig = m.group(1)
+    marks = list(_MAIN_ARG_RE.finditer(sig))
+    out = {}
+    for i, am in enumerate(marks):
+        end = marks[i + 1].start() if i + 1 < len(marks) else len(sig)
+        out[int(am.group(1))] = sig[am.start():end]
+    return out
+
+
+def flat_arg_names(rec) -> tuple[list, set]:
+    """(per-flat-leaf human names, donated flat positions) for a record's
+    example arguments, in jax's flatten order: positional args in order,
+    then kwargs sorted by key (how pjit flattens (args, kwargs))."""
+    try:
+        params = list(inspect.signature(rec["fn"]).parameters)
+    except (TypeError, ValueError):  # pragma: no cover - exotic callables
+        params = []
+    names, donated = [], set()
+    pos = 0
+    for i, a in enumerate(rec["args"]):
+        prefix = params[i] if i < len(params) else f"arg{i}"
+        paths = jax.tree_util.tree_flatten_with_path(a)[0]
+        for path, _ in paths:
+            names.append(prefix + jax.tree_util.keystr(path))
+        if i in rec.get("donate_argnums", ()):
+            donated.update(range(pos, pos + len(paths)))
+        pos += len(paths)
+    kw = rec.get("kwargs", {})
+    for k in sorted(kw):
+        paths = jax.tree_util.tree_flatten_with_path(kw[k])[0]
+        for path, _ in paths:
+            names.append(k + jax.tree_util.keystr(path))
+        if k in rec.get("donate_argnames", ()):
+            donated.update(range(pos, pos + len(paths)))
+        pos += len(paths)
+    return names, donated
+
+
+def check_donation_escape(name, rec, text: str | None = None) -> list:
+    """Per-leaf donation escape analysis: every donated flat argument of
+    the lowered program must carry an input-output alias attribute. A
+    leaf without one ESCAPED donation — its buffer is both donated (the
+    host must not read it after dispatch) and not reused (HBM doubles),
+    the worst of both. Names the leaf via the record's example pytrees
+    so the finding points at a column, not a position."""
+    if text is None:
+        text, _ = lower_text_and_warnings(rec)
+    names, donated = flat_arg_names(rec)
+    if not donated:
+        return []
+    attrs = _main_arg_attrs(text)
+    if attrs is None:  # pragma: no cover - lowering layout drift
+        return [Finding(name, "escape", (
+            "could not locate the public @main signature in the lowered "
+            "program — escape analysis can't run (lowering layout drift)"
+        ))]
+
+    def aliased(a: str) -> bool:
+        return "tf.aliasing_output" in a or "jax.buffer_donor" in a
+
+    if len(attrs) != len(names):
+        # the lowering pruned unused args: flat positions shifted, so
+        # degrade to count-level accounting rather than misname leaves
+        n_aliased = sum(1 for a in attrs.values() if aliased(a))
+        missing = len(donated) - n_aliased
+        if missing > 0:
+            return [Finding(name, "escape", (
+                f"{missing} donated leaf/leaves have no input-output "
+                "alias in the lowered program (argument pruning hides "
+                "which) — donated buffers escape"
+            ))]
+        return []
+    out = []
+    for i in sorted(donated):
+        if not aliased(attrs.get(i, "")):
+            leaf = names[i] if i < len(names) else f"flat arg {i}"
+            out.append(Finding(name, "escape", (
+                f"donated leaf '{leaf}' (flat arg {i}) has no "
+                "input-output alias in the lowered program — the donated "
+                "buffer escapes: HBM doubles and any host view of it "
+                "dangles after dispatch"
+            )))
+    return out
+
+
+# --------------------------------------------------------------------------
 # one record end-to-end
 
 
@@ -331,12 +527,51 @@ def audit_record(rec, *, expect_on=None, diet: bool = False) -> list:
     if want("elision") and expect_on:
         out += check_elision(name, deltas, expect_on)
     if want("dtype") and diet:
-        carry = [rec["args"][0], rec["args"][1]]
+        # dtype_carry overrides the default (state, fab) pair when the
+        # program's in-flight storage avals legitimately differ from the
+        # between-dispatch carry (the paged profile: log columns ride the
+        # scan at the paged-in full-window shape)
+        carry = rec.get("dtype_carry") or [rec["args"][0], rec["args"][1]]
         out += check_dtype_discipline(name, jaxpr, carry)
     if want("capture"):
         out += check_constant_capture(name, jaxpr)
     if want("hygiene"):
         out += check_host_hygiene(name, jaxpr)
-    if want("donation") and rec.get("jit") is not None:
-        out += check_donation(name, rec)
+    if want("carry"):
+        out += check_carry_stability(name, jaxpr, rec)
+    if rec.get("jit") is not None and (want("donation") or want("escape")):
+        lowered = lower_text_and_warnings(rec)
+        if want("donation"):
+            out += check_donation(name, rec, lowered=lowered)
+        if want("escape") and rec.get("donate"):
+            out += check_donation_escape(name, rec, text=lowered[0])
     return out
+
+
+def audit_entries(pairs) -> tuple[list, list]:
+    """Audit every (entry, record) pair plus the cross-record proofs
+    (the paged roundtrip pairing records declare via ``roundtrip``).
+    Returns (findings, per-entry report rows) — the shared driver for
+    ``python -m raft_tpu.analysis`` and the all-green matrix test."""
+    findings, rows = [], []
+    recs = {rec["name"]: rec for _, rec in pairs}
+    for entry, rec in pairs:
+        fs = audit_record(rec, expect_on=entry.expect_on, diet=entry.diet)
+        findings += fs
+        rows.append({
+            "name": entry.name,
+            "profile": entry.profile,
+            "compile_budget": entry.compile_budget,
+            "findings": len(fs),
+        })
+    seen = set()
+    for _, rec in pairs:
+        peer = rec.get("roundtrip")
+        if not peer or peer not in recs:
+            continue
+        key = frozenset((rec["name"], peer))
+        if key in seen:
+            continue
+        seen.add(key)
+        findings += check_paged_roundtrip(rec, recs[peer])
+    return findings, rows

@@ -1,0 +1,258 @@
+"""Compiled-program resource ledger: extract and gate the capacity math.
+
+For every registry entry point, ``jit.lower(args).compile()`` (AOT — no
+dispatch, no execution) and pull the compiler's own arithmetic:
+
+- ``cost_analysis()``  -> flops, bytes accessed
+- ``memory_analysis()`` -> argument/output/temp/alias bytes, code size
+
+plus the one number XLA cannot know — the between-rounds carry bytes —
+from the record's carry-leg avals. Everything is normalized to per-lane
+(flow metrics additionally per-round) so the budget is shape-invariant:
+the 12-lane CI geometry and a 3M-lane chip share one LEDGER.json row.
+
+``run_ledger`` diffs the current tree against the checked-in baseline
+(budgets.diff_entry owns the tolerance rules) and returns findings the
+``python -m raft_tpu.analysis --ledger`` gate turns into a non-zero
+exit; ``update=True`` re-baselines and reports the old->new drift
+instead. The bench-facing helpers at the bottom are the ONE place
+bytes-moved is computed from a lowering — benches/pallas_ab.py routes
+through them so the bench and the gate can never disagree.
+"""
+
+from __future__ import annotations
+
+from raft_tpu.analysis import budgets
+from raft_tpu.analysis.jaxpr_audit import Finding, carry_leaves
+
+__all__ = [
+    "cost_metrics",
+    "memory_metrics",
+    "entry_metrics",
+    "run_ledger",
+    "bytes_accessed",
+    "round_bytes_probe",
+]
+
+
+# --------------------------------------------------------------------------
+# extraction from one compiled program
+
+
+def lower_entry(rec):
+    """AOT-lower a registry record exactly the way the engine dispatches
+    it: the jit twin, the example args, static+plane kwargs."""
+    jit = rec["jit"]
+    kwargs = {**rec.get("static", {}), **rec.get("kwargs", {})}
+    return jit.lower(*rec["args"], **kwargs)
+
+
+def cost_metrics(compiled) -> dict:
+    """Normalize ``compiled.cost_analysis()`` across its backend quirks
+    (CPU returns a one-element list of dicts; some backends return the
+    dict bare, some nothing)."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not cost:
+        return {}
+    out = {}
+    if cost.get("flops") is not None:
+        out["flops"] = float(cost["flops"])
+    if cost.get("bytes accessed") is not None:
+        out["bytes_accessed"] = float(cost["bytes accessed"])
+    return out
+
+
+def memory_metrics(compiled) -> dict:
+    """``compiled.memory_analysis()`` -> plain dict (CompiledMemoryStats
+    fields); empty when the backend doesn't expose it."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if mem is None:
+        return {}
+    fields = (
+        ("argument_size_in_bytes", "arg_bytes"),
+        ("output_size_in_bytes", "out_bytes"),
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("alias_size_in_bytes", "alias_bytes"),
+        ("generated_code_size_in_bytes", "generated_code_bytes"),
+    )
+    out = {}
+    for attr, key in fields:
+        v = getattr(mem, attr, None)
+        if v is not None:
+            out[key] = float(v)
+    return out
+
+
+def carry_nbytes(rec) -> float | None:
+    """Bytes of the between-rounds carry (the HBM residency the entry
+    claims), from the carry-leg avals — None when the record declares no
+    carry (pure kernels like quorum)."""
+    leaves = carry_leaves(rec)
+    if not leaves:
+        return None
+    return float(sum(leaf.size * leaf.dtype.itemsize for leaf in leaves))
+
+
+def entry_metrics(rec) -> dict:
+    """The ledger row for one record: every metric normalized per lane
+    (flow metrics per round per lane), rounded so LEDGER.json diffs stay
+    readable."""
+    lanes = rec.get("lanes") or 1
+    rounds = rec.get("rounds") or rec.get("static", {}).get("n_rounds") or 1
+    compiled = lower_entry(rec).compile()
+    cost = cost_metrics(compiled)
+    mem = memory_metrics(compiled)
+    out = {}
+    cb = carry_nbytes(rec)
+    if cb is not None:
+        out["carry_bytes_per_lane"] = cb / lanes
+    if "bytes_accessed" in cost:
+        out["bytes_moved_per_round_per_lane"] = (
+            cost["bytes_accessed"] / rounds / lanes
+        )
+    if "flops" in cost:
+        out["flops_per_round_per_lane"] = cost["flops"] / rounds / lanes
+    for src, dst in (
+        ("arg_bytes", "arg_bytes_per_lane"),
+        ("out_bytes", "out_bytes_per_lane"),
+        ("temp_bytes", "temp_bytes_per_lane"),
+        ("alias_bytes", "alias_bytes_per_lane"),
+    ):
+        if src in mem:
+            out[dst] = mem[src] / lanes
+    if "generated_code_bytes" in mem:
+        out["generated_code_bytes"] = mem["generated_code_bytes"]
+    return {k: round(v, 3) for k, v in out.items()}
+
+
+# --------------------------------------------------------------------------
+# the gate
+
+
+def _tol_scale() -> float:
+    from raft_tpu import config
+
+    return config.env_float("RAFT_TPU_LEDGER_TOL", 1.0)
+
+
+def run_ledger(pairs=None, *, update: bool = False, path: str | None = None,
+               tol_scale: float | None = None) -> tuple[list, dict]:
+    """Measure every registry entry and diff against LEDGER.json.
+
+    Returns (findings, report). ``update=True`` writes the new baseline
+    instead of failing, still reporting the old->new rows so the caller
+    can print a human-readable re-baseline diff. ``pairs`` lets the gate
+    reuse records already built by the audit step (one build, two
+    passes)."""
+    import jax
+
+    if pairs is None:
+        from raft_tpu.analysis.registry import build_records
+
+        pairs = build_records()
+    path = path or budgets.default_ledger_path()
+    scale = _tol_scale() if tol_scale is None else tol_scale
+    tols = budgets.scaled_tolerances(scale)
+    meta = {"backend": jax.default_backend(), "jax": jax.__version__}
+
+    current = {}
+    for entry, rec in pairs:
+        current[entry.name] = entry_metrics(rec)
+
+    report = {
+        "path": path,
+        "meta": meta,
+        "entries": sorted(current),
+        "tol_scale": scale,
+        "updated": update,
+        "diff": "",
+    }
+    findings: list = []
+    per_entry_rows: dict = {}
+
+    baseline = budgets.load_ledger(path)
+    if update:
+        old = (baseline or {}).get("entries", {})
+        for name, cur in current.items():
+            _, rows = budgets.diff_entry(name, old.get(name, {}), cur,
+                                         tols=tols)
+            per_entry_rows[name] = rows
+        budgets.save_ledger(path, meta, current)
+        report["diff"] = budgets.render_diff(per_entry_rows)
+        return [], report
+
+    if baseline is None:
+        findings.append(Finding("LEDGER.json", "ledger", (
+            f"no baseline at {path} — run "
+            "`python -m raft_tpu.analysis --update-ledger` and check the "
+            "result in"
+        )))
+        report["diff"] = "(no baseline)\n"
+        return findings, report
+
+    metrics = None
+    if baseline.get("meta", {}).get("backend") != meta["backend"]:
+        # a cpu baseline says nothing about a tpu cost model; the
+        # aval-determined metrics still transfer
+        metrics = budgets.AVAL_METRICS
+        report["cross_backend"] = True
+
+    base_entries = baseline.get("entries", {})
+    for name, cur in current.items():
+        if name not in base_entries:
+            findings.append(Finding(name, "ledger", (
+                "entry has no LEDGER.json baseline — new entry point; "
+                "run --update-ledger to budget it"
+            )))
+            per_entry_rows[name] = [
+                (k, None, v, "new") for k, v in sorted(cur.items())
+            ]
+            continue
+        fs, rows = budgets.diff_entry(
+            name, base_entries[name], cur, tols=tols, metrics=metrics
+        )
+        findings += fs
+        per_entry_rows[name] = rows
+    for name in sorted(set(base_entries) - set(current)):
+        findings.append(Finding(name, "ledger", (
+            "LEDGER.json budgets an entry the registry no longer builds "
+            "— stale baseline row; run --update-ledger"
+        )))
+    report["diff"] = budgets.render_diff(per_entry_rows)
+    return findings, report
+
+
+# --------------------------------------------------------------------------
+# bench-facing helpers (the one shared bytes-moved computation)
+
+
+def bytes_accessed(compiled) -> float | None:
+    """Total bytes accessed per dispatch from XLA cost analysis; None on
+    backends without a cost model."""
+    return cost_metrics(compiled).get("bytes_accessed")
+
+
+def round_bytes_probe(cluster, rounds: int, **overrides) -> float | None:
+    """Bytes accessed PER ROUND of a cluster's compiled round program —
+    the exact computation the ledger gate budgets, exported so benches
+    (benches/pallas_ab.py) report the same number the gate enforces.
+    Lowers the copying twin (donation doesn't change bytes accessed and
+    the nodonate lowering never warns about example-arg reuse)."""
+    try:
+        lowered = cluster.lower_round_program(
+            rounds, donate=False, **overrides
+        )
+        return_val = bytes_accessed(lowered.compile())
+    except Exception:
+        return None
+    if return_val is None:
+        return None
+    return return_val / rounds

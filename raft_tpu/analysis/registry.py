@@ -100,6 +100,34 @@ PROFILES = {
         RAFT_TPU_PAGE_ENTRIES=None,
         RAFT_TPU_POOL_PAGES=None,
     ),
+    # diet + paged composed: the packed carry's narrow columns must
+    # survive the scan carry AND the resident-window split at once — the
+    # maximal byte-savings configuration the capacity claims quote
+    "diet_paged": dict(
+        _BASE,
+        RAFT_TPU_METRICS="1",
+        RAFT_TPU_CHAOS="0",
+        RAFT_TPU_TRACELOG="0",
+        RAFT_TPU_DIET="1",
+        RAFT_TPU_DONATE="1",
+        RAFT_TPU_PAGED="1",
+        RAFT_TPU_PAGE_WINDOW=None,
+        RAFT_TPU_PAGE_ENTRIES=None,
+        RAFT_TPU_POOL_PAGES=None,
+    ),
+    # the serving frontend's profile: egress is mandatory (commit
+    # discovery rides the DeltaBundle sink), diet on, chaos/trace off —
+    # the production loop ROADMAP item 3 ships with
+    "serve": dict(
+        _BASE,
+        RAFT_TPU_METRICS="1",
+        RAFT_TPU_CHAOS="0",
+        RAFT_TPU_TRACELOG="0",
+        RAFT_TPU_DIET="1",
+        RAFT_TPU_DONATE="1",
+        RAFT_TPU_PAGED="0",
+        RAFT_TPU_EGRESS="1",
+    ),
 }
 
 
@@ -148,6 +176,25 @@ def _round_pallas():
     return _cluster("pallas", rounds_per_call=2).audit_programs()
 
 
+def _round_diet_paged():
+    import jax
+
+    from raft_tpu.ops import paged as pgmod
+
+    cl = _cluster("xla")
+    recs = cl.audit_programs()
+    # inside the fused round the packed log columns legitimately ride at
+    # the paged-in FULL-window shape (page_in at entry, page_out at
+    # exit); eval_shape gives those avals without dispatching, and the
+    # dtype-discipline check runs against them instead of the resident
+    # carry — dtype must still survive, only the window dim widens
+    full, _ = jax.eval_shape(pgmod.page_in, cl.state, cl.paged)
+    for r in recs:
+        r["name"] = r["name"] + ".diet_paged"
+        r["dtype_carry"] = [full, r["args"][1]]
+    return recs
+
+
 def _sharded_step():
     import jax
 
@@ -156,6 +203,26 @@ def _sharded_step():
     if len(jax.devices()) < 2:  # pragma: no cover - single-device hosts
         return []
     return ShardedFusedCluster(n_groups=16, n_voters=3).audit_programs()
+
+
+def _mesh_step():
+    import jax
+
+    from raft_tpu.parallel.mesh import MeshBlockedCluster
+
+    if len(jax.devices()) < 2:  # pragma: no cover - single-device hosts
+        return []
+    # two blocks of 16 groups over the 8-device host mesh — the smallest
+    # geometry where the mesh driver is more than one sharded cluster
+    return MeshBlockedCluster(
+        n_groups=32, n_voters=3, block_groups=16
+    ).audit_programs()
+
+
+def _serve_round():
+    from raft_tpu.serve.loop import ServeLoop
+
+    return ServeLoop(_cluster("xla")).audit_programs()
 
 
 def _quorum_operands():
@@ -189,6 +256,8 @@ def _quorum_pallas():
         # the pallas-specific invariants (constant capture, hygiene)
         # still apply
         checks=("capture", "hygiene", "donation"),
+        lanes=match.shape[0],
+        rounds=1,
     )]
 
 
@@ -209,6 +278,8 @@ def _quorum_xla():
         donate_argnums=(),
         donate_argnames=(),
         checks=("capture", "hygiene", "donation"),
+        lanes=match.shape[0],
+        rounds=1,
     )]
 
 
@@ -243,6 +314,7 @@ def _egress_entries():
         kwargs={}, static={}, donate=False,
         donate_argnums=(), donate_argnames=(),
         checks=("capture", "hygiene", "donation"),
+        lanes=cl.state.term.shape[0], rounds=1,
     )
     return [
         dict(common, name="egress.ready_bundle", fn=rm.ready_bundle,
@@ -269,7 +341,13 @@ def _rebase_entries():
     delta = jnp.asarray(np.zeros((n,), np.int32))
     common = dict(
         kwargs={}, static={},
-        checks=("capture", "hygiene", "donation"),
+        # the rebase jits are the PR 2 donate-then-read bug class's
+        # original home: carry stability proves the rebased columns
+        # come back with their exact avals, escape names any donated
+        # leaf that loses its in-place alias
+        checks=("capture", "hygiene", "donation", "carry", "escape"),
+        lanes=n, rounds=1,
+        carry_argnums=(0,), carry_argnames=(),
     )
     return [
         dict(common, name="rebase.indexes", fn=fmod._rebase_indexes,
@@ -295,17 +373,7 @@ def _paged_entries():
     with env_profile({"RAFT_TPU_PAGED": "0"}):
         full = _cluster("xla")
     paged0 = pgmod.init_paged(cl._page_plan, full.state)
-    common = dict(
-        kwargs={}, static={}, donate=False,
-        donate_argnums=(), donate_argnames=(),
-        checks=("capture", "hygiene", "donation"),
-    )
-    return [
-        dict(common, name="paged.page_in", fn=pgmod.page_in,
-             jit=pgmod.page_in_host, args=(cl.state, cl.paged)),
-        dict(common, name="paged.page_out", fn=pgmod.page_out,
-             jit=pgmod.page_out_host, args=(full.state, paged0)),
-    ]
+    return pgmod.audit_records(cl.state, cl.paged, full.state, paged0)
 
 
 _ALL_ON = {"metrics": True, "chaos": True, "trace": True, "paged": False}
@@ -330,6 +398,19 @@ ENTRIES = (
     Entry("rebase.fabric", "planes_off", _rebase_entries, compile_budget=1),
     Entry("paged.page_in", "paged", _paged_entries, compile_budget=1),
     Entry("paged.page_out", "paged", _paged_entries, compile_budget=1),
+    # the shipped drivers the original manifest never audited: the
+    # mesh-blocked multi-chip driver, the ServeLoop round program, and
+    # the diet+paged composed profile the capacity claims quote
+    Entry("mesh.step.xla", "planes_on", _mesh_step, compile_budget=1),
+    Entry("serve.round", "serve", _serve_round, compile_budget=1,
+          expect_on={"metrics": True, "chaos": False, "trace": False,
+                     "paged": False},
+          diet=True),
+    Entry("round.xla.diet_paged", "diet_paged", _round_diet_paged,
+          compile_budget=1,
+          expect_on={"metrics": True, "chaos": False, "trace": False,
+                     "paged": True},
+          diet=True),
 )
 
 

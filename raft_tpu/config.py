@@ -66,6 +66,19 @@ def env_int(name: str, default: int = 0) -> int:
     except ValueError:
         raise ValueError(f"{name} must be an integer, got {raw!r}") from None
 
+
+def env_float(name: str, default: float = 0.0) -> float:
+    """Float knob (tolerance multipliers and the like): unset/empty ->
+    default; non-numeric raises with the knob name, same contract as
+    env_int."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a float, got {raw!r}") from None
+
 # Diet-v2 stores rebased index columns as uint16; the post-rebase index
 # space is a few windows plus the between-rebase growth budget, so the
 # window itself must stay far under 2^16. Named here so the validation

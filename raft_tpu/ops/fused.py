@@ -2078,16 +2078,10 @@ class FusedCluster:
             if self._donate:
                 self._trace_pending = trace
 
-    def audit_programs(self, rounds: int = 2):
-        """Enumerate this cluster's round-dispatch entry points as audit
-        records for the static program auditor (raft_tpu/analysis). Each
-        record carries the unjitted fn (for make_jaxpr), the jit twin the
-        engine actually dispatches (for lowered-HLO donation checks), the
-        live carry pytrees as example arguments, and the donation
-        signature. Nothing here dispatches a round: the auditor only
-        traces and lowers."""
-        from raft_tpu.ops import pallas_round as plr
-
+    def _round_static(self, rounds: int, **overrides) -> dict:
+        """The static-kwarg set the round program is specialized on —
+        shared by audit_programs and lower_round_program so the audited,
+        budgeted, and benched lowerings can never drift apart."""
         static = dict(
             v=self.v,
             n_rounds=rounds,
@@ -2096,6 +2090,20 @@ class FusedCluster:
             auto_compact_lag=None,
             ops_first_round_only=True,
         )
+        static.update(overrides)
+        return static
+
+    def lower_round_program(self, rounds: int = 1, *,
+                            donate: bool | None = None, **overrides):
+        """AOT-lower (never compile-and-dispatch) the exact round program
+        run() dispatches for the current engine against the live carry —
+        the shared entry point for the resource ledger's cost/memory
+        extraction and the benches' bytes-moved probes. ``overrides``
+        adjust the static kwargs (auto_propose, auto_compact_lag, ...)."""
+        from raft_tpu.ops import pallas_round as plr
+
+        donate = self._donate if donate is None else donate
+        static = self._round_static(rounds, **overrides)
         kwargs = dict(
             metrics=self.metrics,
             chaos=self.chaos,
@@ -2104,18 +2112,54 @@ class FusedCluster:
         )
         args = (self.state, self.fab, self._no_ops, self.mute)
         if self.engine == "pallas":
+            if self._pallas_interpret is None:
+                self._pallas_interpret = plr.default_interpret()
+            return plr.round_jit_twin(donate).lower(
+                *args,
+                tile_lanes=self._resolve_pallas_tile(),
+                rounds_per_call=self._resolve_pallas_rounds(),
+                interpret=self._pallas_interpret,
+                **static, **kwargs,
+            )
+        jit = _fused_rounds_jit if donate else _fused_rounds_nodonate_jit
+        return jit.lower(*args, **static, **kwargs)
+
+    def audit_programs(self, rounds: int = 2):
+        """Enumerate this cluster's round-dispatch entry points as audit
+        records for the static program auditor (raft_tpu/analysis). Each
+        record carries the unjitted fn (for make_jaxpr), the jit twin the
+        engine actually dispatches (for lowered-HLO donation checks), the
+        live carry pytrees as example arguments, the donation signature,
+        and the ledger metadata (lanes / rounds for per-lane-per-round
+        normalization, the carry legs for carry-bytes accounting and the
+        carry-stability fixpoint proof). Nothing here dispatches a round:
+        the auditor only traces and lowers."""
+        from raft_tpu.ops import pallas_round as plr
+
+        static = self._round_static(rounds)
+        kwargs = dict(
+            metrics=self.metrics,
+            chaos=self.chaos,
+            trace=self.trace,
+            paged=self.paged,
+        )
+        args = (self.state, self.fab, self._no_ops, self.mute)
+        meta = dict(
+            lanes=self.shape.n_lanes,
+            rounds=rounds,
+            carry_argnums=(0, 1),
+            carry_argnames=("metrics", "chaos", "trace", "paged"),
+        )
+        if self.engine == "pallas":
             rpc = self._resolve_pallas_rounds()
             tile = self._resolve_pallas_tile()
             if self._pallas_interpret is None:
                 self._pallas_interpret = plr.default_interpret()
             return [dict(
+                meta,
                 name="round.pallas",
                 fn=plr.pallas_rounds,
-                jit=(
-                    plr._pallas_rounds_jit
-                    if self._donate
-                    else plr._pallas_rounds_nodonate_jit
-                ),
+                jit=plr.round_jit_twin(self._donate),
                 args=args,
                 kwargs=kwargs,
                 static=dict(
@@ -2129,6 +2173,7 @@ class FusedCluster:
                 donate_argnames=("metrics", "chaos", "trace", "paged"),
             )]
         return [dict(
+            meta,
             name="round.xla",
             fn=fused_rounds,
             jit=(

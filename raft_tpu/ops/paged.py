@@ -369,6 +369,34 @@ def split_state(state: RaftState, plan: PagePlan, segs: int = 1):
     return page_out_host(state, init_paged(plan, state), segs)
 
 
+def audit_records(resident_state: RaftState, paged: PagedLog,
+                  full_state: RaftState, paged0: PagedLog) -> list:
+    """Audit records for the two host-boundary programs (raft_tpu/
+    analysis): page_in against the live (resident, paged) pair and
+    page_out against a full-window carry with a fresh all-resident
+    sidecar. The mutual ``roundtrip`` keys declare the aval-inverse
+    pairing the auditor proves (page_out's outputs == page_in's inputs
+    and vice versa), and the carry metadata budgets the TOTAL paged
+    residency per lane — resident columns plus sidecar — in the ledger.
+    Nothing here dispatches: records are traced and lowered only."""
+    n = resident_state.term.shape[0]
+    common = dict(
+        kwargs={}, static={}, donate=False,
+        donate_argnums=(), donate_argnames=(),
+        checks=("capture", "hygiene", "donation"),
+        lanes=n, rounds=1,
+        carry_argnums=(0, 1), carry_argnames=(),
+    )
+    return [
+        dict(common, name="paged.page_in", fn=page_in,
+             jit=page_in_host, args=(resident_state, paged),
+             roundtrip="paged.page_out"),
+        dict(common, name="paged.page_out", fn=page_out,
+             jit=page_out_host, args=(full_state, paged0),
+             roundtrip="paged.page_in"),
+    ]
+
+
 def paged_stats(paged: PagedLog) -> dict:
     """Host occupancy snapshot (forces a device sync — call lazily from
     metrics_snapshot / benches, never per dispatch)."""

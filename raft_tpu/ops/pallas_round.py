@@ -819,3 +819,12 @@ _pallas_rounds_jit = jax.jit(
 _pallas_rounds_nodonate_jit = jax.jit(
     pallas_rounds, static_argnames=_PALLAS_STATIC
 )
+
+
+def round_jit_twin(donate: bool):
+    """The jitted round program for one donation mode — the single
+    selection point the static auditor, the resource ledger and the
+    bench lowerings share, so a twin swap can never happen in one of
+    them only (the dispatch path in ops/fused.py keeps its explicit
+    pair: the donating twin rides the _no_persistent_cache fence)."""
+    return _pallas_rounds_jit if donate else _pallas_rounds_nodonate_jit

@@ -134,10 +134,12 @@ class MeshBlockedCluster:
     def audit_programs(self, rounds: int = 2):
         """Audit records for the mesh driver (raft_tpu/analysis): every
         block compiles the identical sharded stepper (same geometry,
-        same plane set), so the first block's record covers the mesh."""
+        same plane set), so the first block's record covers the mesh.
+        Records are named ``mesh.step.<engine>`` — the mesh drives one
+        step program per block, whatever engine the block resolved."""
         recs = self.blocks[0].audit_programs(rounds)
         for r in recs:
-            r["name"] = "mesh." + r["name"]
+            r["name"] = r["name"].replace("sharded.step.", "mesh.step.")
         return recs
 
     def prepare_ops(self, ops: LocalOps) -> list[LocalOps]:

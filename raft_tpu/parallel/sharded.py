@@ -722,6 +722,11 @@ class ShardedFusedCluster:
             if self._donate
             else ()
         )
+        # the stepper returns its carry first in argument order (state,
+        # fab, *extras) regardless of donation mode — declare the carry
+        # legs explicitly so the carry-stability proof and the ledger's
+        # carry-bytes accounting also cover the copying twin
+        carry_argnums = (0, 1) + tuple(range(4, 4 + len(extras)))
         return [dict(
             name=f"sharded.step.{engine}",
             fn=jit,
@@ -735,6 +740,10 @@ class ShardedFusedCluster:
             donate=self._donate,
             donate_argnums=donate_argnums,
             donate_argnames=(),
+            lanes=self.inner.shape.n_lanes,
+            rounds=rounds,
+            carry_argnums=carry_argnums,
+            carry_argnames=(),
         )]
 
     def run(self, rounds: int = 1, ops=None, do_tick: bool = True,

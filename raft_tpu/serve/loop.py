@@ -33,8 +33,6 @@ from __future__ import annotations
 
 import contextlib
 
-import numpy as np
-
 from raft_tpu.metrics.host import (
     HostCounters,
     HostHistogram,
@@ -215,6 +213,25 @@ class ServeLoop:
             self._trace_arg = (
                 self.traces if self.blocked else self.traces[0]
             )
+
+    def audit_programs(self, rounds: int = 1):
+        """Audit records for the serving frontend (raft_tpu/analysis).
+        The loop's device-side program IS the cluster round program it
+        drives — `_step_one` dispatches `cluster.run(1, ops, egress=...,
+        trace=...)` and the egress/trace streams are host-side consumers,
+        not program inputs — so the record is the cluster's own, renamed
+        and pinned to the loop's one-round cadence. Blocked drivers that
+        don't export audit records themselves (BlockedFusedCluster)
+        delegate to their first block: every block runs the identical
+        program."""
+        target = self.cluster
+        if not callable(getattr(target, "audit_programs", None)):
+            target = target.blocks[0]
+        recs = target.audit_programs(rounds)
+        for r in recs:
+            r["name"] = "serve.round"
+            r["rounds"] = rounds
+        return recs
 
     # -- bootstrap ---------------------------------------------------------
 

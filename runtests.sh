@@ -42,13 +42,17 @@ run_bench() {
 static_gate() {
   # static analysis gate (raft_tpu/analysis): repo lint + jaxpr/HLO
   # invariant audit over every manifest entry point + the recompile
-  # sentinel, in its own process BEFORE any test chunk — a broken
-  # compile-time contract fails in ~a minute instead of surfacing as a
-  # flaky assert deep in the suite. Emits ANALYSIS.json next to the
-  # bench JSONs.
+  # sentinel + the compiled-program resource ledger (--ledger:
+  # AOT-compiles every entry and diffs per-lane HBM/FLOP budgets
+  # against LEDGER.json; RAFT_TPU_LEDGER_PATH/_TOL tune it, and
+  # `python -m raft_tpu.analysis --update-ledger` re-baselines after an
+  # intentional change), in its own process BEFORE any test chunk — a
+  # broken compile-time contract fails in ~a minute instead of
+  # surfacing as a flaky assert deep in the suite. Emits ANALYSIS.json
+  # and LEDGER_DIFF.txt next to the bench JSONs.
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
-    python -m raft_tpu.analysis --json ANALYSIS.json
+    python -m raft_tpu.analysis --json ANALYSIS.json --ledger
 }
 
 smokes() {

@@ -1,7 +1,10 @@
 """Static program auditor (raft_tpu/analysis/): seeded-violation fixtures
 prove each check can actually fail, the all-green matrix proves the live
-registry passes every check, and the lint rules are exercised against
-both synthetic trees and the real repo.
+registry passes every check, the lint rules are exercised against both
+synthetic trees and the real repo, and the resource-ledger fixtures
+(widened diet column, gratuitous temp, dropped donation alias) each trip
+exactly their budget while the checked-in LEDGER.json stays consistent
+with the manifest.
 
 The matrix test doubles as the auditor's purity gate: a CompileWatch
 wrapped around build-everything + audit-everything must see ZERO fresh
@@ -15,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from raft_tpu.analysis import jaxpr_audit, lint, recompile
+from raft_tpu.analysis import budgets, jaxpr_audit, ledger, lint, recompile
 
 
 def _rec(fn, jit, args, donate):
@@ -121,6 +124,88 @@ def test_donation_check_seeded():
         "e", _rec(lambda a: a + jnp.uint16(1), copy, (x,), False))
 
 
+def test_carry_stability_check_seeded():
+    x = jnp.arange(8, dtype=jnp.uint16)
+
+    # widening program: carry-out aval != carry-in aval -> no fixpoint
+    def widen(a):
+        return a.astype(jnp.int32)
+
+    rec = _rec(widen, jax.jit(widen, donate_argnums=0), (x,), True)
+    rec["carry_argnums"] = (0,)
+    fs = jaxpr_audit.check_carry_stability(
+        "e", jaxpr_audit.trace_entry(rec), rec)
+    assert fs and all(f.check == "carry" for f in fs)
+    assert "uint16" in fs[0].detail and "int32" in fs[0].detail
+
+    # stable carry: clean
+    def stable(a):
+        return a + jnp.uint16(1)
+
+    rec = _rec(stable, jax.jit(stable, donate_argnums=0), (x,), True)
+    rec["carry_argnums"] = (0,)
+    assert not jaxpr_audit.check_carry_stability(
+        "e", jaxpr_audit.trace_entry(rec), rec)
+
+    # program dropping a carry leaf entirely
+    def drop(a, b):
+        return a + 1
+
+    rec = _rec(drop, jax.jit(drop), (x, x), False)
+    rec["carry_argnums"] = (0, 1)
+    fs = jaxpr_audit.check_carry_stability(
+        "e", jaxpr_audit.trace_entry(rec), rec)
+    assert fs and "carry" in fs[0].check
+
+
+def test_donation_escape_check_seeded():
+    x = jnp.arange(8, dtype=jnp.uint16)
+
+    # dtype-changing output: jax drops the alias, and the escape check
+    # must name WHICH flat leaf lost it (here: the arg named 'a')
+    def widen(a):
+        return a.astype(jnp.int32)
+
+    rec = _rec(widen, jax.jit(widen, donate_argnums=0), (x,), True)
+    fs = jaxpr_audit.check_donation_escape("e", rec)
+    assert fs and all(f.check == "escape" for f in fs)
+    assert "a" in fs[0].detail
+
+    # alias kept: clean
+    def keep(a):
+        return a + jnp.uint16(1)
+
+    rec = _rec(keep, jax.jit(keep, donate_argnums=0), (x,), True)
+    assert not jaxpr_audit.check_donation_escape("e", rec)
+
+    # no donation: vacuously clean
+    rec = _rec(keep, jax.jit(keep), (x,), False)
+    assert not jaxpr_audit.check_donation_escape("e", rec)
+
+
+def test_paged_roundtrip_check_seeded():
+    x = jnp.arange(8, dtype=jnp.int32)
+
+    def fwd(a):
+        return a.astype(jnp.int16)
+
+    def inv(a):
+        return a.astype(jnp.int32)
+
+    def not_inv(a):
+        return a.astype(jnp.int8)
+
+    ra = _rec(fwd, jax.jit(fwd), (x,), False)
+    rb = _rec(inv, jax.jit(inv), (x.astype(jnp.int16),), False)
+    rb["name"] = "seeded_b"
+    assert not jaxpr_audit.check_paged_roundtrip(ra, rb)
+
+    rc = _rec(not_inv, jax.jit(not_inv), (x.astype(jnp.int16),), False)
+    rc["name"] = "seeded_c"
+    fs = jaxpr_audit.check_paged_roundtrip(ra, rc)
+    assert fs and all(f.check == "roundtrip" for f in fs)
+
+
 # -- all-green matrix over the live registry (and auditor purity) ----------
 
 
@@ -129,21 +214,21 @@ def test_registry_matrix_green_and_purely_static():
 
     with recompile.CompileWatch() as watch:
         pairs = build_records()
-        assert len(pairs) >= 10
+        assert len(pairs) >= 14
         names = [e.name for e, _ in pairs]
         assert len(names) == len(set(names))
         # builders never dispatch a ROUND; the one legal build-time
         # dispatch is the paged cluster ctor splitting its initial
-        # window (page_out at the host boundary)
+        # window (page_out at the host boundary) — once for the paged
+        # profile, once more for the diet_paged profile (packed carry =
+        # a distinct page_out signature)
         build_compiles, _ = recompile._bucket(watch.counts)
-        assert build_compiles.pop("paged.page_out") <= 1
+        assert build_compiles.pop("paged.page_out") <= 2
         assert all(c == 0 for c in build_compiles.values()), build_compiles
         watch.reset()
-        for entry, rec in pairs:
-            assert entry.name == rec["name"]
-            fs = jaxpr_audit.audit_record(
-                rec, expect_on=entry.expect_on, diet=entry.diet)
-            assert not fs, (entry.name, [f.as_dict() for f in fs])
+        audit_findings, rows = jaxpr_audit.audit_entries(pairs)
+        assert not audit_findings, [f.as_dict() for f in audit_findings]
+        assert [r["name"] for r in rows] == names
     # purity: the audit itself (make_jaxpr + lower) compiled — hence
     # dispatched — no manifest entry point at all
     per_entry, _ = recompile._bucket(watch.counts)
@@ -182,6 +267,154 @@ def test_recompile_bucket_splits_tracked_and_untracked():
     assert per["round.xla"] == 2
     assert per["quorum.xla"] == 0
     assert untracked == {"mystery": 1}
+
+
+# -- resource ledger: seeded regressions + the checked-in baseline ---------
+
+
+def _ledger_rec(fn, jit, args, donate, lanes=8):
+    rec = _rec(fn, jit, args, donate)
+    rec["carry_argnums"] = (0,) if donate else ()
+    rec["lanes"] = lanes
+    rec["rounds"] = 1
+    return rec
+
+
+def test_ledger_trips_widened_diet_column():
+    """The classic diet regression — a packed uint16 column widened to
+    int32 in the carry — must trip the HARD carry-bytes budget."""
+    u = jnp.arange(8, dtype=jnp.uint16)
+    w = jnp.arange(8, dtype=jnp.int32)
+
+    slim = _ledger_rec(
+        lambda a: a + jnp.uint16(1),
+        jax.jit(lambda a: a + jnp.uint16(1), donate_argnums=0), (u,), True)
+    wide = _ledger_rec(
+        lambda a: a + 1, jax.jit(lambda a: a + 1, donate_argnums=0),
+        (w,), True)
+
+    base = ledger.entry_metrics(slim)
+    cur = ledger.entry_metrics(wide)
+    assert base["carry_bytes_per_lane"] == 2.0
+    assert cur["carry_bytes_per_lane"] == 4.0
+    fs, rows = budgets.diff_entry(
+        "e", base, cur, metrics=("carry_bytes_per_lane",))
+    assert len(fs) == 1 and fs[0].check == "ledger"
+    assert "carry_bytes_per_lane" in fs[0].detail
+    assert "hard budget" in fs[0].detail
+    # and the fixed program is green against the same baseline
+    assert not budgets.diff_entry(
+        "e", base, base, metrics=("carry_bytes_per_lane",))[0]
+
+
+def test_ledger_trips_dropped_donation_alias():
+    """A program that silently loses carry donation shows up as alias
+    bytes shrinking to zero — the shrink-direction hard budget."""
+    u = jnp.arange(8, dtype=jnp.uint16)
+    donating = _ledger_rec(
+        lambda a: a + jnp.uint16(1),
+        jax.jit(lambda a: a + jnp.uint16(1), donate_argnums=0), (u,), True)
+    copying = _ledger_rec(
+        lambda a: a + jnp.uint16(1),
+        jax.jit(lambda a: a + jnp.uint16(1)), (u,), False)
+
+    base = ledger.entry_metrics(donating)
+    cur = ledger.entry_metrics(copying)
+    assert base["alias_bytes_per_lane"] == 2.0
+    assert cur["alias_bytes_per_lane"] == 0.0
+    fs, _ = budgets.diff_entry(
+        "e", base, cur, metrics=("alias_bytes_per_lane",))
+    assert len(fs) == 1 and "shrank" in fs[0].detail
+    # growth direction never fires for the shrink budget
+    assert not budgets.diff_entry(
+        "e", cur, base, metrics=("alias_bytes_per_lane",))[0]
+
+
+def test_ledger_trips_gratuitous_temp_and_new_metric():
+    base = {"temp_bytes_per_lane": 8.0}
+    # past the hard atol (2 bytes/lane): FAIL
+    fs, rows = budgets.diff_entry("e", base, {"temp_bytes_per_lane": 64.0})
+    assert len(fs) == 1 and "temp_bytes_per_lane" in fs[0].detail
+    assert rows[0][3] == "FAIL"
+    # within the atol: ok
+    assert not budgets.diff_entry("e", base, {"temp_bytes_per_lane": 9.5})[0]
+    # a metric with no baseline at all is a finding, not a silent pass
+    fs, rows = budgets.diff_entry("e", {}, {"temp_bytes_per_lane": 4.0})
+    assert len(fs) == 1 and "no baseline" in fs[0].detail
+    assert rows[0][3] == "new"
+    # soft metrics ride a relative band and scale with RAFT_TPU_LEDGER_TOL
+    soft = {"flops_per_round_per_lane": 10000.0}
+    assert not budgets.diff_entry(
+        "e", soft, {"flops_per_round_per_lane": 10400.0})[0]  # +4% < 5%
+    fs, _ = budgets.diff_entry(
+        "e", soft, {"flops_per_round_per_lane": 11500.0})     # +15%
+    assert len(fs) == 1
+    wide = budgets.scaled_tolerances(4.0)                      # 4x band
+    assert not budgets.diff_entry(
+        "e", soft, {"flops_per_round_per_lane": 11500.0}, tols=wide)[0]
+    # hard budgets never scale
+    hard = {"carry_bytes_per_lane": 2.0}
+    assert budgets.diff_entry(
+        "e", hard, {"carry_bytes_per_lane": 4.0}, tols=wide)[0]
+
+
+def test_ledger_roundtrip_gate_and_rebaseline(tmp_path):
+    """run_ledger end-to-end on cheap synthetic entries: update mode
+    writes the baseline, gate mode is green against it, a regression
+    trips it, and --update re-baselines."""
+    u = jnp.arange(16, dtype=jnp.uint16)
+    w = jnp.arange(16, dtype=jnp.int32)
+
+    class E:
+        name = "seeded"
+
+    slim = _ledger_rec(
+        lambda a: a + jnp.uint16(1),
+        jax.jit(lambda a: a + jnp.uint16(1), donate_argnums=0), (u,), True,
+        lanes=16)
+    wide = _ledger_rec(
+        lambda a: a + 1, jax.jit(lambda a: a + 1, donate_argnums=0),
+        (w,), True, lanes=16)
+    path = str(tmp_path / "LEDGER.json")
+
+    # gate with no baseline: finding pointing at --update-ledger
+    fs, _ = ledger.run_ledger([(E, slim)], path=path)
+    assert fs and "--update-ledger" in fs[0].detail
+    # baseline, then gate: green
+    fs, report = ledger.run_ledger([(E, slim)], update=True, path=path)
+    assert not fs and report["updated"]
+    fs, report = ledger.run_ledger([(E, slim)], path=path)
+    assert not fs, [f.as_dict() for f in fs]
+    # the widened program trips the gate against the slim baseline
+    fs, report = ledger.run_ledger([(E, wide)], path=path)
+    assert fs and any("carry_bytes_per_lane" in f.detail for f in fs)
+    assert "FAIL" in report["diff"]
+    # re-baseline accepts it
+    fs, _ = ledger.run_ledger([(E, wide)], update=True, path=path)
+    assert not fs
+    assert not ledger.run_ledger([(E, wide)], path=path)[0]
+    # a stale baseline entry (program deleted) is flagged
+    baseline = budgets.load_ledger(path)
+    baseline["entries"]["ghost"] = {"flops_per_round_per_lane": 1.0}
+    budgets.save_ledger(path, baseline["meta"], baseline["entries"])
+    fs, _ = ledger.run_ledger([(E, wide)], path=path)
+    assert fs and any(f.entry == "ghost" for f in fs)
+
+
+def test_checked_in_ledger_covers_manifest():
+    """LEDGER.json at the repo root is the live baseline the static gate
+    diffs against: versioned, and exactly one row per manifest entry."""
+    from raft_tpu.analysis.registry import entry_names
+
+    data = budgets.load_ledger(budgets.default_ledger_path())
+    assert data["version"] == budgets.LEDGER_VERSION
+    assert sorted(data["entries"]) == sorted(entry_names())
+    assert len(data["entries"]) >= 14
+    for name, metrics in data["entries"].items():
+        assert metrics, name
+        for k, v in metrics.items():
+            assert k in budgets.TOLERANCES, (name, k)
+            assert isinstance(v, (int, float)), (name, k)
 
 
 # -- lint rules: seeded trees + the real repo ------------------------------
@@ -255,9 +488,60 @@ def test_lint_host_hygiene_visitor_seeded():
     assert "line 7" in v.findings[1].detail
 
 
+def test_lint_view_escape_seeded():
+    src = (
+        "import numpy as np\n"
+        "class S:\n"
+        "    def grab(self):\n"
+        "        self.view = self.c.host_state()\n"          # line 4: flagged
+        "    def grab_copy(self):\n"
+        "        self.snap = np.asarray(self.c.host_state())\n"  # copied: fine
+        "    def defer(self):\n"
+        "        self._wal_pending = self.c.compute_delta()\n"   # exempt slot
+        "    def local(self):\n"
+        "        view = self.c.host_state()\n"               # not stored: fine
+        "        return np.asarray(view)\n"
+        "    def unrelated(self):\n"
+        "        self.count = self.c.n_lanes()\n"            # not a view: fine
+    )
+    v = lint._EscapeVisitor("m.py")
+    v.visit(ast.parse(src))
+    assert [f.check for f in v.findings] == ["view-escape"]
+    assert "line 4" in v.findings[0].detail
+    assert "self.view" in v.findings[0].detail
+    assert "host_state" in v.findings[0].detail
+
+
+def test_lint_bench_hygiene_seeded(tmp_path, monkeypatch):
+    bench_dir = tmp_path / "benches"
+    bench_dir.mkdir()
+    (bench_dir / "listed.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def measure(x):\n"
+        "    return jnp.sum(x)\n"     # allowlisted
+        "def report(x):\n"
+        "    return jnp.sum(x)\n"     # line 5: outside the allowlist
+    )
+    (bench_dir / "unlisted.py").write_text("x = 1\n")
+    monkeypatch.setattr(lint, "BENCH_ALLOW", {
+        "benches/listed.py": {"measure"},
+        "benches/gone.py": set(),
+    })
+    fs = lint.check_bench_hygiene(str(tmp_path))
+    checks = sorted((f.entry, f.check) for f in fs)
+    assert ("benches/gone.py", "bench-hygiene") in checks       # stale row
+    assert ("benches/unlisted.py", "bench-hygiene") in checks   # missing row
+    hygiene = [f for f in fs if f.entry == "benches/listed.py"]
+    assert len(hygiene) == 1 and "line 5" in hygiene[0].detail
+
+
 def test_repo_lint_green():
     findings, report = lint.run_lint()
     assert not findings, [f.as_dict() for f in findings]
     assert report["files_scanned"] > 50
     assert "RAFT_TPU_METRICS" in report["knobs"]
+    assert "RAFT_TPU_LEDGER_TOL" in report["knobs"]
     assert report["host_plane_modules"]
+    assert "raft_tpu/serve/loop.py" in report["host_plane_modules"]
+    assert len(report["bench_modules"]) >= 15
+    assert report["escape_modules"]
