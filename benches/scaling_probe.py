@@ -20,7 +20,15 @@ ISSUE 11 / BENCH_r06): each rung's JSON line grows pool-occupancy
 (paged_pool_in_use / paged_pool_pages / paged_page_faults /
 paged_exhausted) and paged_bytes_per_lane columns, so a flipped pair of
 ladders is the paged acceptance artifact. Pin RAFT_TPU_PAGE_WINDOW /
-RAFT_TPU_POOL_PAGES to probe sub-full-provisioning pools."""
+RAFT_TPU_POOL_PAGES to probe sub-full-provisioning pools.
+
+PROBE_TIER=0/1 flips the hot/cold hibernation tier (RAFT_TPU_TIER,
+ISSUE 16): each rung addresses PROBE_LOGICAL_RATIO x its resident group
+count in logical groups (default 16x), the per-size rung hibernates half
+its cohort to the host cold store before timing, and the JSON line grows
+logical-vs-resident occupancy plus cold_host_bytes_per_logical columns —
+the O(resident) HBM / O(total) logical-groups artifact: live bytes track
+the RESIDENT column while the logical column scales away."""
 
 from __future__ import annotations
 
@@ -68,6 +76,40 @@ def paged_columns(c) -> dict:
     return out
 
 
+def tier_logical(n_groups: int) -> dict:
+    """Constructor kwargs for the tier arm: every rung addresses
+    PROBE_LOGICAL_RATIO x its resident group count in logical ids."""
+    if not config.env_flag("RAFT_TPU_TIER", default=False):
+        return {}
+    ratio = int(os.environ.get("PROBE_LOGICAL_RATIO", 16))
+    return {"logical_groups": n_groups * max(ratio, 1)}
+
+
+def tier_columns(c) -> dict:
+    """Logical-vs-resident occupancy columns for the PROBE_TIER=1 arm
+    (ISSUE 16): how many groups the rung ADDRESSES vs how many it keeps
+    resident, and the cold store's host-RAM footprint amortized over the
+    logical space; {"tier": 0} when RAFT_TPU_TIER is off. Host-side
+    counters only — reading them costs no device traffic."""
+    t = getattr(c, "tier", None)
+    if t is None:
+        return {"tier": 0}
+    s = t.stats()
+    logical = int(getattr(t, "n_logical", 0) or s["tier_resident"])
+    return {
+        "tier": 1,
+        "logical_groups": logical,
+        "resident_groups": s["tier_resident"],
+        "residency_ratio": round(logical / max(s["tier_resident"], 1), 1),
+        "cold_groups": s["tier_cold"],
+        "cold_host_bytes_per_logical": round(
+            s["tier_cold_bytes"] / max(logical, 1), 2
+        ),
+        "tier_evictions": s["tier_evictions"],
+        "tier_births": s["tier_births"],
+    }
+
+
 def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
     from raft_tpu.config import Shape
     from raft_tpu.ops.fused import FusedCluster
@@ -82,7 +124,8 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
         max_inflight=f,
         max_read_index=r,
     )
-    c = FusedCluster(n_groups, n_voters, seed=42, shape=shape)
+    c = FusedCluster(n_groups, n_voters, seed=42, shape=shape,
+                     **tier_logical(n_groups))
     lag = min(8, w // 2)
     t0 = time.perf_counter()
     c.run(block, auto_propose=True, auto_compact_lag=lag)
@@ -92,6 +135,15 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
     while len(c.leader_lanes()) < n_groups and warm < 40 * 16:
         c.run(block, auto_propose=True, auto_compact_lag=lag)
         warm += block
+    if getattr(c, "tier", None) is not None:
+        # hibernate half the elected cohort before timing: the rung then
+        # measures a pool whose cold half holds host-RAM records, so the
+        # cold-bytes column is non-zero and the parked-lane mute rides
+        # inside the timed rounds (suspend-to-RAM is bit-exact, so this
+        # perturbs nothing the digest tests don't already pin)
+        for g in list(c.tier.residents())[::2]:
+            c.tier.request_evict(g)
+        c.tier.apply(1 << 20)
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -127,6 +179,7 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
                 "diet": int(config.env_flag("RAFT_TPU_DIET", default=False)),
                 "live_bytes_per_lane": round(live_per_lane, 1),
                 **paged_columns(c),
+                **tier_columns(c),
                 **mem,
             }
         ),
@@ -147,7 +200,8 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
         max_msg_entries=e, max_inflight=f, max_read_index=r,
     )
     c = BlockedFusedCluster(
-        n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape
+        n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape,
+        **tier_logical(n_groups),
     )
     lag = min(8, w // 2)
     t0 = time.perf_counter()
@@ -192,6 +246,7 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
                 "diet": int(config.env_flag("RAFT_TPU_DIET", default=False)),
                 "live_bytes_per_lane": round(live_per_lane, 1),
                 **paged_columns(c),
+                **tier_columns(c),
                 **mem,
             }
         ),
@@ -221,7 +276,8 @@ def measure_mesh(n_groups, n_voters, block_groups, block=32, iters=5,
         max_msg_entries=e, max_inflight=f, max_read_index=r,
     )
     c = MeshBlockedCluster(
-        n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape
+        n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape,
+        **tier_logical(n_groups),
     )
     lag = min(8, w // 2)
     t0 = time.perf_counter()
@@ -268,6 +324,7 @@ def measure_mesh(n_groups, n_voters, block_groups, block=32, iters=5,
                 "diet": int(config.env_flag("RAFT_TPU_DIET", default=False)),
                 "live_bytes_per_lane": round(live_per_lane, 1),
                 **paged_columns(c),
+                **tier_columns(c),
                 **mem,
             }
         ),
@@ -281,6 +338,11 @@ if __name__ == "__main__":
         # the ladder doubles as the diet-v2 acceptance artifact: force the
         # packed-carry knob off/on for every rung from one place
         os.environ["RAFT_TPU_DIET"] = os.environ["PROBE_DIET"]
+    if os.environ.get("PROBE_TIER") is not None:
+        # and for the hibernation tier (ISSUE 16): flip RAFT_TPU_TIER for
+        # every rung; each rung then addresses PROBE_LOGICAL_RATIO x its
+        # resident groups and grows the occupancy/cold-bytes columns
+        os.environ["RAFT_TPU_TIER"] = os.environ["PROBE_TIER"]
     if os.environ.get("PROBE_PAGED") is not None:
         # same pattern for the paged entry log (ISSUE 11): flip
         # RAFT_TPU_PAGED for every rung and each JSON line grows the

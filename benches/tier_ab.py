@@ -1,0 +1,287 @@
+"""Hot/cold tiering A/B: the hibernation tier (RAFT_TPU_TIER=1) vs the
+all-resident carry, on a Zipfian multi-tenant serve workload.
+
+The tier exists for exactly this profile (ISSUE 16): a small hot set of
+logical raft groups does nearly all the serving while a long cold tail
+sits quiescent, so keeping every group's lanes resident makes HBM scale
+O(total groups). The tiered arm keeps a resident pool sized to the hot
+set (~5% of the logical space), suspends quiescent groups to host RAM,
+and re-admits on the first touch — the client sees a typed
+REJECT_COLD_GROUP retry, never a drop.
+
+Arm matrix (fresh subprocess per arm, serve plane + metrics live):
+
+  off       RAFT_TPU_TIER=0, resident == logical     (the baseline)
+  identity  RAFT_TPU_TIER=1, resident == logical     (tier on, no misses)
+  hot       RAFT_TPU_TIER=1, resident ~= 5% logical  (the point of it)
+
+One bench JSON line per arm plus a summary, with the probes in `extra`:
+
+  - resident_bytes: nbytes of the between-dispatch device carry
+    (state + fabric + sidecars) — the quantity the tier exists to shrink
+  - digest_kv / digest_state: sha256 of the applied KV materialization
+    and of the final host_state trajectory columns
+  - admit_p99_rounds: re-admission latency (first cold rejection ->
+    first non-cold verdict), client retrying every round
+
+Asserted invariants:
+  - `off` and `identity` end on IDENTICAL kv + state digests and the
+    same round count — the tier plane at resident == logical is
+    trajectory-invisible (sha256 stream identity, tier on/off)
+  - `identity` saw zero cold misses and zero evictions
+  - `hot` resident carry bytes STRICTLY lower than `off`
+  - `hot` re-admission p99 < AB_P99_BAR (4) rounds, with real cold
+    misses (cold_rejects > 0, tier_evictions > 0)
+  - zero drops everywhere: every accepted ticket commits and applies,
+    every child's kv digest matches its replay twin, and the tier
+    counter identity evictions - admissions == cold population holds
+
+Exit 0 = pass, 1 = regression. `--smoke` shrinks the workload for CI.
+Env: AB_LOGICAL, AB_HOT_GROUPS, AB_VOTERS, AB_OPS, AB_P99_BAR,
+RAFT_TPU_* (forwarded to the children verbatim).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu import config
+
+DIGEST_FIELDS = (
+    "term", "vote", "lead", "state", "committed", "last",
+    "log_term", "log_type", "log_bytes", "error_bits",
+)
+
+
+def child():
+    import time
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.ops import fused
+    from raft_tpu.serve.admission import REJECT_COLD_GROUP, Rejected
+    from raft_tpu.serve.loop import ServeLoop
+
+    tier_on = config.env_flag("RAFT_TPU_TIER", default=False)
+    groups = int(os.environ.get("AB_GROUPS", 256))
+    logical = int(os.environ.get("AB_LOGICAL", groups))
+    v = int(os.environ.get("AB_VOTERS", 3))
+    ops_n = int(os.environ.get("AB_OPS", 200))
+
+    kw = dict(logical_groups=logical) if tier_on else {}
+    sl = ServeLoop(fused.FusedCluster(groups, v, seed=13, **kw))
+    sl.bootstrap()
+
+    # deterministic Zipfian tenant stream: the same (tenant, key, value)
+    # sequence in every arm, so `off` and `identity` trace bit-identical
+    # trajectories while `hot` turns the tail into cold misses
+    rng = np.random.default_rng(11)
+    names = rng.zipf(1.3, size=ops_n) % logical
+    sessions: dict = {}
+    tickets = []
+    cold_rejects = dropped = 0
+    admit_latency = []
+    t0 = time.perf_counter()
+    for i, n in enumerate(names):
+        tenant = f"t{int(n)}"
+        s = sessions.get(tenant)
+        if s is None:
+            s = sessions[tenant] = sl.open_session(tenant)
+        r = sl.put(s, f"k{i}", i)
+        if isinstance(r, Rejected) and r.reason == REJECT_COLD_GROUP:
+            # the re-admission latency the summary gates on: retry every
+            # round until the verdict stops being COLD (a newborn group
+            # may still answer NO_LEADER while it elects — that's the
+            # raft clock, not the tier's)
+            cold_rejects += 1
+            start = sl.round
+            for _ in range(64):
+                sl.step()
+                sl.flush()
+                r = sl.put(s, f"k{i}", i)
+                if not (isinstance(r, Rejected)
+                        and r.reason == REJECT_COLD_GROUP):
+                    break
+            admit_latency.append(sl.round - start)
+        if isinstance(r, Rejected):
+            for _ in range(256):
+                sl.step()
+                sl.flush()
+                r = sl.put(s, f"k{i}", i)
+                if not isinstance(r, Rejected):
+                    break
+        if isinstance(r, Rejected):
+            dropped += 1
+        else:
+            tickets.append(r)
+        sl.step()
+    drained = sl.drain(600)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+
+    assert drained, "serve drain stalled with work outstanding"
+    assert tickets and all(t.done and t.applied for t in tickets)
+    assert sl.digest() == sl.twin_digest(), "applied stream != replay twin"
+    sl.cluster.check_no_errors()
+
+    c = sl.cluster
+    lanes = int(np.asarray(c.state.term).shape[0])
+    resident = sum(x.nbytes for x in jax.tree.leaves(c.state)) + sum(
+        x.nbytes for x in jax.tree.leaves(c.fab)
+    )
+    if getattr(c, "paged", None) is not None:
+        resident += sum(x.nbytes for x in jax.tree.leaves(c.paged))
+    stats = dict(sl.tier.stats()) if tier_on else {}
+    if tier_on:
+        assert (stats["tier_evictions"] - stats["tier_admissions"]
+                == stats["tier_cold"]), "tier counter identity broken"
+
+    st = c.host_state()
+    dg = hashlib.sha256()
+    for name in DIGEST_FIELDS:
+        dg.update(np.ascontiguousarray(np.asarray(getattr(st, name))).tobytes())
+    lat = np.asarray(admit_latency or [0], dtype=np.int64)
+    p99 = float(np.percentile(lat, 99)) if admit_latency else 0.0
+    print(json.dumps({
+        "config": f"tier_ab:tier={int(tier_on)}:{groups}/{logical}",
+        "value": round(p99, 2),
+        "unit": "admit_p99_rounds",
+        "extra": {
+            "tier": tier_on,
+            "groups": groups,
+            "logical": logical,
+            "lanes": lanes,
+            "rounds": int(sl.round),
+            "wall_ms": round(wall_ms, 1),
+            "resident_bytes": int(resident),
+            "resident_bytes_per_lane": resident / lanes,
+            "digest_kv": sl.digest(),
+            "digest_state": dg.hexdigest(),
+            "tickets": len(tickets),
+            "cold_rejects": cold_rejects,
+            "dropped": dropped,
+            "admit_p99_rounds": p99,
+            "admit_max_rounds": int(lat.max()) if admit_latency else 0,
+            "backend": jax.default_backend(),
+            **stats,
+        },
+    }), flush=True)
+
+
+def run_child(tier: str, groups: int, logical: int,
+              extra_env: dict | None = None) -> dict:
+    env = dict(
+        os.environ,
+        RAFT_TPU_TIER=tier,
+        AB_GROUPS=str(groups),
+        AB_LOGICAL=str(logical),
+        # the serve plane is the workload; metrics make the counter
+        # identity visible in the child's snapshot fold
+        RAFT_TPU_EGRESS="1",
+        RAFT_TPU_METRICS="1",
+    )
+    if extra_env:
+        env.update(extra_env)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    if "--smoke" in sys.argv:
+        os.environ.setdefault("AB_LOGICAL", "96")
+        os.environ.setdefault("AB_OPS", "48")
+    logical = int(os.environ.get("AB_LOGICAL", 256))
+    hot = int(os.environ.get("AB_HOT_GROUPS", str(max(4, logical // 20))))
+    bar = float(os.environ.get("AB_P99_BAR", 4))
+
+    arms = {
+        "off": run_child("0", logical, logical),
+        "identity": run_child("1", logical, logical),
+        # serving-latency tuning: a 1-round halflife with admit at 0.5
+        # means the first retry's touch crosses the threshold, and evict
+        # at 0.45 (hysteresis gap kept) frees victims a couple of rounds
+        # after they go quiet — re-admission is victim-bound, not
+        # score-bound, at a churning 5% pool
+        "hot": run_child("1", hot, logical, {
+            "RAFT_TPU_TIER_HALFLIFE": "1",
+            "RAFT_TPU_TIER_ADMIT": "0.5",
+            "RAFT_TPU_TIER_EVICT": "0.45",
+            "RAFT_TPU_TIER_COOLDOWN": "0",
+        }),
+    }
+    for r in arms.values():
+        print(json.dumps(r), flush=True)
+
+    fails = []
+    off, ident, hotx = (arms[k]["extra"] for k in ("off", "identity", "hot"))
+    for k, ex in zip(("off", "identity", "hot"), (off, ident, hotx)):
+        if ex["dropped"]:
+            fails.append(f"{k}: {ex['dropped']} proposal(s) never accepted")
+    if ident["digest_kv"] != off["digest_kv"] or (
+        ident["digest_state"] != off["digest_state"]
+    ):
+        fails.append(
+            "identity: digest diverged from tier-off — the tier plane is "
+            "not trajectory-invisible at resident == logical"
+        )
+    if ident["rounds"] != off["rounds"]:
+        fails.append(
+            f"identity: round count diverged ({off['rounds']} -> "
+            f"{ident['rounds']})"
+        )
+    if ident["cold_rejects"] or ident.get("tier_evictions"):
+        fails.append(
+            f"identity: saw {ident['cold_rejects']} cold miss(es), "
+            f"{ident.get('tier_evictions')} eviction(s) at full residency"
+        )
+    if hotx["resident_bytes"] >= off["resident_bytes"]:
+        fails.append(
+            f"hot: resident carry bytes not strictly lower "
+            f"({off['resident_bytes']} -> {hotx['resident_bytes']})"
+        )
+    if not hotx["cold_rejects"] or not hotx.get("tier_evictions"):
+        fails.append(
+            "hot: the Zipfian tail never missed cold — the arm is not "
+            "exercising the tier"
+        )
+    if hotx["admit_p99_rounds"] >= bar:
+        fails.append(
+            f"hot: re-admission p99 {hotx['admit_p99_rounds']} rounds "
+            f">= bar {bar}"
+        )
+    print(json.dumps({
+        "metric": "tier_ab",
+        "ok": not fails,
+        "logical_groups": logical,
+        "hot_resident_groups": hot,
+        "resident_bytes_off": off["resident_bytes"],
+        "resident_bytes_hot": hotx["resident_bytes"],
+        "shrink_pct": round(
+            100 * (1 - hotx["resident_bytes"] / off["resident_bytes"]), 1,
+        ),
+        "admit_p99_rounds": hotx["admit_p99_rounds"],
+        "cold_rejects": hotx["cold_rejects"],
+        "evictions": hotx.get("tier_evictions"),
+        "births": hotx.get("tier_births"),
+        "p99_bar": bar,
+    }), flush=True)
+    for f in fails:
+        print(f"FAIL: {f}", file=sys.stderr)
+    sys.exit(1 if fails else 0)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

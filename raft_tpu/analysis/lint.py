@@ -102,6 +102,7 @@ BENCH_ALLOW = {
     "benches/scaling_probe.py": {"measure"},
     "benches/serve_bench.py": {"pct"},
     "benches/soak.py": {"main"},
+    "benches/tier_ab.py": {"child"},
     "benches/trace_ab.py": {"child"},
     "benches/wal_ab.py": {"fetch_delta", "run"},
 }

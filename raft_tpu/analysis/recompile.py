@@ -49,6 +49,8 @@ ENTRY_JIT_NAMES = {
     "rebase.fabric": ("_rebase_fabric",),
     "paged.page_in": ("page_in_host", "page_in"),
     "paged.page_out": ("page_out_host", "page_out"),
+    "tier.gather": ("_tier_gather",),
+    "tier.scatter": ("_tier_scatter",),
 }
 
 
@@ -175,6 +177,21 @@ def _smoke_context():
         jnp.asarray(np.zeros((nl,), np.int32)),
     )
     ctx["rebase_fab"] = fmod.fat_fabric(fmod.unpack_fabric(base.fab))
+    # tier gather/scatter operands: one group's voter lanes pow2-padded,
+    # rows sliced host-side so building them compiles nothing
+    from raft_tpu.tier import engine as tmod
+
+    with env_profile(PROFILES["tier"]):
+        tcl = fmod.FusedCluster(n_groups=4, n_voters=3, engine="xla")
+    tst = unpack_state(tcl.state)
+    tfb = fmod.unpack_fabric(tcl.fab)
+    tlanes, _ = tmod._pad_rows(np.arange(tcl.v, dtype=np.int32), None)
+    trows = lambda t: jax.tree.map(
+        lambda x: jnp.asarray(np.asarray(x)[tlanes]), t
+    )
+    ctx["tier_args"] = (tst, tfb, jnp.asarray(tlanes))
+    ctx["tier_rows"] = (trows(tst), trows(tfb))
+    ctx["tmod"] = tmod
     ctx["rm"] = rm
     ctx["qp"] = qp
     ctx["fmod"] = fmod
@@ -204,6 +221,12 @@ def _drive(ctx):
     pg = ctx["paged"]
     full, _ = pgmod.page_in_host(pg.state, pg.paged)
     jax.block_until_ready(pgmod.page_out_host(full, pg.paged))
+    # the tier pair via the copying scatter twin (same jit name as the
+    # donating one, and the operands stay valid for the steady pass)
+    tg, tsc, _ = ctx["tmod"]._jits()
+    tst, tfb, tlanes = ctx["tier_args"]
+    jax.block_until_ready(tg(tst, tfb, tlanes))
+    jax.block_until_ready(tsc(tst, tfb, tlanes, *ctx["tier_rows"]))
 
 
 def run_sentinel() -> tuple[list, dict]:

@@ -61,6 +61,9 @@ _BASE = {
     "RAFT_TPU_PALLAS_ROUNDS": None,
     "RAFT_TPU_UNROLL": None,
     "RAFT_TPU_ROUTE": None,
+    # the tier plane is pinned OFF in every profile except "tier": the
+    # RAFT_TPU_TIER=0 elision claim is asserted on every other entry
+    "RAFT_TPU_TIER": None,
 }
 
 PROFILES = {
@@ -127,6 +130,19 @@ PROFILES = {
         RAFT_TPU_DONATE="1",
         RAFT_TPU_PAGED="0",
         RAFT_TPU_EGRESS="1",
+    ),
+    # the hot/cold tier's dispatch-boundary jits (tier/engine.py): planes
+    # off so the gather/scatter jaxprs are pure row movement, donation on
+    # (the scatter's dominant tier-on path consumes the carry in place)
+    "tier": dict(
+        _BASE,
+        RAFT_TPU_METRICS="0",
+        RAFT_TPU_CHAOS="0",
+        RAFT_TPU_TRACELOG="0",
+        RAFT_TPU_DIET="0",
+        RAFT_TPU_DONATE="1",
+        RAFT_TPU_PAGED="0",
+        RAFT_TPU_TIER="1",
     ),
 }
 
@@ -376,8 +392,61 @@ def _paged_entries():
     return pgmod.audit_records(cl.state, cl.paged, full.state, paged0)
 
 
-_ALL_ON = {"metrics": True, "chaos": True, "trace": True, "paged": False}
-_ALL_OFF = {"metrics": False, "chaos": False, "trace": False, "paged": False}
+def _tier_entries():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.fused import unpack_fabric
+    from raft_tpu.state import unpack_state
+    from raft_tpu.tier import engine as tmod
+
+    cl = _cluster("xla")
+    assert cl.tier is not None, "tier profile must enable RAFT_TPU_TIER"
+    # the gather/scatter operate on the unpacked slim-canonical carry —
+    # exactly what TierEngine._commit hands them between page_in/unpack
+    # and slim/pack/page_out
+    st = unpack_state(cl.state)
+    fb = unpack_fabric(cl.fab)
+    # one evicted group's voter lanes, duplicate-padded to the next power
+    # of two exactly as _commit pads its batches (3 lanes -> 4)
+    lanes_np, _ = tmod._pad_rows(np.arange(cl.v, dtype=np.int32), None)
+    lanes = jnp.asarray(lanes_np)
+    rows = lambda t: jax.tree.map(
+        lambda x: jnp.asarray(np.asarray(x)[lanes_np]), t
+    )
+    st_rows, fb_rows = rows(st), rows(fb)
+    gather_jit, _, scatter_donate_jit = tmod._jits()
+    n = np.asarray(st.term).shape[0]
+    common = dict(kwargs={}, static={}, lanes=n, rounds=1)
+    return [
+        # evict-snapshot: fresh row buffers off the carry (no donation —
+        # the carry must stay valid for the scatter in the same apply)
+        dict(common, name="tier.gather", fn=tmod._tier_gather,
+             jit=gather_jit, args=(st, fb, lanes), donate=False,
+             donate_argnums=(), donate_argnames=(),
+             checks=("elision", "capture", "hygiene", "donation")),
+        # admit-restore: the donating twin _commit dispatches under
+        # RAFT_TPU_DONATE=1 — the carry is the fixpoint (state AND fabric
+        # come back with identical avals) and every donated leaf must
+        # keep its in-place alias
+        dict(common, name="tier.scatter", fn=tmod._tier_scatter,
+             jit=scatter_donate_jit,
+             args=(st, fb, lanes, st_rows, fb_rows), donate=True,
+             donate_argnums=(0, 1), donate_argnames=(),
+             checks=("elision", "capture", "hygiene", "donation",
+                     "carry", "escape"),
+             carry_argnums=(0, 1), carry_argnames=()),
+    ]
+
+
+_ALL_ON = {"metrics": True, "chaos": True, "trace": True, "paged": False,
+           "tier": False}
+_ALL_OFF = {"metrics": False, "chaos": False, "trace": False,
+            "paged": False, "tier": False}
+_TIER_ON = {"metrics": False, "chaos": False, "trace": False,
+            "paged": False, "tier": True}
 
 ENTRIES = (
     Entry("round.xla", "planes_on", _round_xla,
@@ -404,13 +473,21 @@ ENTRIES = (
     Entry("mesh.step.xla", "planes_on", _mesh_step, compile_budget=1),
     Entry("serve.round", "serve", _serve_round, compile_budget=1,
           expect_on={"metrics": True, "chaos": False, "trace": False,
-                     "paged": False},
+                     "paged": False, "tier": False},
           diet=True),
     Entry("round.xla.diet_paged", "diet_paged", _round_diet_paged,
           compile_budget=1,
           expect_on={"metrics": True, "chaos": False, "trace": False,
-                     "paged": True},
+                     "paged": True, "tier": False},
           diet=True),
+    # the hot/cold tier's dispatch-boundary pair (tier/engine.py): the
+    # evict-snapshot gather and the donating admit-restore scatter; every
+    # OTHER entry above asserts "tier": False under its pinned-off
+    # profile — the RAFT_TPU_TIER=0 full-elision claim
+    Entry("tier.gather", "tier", _tier_entries, compile_budget=1,
+          expect_on=_TIER_ON),
+    Entry("tier.scatter", "tier", _tier_entries, compile_budget=1,
+          expect_on=_TIER_ON),
 )
 
 

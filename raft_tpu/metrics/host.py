@@ -187,6 +187,31 @@ PAGED_COUNTERS = (
     "paged_exhausted",
 )
 
+# hot/cold tier counter families (host plane — pure python counters from
+# raft_tpu/tier/engine.py, mirrored by FusedCluster.metrics_snapshot /
+# TierEngine.stats(mirror=True); no device sync involved). The
+# accounting identity the tier tests gate on:
+#   tier_evictions - tier_admissions == tier_cold   (exactly — genesis
+#   admissions count as tier_births, never tier_admissions)
+#   tier_evictions         groups suspended to the cold store (cumulative)
+#   tier_admissions        groups restored FROM the cold store (cumulative)
+#   tier_births            groups admitted by genesis synthesis — first
+#                          residency of a late-born logical id (cumulative)
+#   tier_resident          gauge: logical groups currently on resident lanes
+#   tier_cold              gauge: cold-store population (RAM + spilled)
+#   tier_cold_bytes        gauge: cold-record bytes (host RAM + disk spill)
+#   tier_thrash_suppressed evictions blocked ONLY by the minimum-residency
+#                          cooldown — the hysteresis doing work
+TIER_COUNTERS = (
+    "tier_evictions",
+    "tier_admissions",
+    "tier_births",
+    "tier_resident",
+    "tier_cold",
+    "tier_cold_bytes",
+    "tier_thrash_suppressed",
+)
+
 
 class HostCounters:
     """Plain host-side counter bag speaking the snapshot schema — the
@@ -449,3 +474,14 @@ def record_paged_stats(stats: dict) -> None:
             int(stats.get("paged_exhausted", 0)),
             int(stats.get("paged_pool_pages", 0)),
         )
+
+
+# process-wide mirror of the latest tier stats (the PAGED_EVENTS twin):
+# /metrics exports scrape this without holding a cluster reference
+TIER_EVENTS = HostCounters()
+
+
+def record_tier_stats(stats: dict) -> None:
+    """Mirror one tier/engine.py stats() snapshot onto the host plane."""
+    for name in TIER_COUNTERS:
+        TIER_EVENTS.set(name, int(stats.get(name, 0)))

@@ -1859,6 +1859,7 @@ class FusedCluster:
         engine: str | None = None,
         tile_lanes: int | None = None,
         rounds_per_call: int | None = None,
+        logical_groups: int | None = None,
         **cfg,
     ):
         import numpy as np
@@ -1904,6 +1905,26 @@ class FusedCluster:
             init_state(self.shape, ids, peers, is_learner, seed=seed, cfg=lane_cfg)
         )
         self.fab = slim_fabric(empty_fabric(n, n_voters, self.shape.max_msg_entries))
+        # hot/cold tiering (RAFT_TPU_TIER, raft_tpu/tier/ — read once at
+        # construction like the other planes): capture the genesis row
+        # template NOW, while the carry is still the slim-canonical full
+        # window (pre-diet-pack, pre-paged-split) — the layout cold
+        # records and late-born groups restore into. tier=None keeps
+        # every tier code path (and both tier jits) out of existence.
+        from raft_tpu.tier import tier_enabled
+
+        self._seed = seed
+        self.tier = None
+        self._tier_template = None
+        if tier_enabled():
+            self._tier_template = (
+                jax.tree.map(lambda x: np.asarray(x[:n_voters]).copy(), self.state),
+                jax.tree.map(lambda x: np.asarray(x[:n_voters]).copy(), self.fab),
+            )
+        elif logical_groups is not None and logical_groups != n_groups:
+            raise ValueError(
+                "logical_groups > n_groups requires RAFT_TPU_TIER=1"
+            )
         # diet-v2 (RAFT_TPU_DIET, read once at construction): the resident
         # carry packs down to bitset masks + uint16 rebased indexes
         # (state.pack_state / pack_fabric); every dispatch widens in-device.
@@ -1965,6 +1986,30 @@ class FusedCluster:
         if pgmod.paged_enabled():
             self._page_plan = pgmod.validate_page_plan(self.shape, n)
             self.state, self.paged = pgmod.split_state(self.state, self._page_plan)
+        # default tier binding: identity cohort (lgids == slots). The
+        # blocked/mesh drivers re-attach per-block engines with their own
+        # cohorts/lane bases (scheduler.py / parallel/mesh.py).
+        if self._tier_template is not None:
+            self.attach_tier(n_logical=logical_groups)
+
+    def attach_tier(self, *, n_logical=None, initial=None, lane_base=0):
+        """(Re)bind this carry's TierEngine (RAFT_TPU_TIER=1 only): the
+        multi-block drivers call this with per-block genesis cohorts and
+        lane bases; standalone construction binds the identity cohort."""
+        from raft_tpu.tier.engine import TierEngine
+
+        if self._tier_template is None:
+            raise RuntimeError(
+                "tier plane is off: construct under RAFT_TPU_TIER=1"
+            )
+        self.tier = TierEngine(
+            self,
+            seed=self._seed,
+            n_logical=self.g if n_logical is None else n_logical,
+            initial=initial,
+            lane_base=lane_base,
+        )
+        return self.tier
 
     # -- driving ----------------------------------------------------------
 
@@ -2684,6 +2729,12 @@ class FusedCluster:
             # a host sync point, so the lazy occupancy sum costs nothing
             # extra); also mirrors onto metrics/host.py PAGED_COUNTERS
             for k, val in (self.paged_stats() or {}).items():
+                snap["counters"][k] = val
+        if self.tier is not None:
+            # tier occupancy/churn rides the same snapshot and mirrors
+            # onto metrics/host.py TIER_COUNTERS (pure host counters — no
+            # device sync at all)
+            for k, val in self.tier.stats(mirror=True).items():
                 snap["counters"][k] = val
         return snap
 

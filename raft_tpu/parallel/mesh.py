@@ -90,6 +90,7 @@ class MeshBlockedCluster:
         round_chunk: int = 1,
         pipeline_depth: int | None = None,
         straddle: bool = False,
+        logical_groups: int | None = None,
         **cfg,
     ):
         devices = list(devices) if devices is not None else jax.devices()
@@ -128,6 +129,30 @@ class MeshBlockedCluster:
         self.lanes_per_shard = self.blocks[0].lanes_per_shard
         # optional utils/profiling.py SpanRecorder (scheduler contract)
         self.spans = None
+        # hot/cold tiering (RAFT_TPU_TIER): per-block engines re-attached
+        # with their contiguous slice of the logical id space (the
+        # scheduler's exact partition), each keeping the sharded driver's
+        # post-commit re-shard hook, coordinated by one ClusterTier
+        self.tier = None
+        if self.blocks[0].tier is not None:
+            from raft_tpu.tier.engine import ClusterTier
+
+            n_logical = logical_groups or n_groups
+            engines = [
+                b.attach_tier(
+                    n_logical=n_logical,
+                    initial=ClusterTier.initial_cohort(
+                        n_logical, self.k, i, self.block_groups
+                    ),
+                    lane_base=i * self.lanes_per_block,
+                )
+                for i, b in enumerate(self.blocks)
+            ]
+            self.tier = ClusterTier(engines, n_logical)
+        elif logical_groups is not None and logical_groups != n_groups:
+            raise ValueError(
+                "logical_groups > n_groups requires RAFT_TPU_TIER=1"
+            )
 
     # -- driving ----------------------------------------------------------
 
@@ -348,7 +373,16 @@ class MeshBlockedCluster:
             return None
         from raft_tpu.metrics.host import merge_snapshots
 
-        return merge_snapshots([b.metrics_snapshot() for b in self.blocks])
+        merged = merge_snapshots(
+            [b.metrics_snapshot() for b in self.blocks]
+        )
+        if self.tier is not None:
+            # the per-block folds summed once each in the merge; overwrite
+            # with the coordinator's aggregate (gauge semantics) so the
+            # accounting identity holds over the whole logical space
+            for key, val in self.tier.stats(mirror=True).items():
+                merged["counters"][key] = val
+        return merged
 
     def state_columns(self, *names) -> dict:
         """Aggregate state_columns over all K blocks in GLOBAL lane order

@@ -468,6 +468,29 @@ class ShardedFusedCluster:
         # donate the (state, fab, metrics) carry, mirroring FusedCluster;
         # ops/mute stay un-donated (self._no_ops and inner.mute are re-fed)
         self._donate = _donation_enabled()
+        # hot/cold tiering (RAFT_TPU_TIER): the inner cluster attached an
+        # identity-cohort engine at construction; its commits scatter
+        # fresh carry buffers OUTSIDE shard_map, so hook the dispatch
+        # boundary to re-shard the carry (and mute) back over the mesh
+        if self.inner.tier is not None:
+            self.inner.tier.post_commit = self._reshard_after_tier
+
+    def attach_tier(self, *, n_logical=None, initial=None, lane_base=0):
+        """Re-bind the inner engine (mesh driver path) keeping the
+        post-commit re-shard hook attached to the fresh engine."""
+        eng = self.inner.attach_tier(
+            n_logical=n_logical, initial=initial, lane_base=lane_base
+        )
+        eng.post_commit = self._reshard_after_tier
+        return eng
+
+    def _reshard_after_tier(self):
+        inner = self.inner
+        inner.state = jax.tree.map(self._shard_lanes, inner.state)
+        inner.fab = jax.tree.map(self._shard_lanes, inner.fab)
+        inner.mute = jax.device_put(
+            jnp.asarray(inner.mute), self.lane_sharding
+        )
 
     def _resolve_shard_tile(self) -> int:
         """Lane tile for the PER-SHARD pallas grid (the kernel sees

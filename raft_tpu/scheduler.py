@@ -222,6 +222,7 @@ class BlockedFusedCluster:
         shape: Shape | None = None,
         round_chunk: int = 1,
         pipeline_depth: int | None = None,
+        logical_groups: int | None = None,
         **cfg,
     ):
         # geometry + ops-slicing + sweep bookkeeping live in the shared
@@ -260,6 +261,30 @@ class BlockedFusedCluster:
         # assembler folds into the Perfetto timeline (host dispatch time —
         # JAX async dispatch means device execution rides behind it)
         self.spans = None
+        # hot/cold tiering (RAFT_TPU_TIER, raft_tpu/tier/): re-attach each
+        # block's engine with its slice of the LOGICAL id space — a
+        # contiguous equal partition, so L == G is lane-identical to the
+        # tier-off blocked layout — coordinated through one ClusterTier.
+        self.tier = None
+        if self.blocks[0].tier is not None:
+            from raft_tpu.tier.engine import ClusterTier
+
+            n_logical = logical_groups or n_groups
+            engines = [
+                b.attach_tier(
+                    n_logical=n_logical,
+                    initial=ClusterTier.initial_cohort(
+                        n_logical, self.k, i, self.block_groups
+                    ),
+                    lane_base=i * self.lanes_per_block,
+                )
+                for i, b in enumerate(self.blocks)
+            ]
+            self.tier = ClusterTier(engines, n_logical)
+        elif logical_groups is not None and logical_groups != n_groups:
+            raise ValueError(
+                "logical_groups > n_groups requires RAFT_TPU_TIER=1"
+            )
 
     # -- driving ----------------------------------------------------------
 
@@ -456,7 +481,14 @@ class BlockedFusedCluster:
             )
             b._metrics_acc.pull(pulled)
             snaps.append(b._metrics_acc.snapshot())
-        return merge_snapshots(snaps)
+        merged = merge_snapshots(snaps)
+        if self.tier is not None:
+            # per-block tier counters don't ride the per-block snapshots
+            # here (they're pure host counters); fold the coordinator's
+            # aggregate in once, mirroring onto TIER_COUNTERS
+            for key, val in self.tier.stats(mirror=True).items():
+                merged["counters"][key] = val
+        return merged
 
     def state_columns(self, *names) -> dict:
         """Aggregate FusedCluster.state_columns over all K resident blocks:

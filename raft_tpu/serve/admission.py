@@ -35,6 +35,10 @@ REJECT_QUEUE_FULL = "queue_full"
 REJECT_READ_BATCH_FULL = "read_batch_full"
 REJECT_NO_LEADER = DROP_NO_LEADER
 REJECT_SESSION_CLOSED = "session_closed"
+# the group is hibernated (RAFT_TPU_TIER): the miss queued its
+# re-admission — a typed retry-later, never a drop (the client resubmits
+# once the tier restores the group, typically within a couple of rounds)
+REJECT_COLD_GROUP = "cold_group"
 
 
 class Rejected(NamedTuple):

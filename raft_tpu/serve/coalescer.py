@@ -126,48 +126,80 @@ class ProposalCoalescer:
         self.queue_cap = queue_cap
         self.cmd_bytes = cmd_bytes
         self.read_retry_rounds = read_retry_rounds
-        self.pending: list[deque] = [deque() for _ in range(n_groups)]
-        self.read_wait: list[list] = [[] for _ in range(n_groups)]
+        # per-group queues materialize lazily (dict, not dense list): the
+        # group key space is LOGICAL under RAFT_TPU_TIER — millions of
+        # ids, of which only the actively-served ones may hold queues
+        self.pending: dict[int, deque] = {}
+        self.read_wait: dict[int, list] = {}
         self.read_batches: dict[int, ReadBatch] = {}  # ctx -> batch
-        self._batches_of: list[set] = [set() for _ in range(n_groups)]
+        self._batches_of: dict[int, set] = {}
         self._next_ctx = 1
         self.on_read_retry = None  # optional hook (ServeLoop -> metrics)
+
+    def _pending(self, group: int) -> deque:
+        q = self.pending.get(group)
+        if q is None:
+            q = self.pending[group] = deque()
+        return q
+
+    def _read_wait(self, group: int) -> list:
+        q = self.read_wait.get(group)
+        if q is None:
+            q = self.read_wait[group] = []
+        return q
+
+    def _batches(self, group: int) -> set:
+        s = self._batches_of.get(group)
+        if s is None:
+            s = self._batches_of[group] = set()
+        return s
+
+    def active_groups(self) -> set:
+        """Groups with any queued/in-flight coalescer work — the serve
+        loop's iteration set for build() and the tier's eviction shield."""
+        return (
+            {g for g, q in self.pending.items() if q}
+            | {g for g, q in self.read_wait.items() if q}
+            | {g for g, s in self._batches_of.items() if s}
+        )
 
     # -- intake -----------------------------------------------------------
 
     def queue_depth(self, group: int) -> int:
-        return len(self.pending[group]) + len(self.read_wait[group])
+        return len(self.pending.get(group) or ()) + len(
+            self.read_wait.get(group) or ()
+        )
 
     def enqueue(self, ticket: ProposeTicket) -> Rejected | None:
         g = ticket.group
         if self.queue_depth(g) >= self.queue_cap:
             return Rejected(REJECT_QUEUE_FULL, f"group={g}")
-        self.pending[g].append(ticket)
+        self._pending(g).append(ticket)
         return None
 
     def requeue_front(self, group: int, tickets: list) -> None:
         """Epoch resync: put re-proposed tickets back at the queue head in
         original order (dedup makes the re-commit exactly-once)."""
-        self.pending[group].extendleft(reversed(tickets))
+        self._pending(group).extendleft(reversed(tickets))
 
     def enqueue_read(self, ticket: ReadTicket) -> Rejected | None:
         g = ticket.group
         # the more specific reason first: the ReadIndex batch window is
         # saturated AND the wait queue is at capacity behind it
         if (
-            len(self._batches_of[g]) >= self.max_read_batches
-            and len(self.read_wait[g]) >= self.queue_cap
+            len(self._batches_of.get(g) or ()) >= self.max_read_batches
+            and len(self.read_wait.get(g) or ()) >= self.queue_cap
         ):
             return Rejected(REJECT_READ_BATCH_FULL, f"group={g}")
         if self.queue_depth(g) >= self.queue_cap:
             return Rejected(REJECT_QUEUE_FULL, f"group={g}")
-        self.read_wait[g].append(ticket)
+        self._read_wait(g).append(ticket)
         return None
 
     def take_batch(self, ctx: int) -> ReadBatch | None:
         b = self.read_batches.pop(ctx, None)
         if b is not None:
-            self._batches_of[b.group].discard(ctx)
+            self._batches(b.group).discard(ctx)
         return b
 
     @property
@@ -178,12 +210,12 @@ class ProposalCoalescer:
         """Epoch resync: cancel the group's unreleased batches and return
         every waiting ticket for re-admission-free re-batching."""
         tickets = []
-        for ctx in sorted(self._batches_of[group]):
+        for ctx in sorted(self._batches_of.get(group) or ()):
             b = self.read_batches.pop(ctx)
             tickets.extend(b.tickets)
-        self._batches_of[group].clear()
-        tickets.extend(self.read_wait[group])
-        self.read_wait[group] = []
+        self._batches_of.pop(group, None)
+        tickets.extend(self.read_wait.get(group) or ())
+        self.read_wait.pop(group, None)
         return tickets
 
     # -- the per-round batched injection ----------------------------------
@@ -200,12 +232,15 @@ class ProposalCoalescer:
         """
         prop_n = None  # allocated lazily: zero-op rounds build nothing
         injections = []
-        for g in range(self.g):
+        # iterate only the groups with queued/in-flight work — O(active),
+        # never O(logical groups); sorted for deterministic injection order
+        for g in sorted(self.active_groups()):
             view = views[g]
             if view.leader_lane < 0:
                 continue
             room = self.window_budget - (view.next_index - 1 - view.floor())
-            m = min(len(self.pending[g]), self.max_per_round, max(0, room))
+            q = self.pending.get(g) or ()
+            m = min(len(q), self.max_per_round, max(0, room))
             if m > 0:
                 if prop_n is None:
                     prop_n = np.zeros((self.n,), np.int32)
@@ -240,7 +275,7 @@ class ProposalCoalescer:
         unreleased batch wins over opening a new batch."""
         due = [
             self.read_batches[c]
-            for c in self._batches_of[g]
+            for c in self._batches_of.get(g) or ()
             if round_id - self.read_batches[c].inject_round
             >= self.read_retry_rounds * (self.read_batches[c].retries + 1)
         ]
@@ -251,16 +286,15 @@ class ProposalCoalescer:
                 self.on_read_retry()
             return b.ctx
         if (
-            self.read_wait[g]
-            and len(self._batches_of[g]) < self.max_read_batches
+            self.read_wait.get(g)
+            and len(self._batches_of.get(g) or ()) < self.max_read_batches
         ):
             ctx = self._next_ctx
             # i32, nonzero, wraps long before the ro ring could still hold
             # a colliding live ticket
             self._next_ctx = 1 if self._next_ctx >= (1 << 30) else ctx + 1
-            b = ReadBatch(ctx, g, self.read_wait[g], round_id)
-            self.read_wait[g] = []
+            b = ReadBatch(ctx, g, self.read_wait.pop(g), round_id)
             self.read_batches[ctx] = b
-            self._batches_of[g].add(ctx)
+            self._batches(g).add(ctx)
             return ctx
         return 0

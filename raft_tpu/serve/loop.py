@@ -42,6 +42,7 @@ from raft_tpu.metrics.host import (
 from raft_tpu.ops import ready_mask
 from raft_tpu.runtime.egress import EgressStream
 from raft_tpu.serve.admission import (
+    REJECT_COLD_GROUP,
     REJECT_NO_LEADER,
     REJECT_SESSION_CLOSED,
     AdmissionController,
@@ -139,6 +140,16 @@ class ServeLoop:
         )
         self.expire_every = expire_every
         self.round = 0
+        # hot/cold tier (RAFT_TPU_TIER): when the cluster carries one, the
+        # serve plane speaks LOGICAL group ids everywhere — sessions, KV,
+        # coalescer queues, router views — and the tier maps the resident
+        # subset onto carry slots. None on tier-off clusters, and every
+        # tier branch below is skipped then.
+        self.tier = getattr(cluster, "tier", None)
+        self.logical_groups = (
+            self.g if self.tier is None
+            else (self.tier.n_logical or self.g)
+        )
 
         self.metrics = ServeMetrics()
         # host-side phase timings for the round loop (admission / coalesce
@@ -153,8 +164,8 @@ class ServeLoop:
         self.registry = MetricsRegistry()
         self.registry.register("serve", self.metrics.snapshot)
         self.registry.register("steps", self.stats.snapshot)
-        self.sessions = SessionManager(self.g)
-        self.kv = KVStore(self.g)
+        self.sessions = SessionManager(self.logical_groups)
+        self.kv = KVStore(self.logical_groups)
         self.admission = AdmissionController(
             tenant_rate=tenant_rate,
             tenant_burst=tenant_burst,
@@ -213,6 +224,20 @@ class ServeLoop:
             self._trace_arg = (
                 self.traces if self.blocked else self.traces[0]
             )
+        if self.tier is not None:
+            # the router resolves lanes <-> logical ids through the tier's
+            # allocator, feeds the activity scorer straight from the egress
+            # bundles (one touch per active-lane row), and its in-flight
+            # attribution pins groups against mid-proposal eviction
+            self.router.lane_to_group = self.tier.group_of_lane
+            self.router.base_lane = self.tier.lane_of_group
+            self.router.on_group_activity = self.tier.touch
+            self.tier.set_pinned(
+                lambda: self.router.groups_with_inflight()
+                | self.coalescer.active_groups()
+            )
+            if self.spans is not None:
+                self.tier.set_spans(self.spans)
 
     def audit_programs(self, rounds: int = 1):
         """Audit records for the serving frontend (raft_tpu/analysis).
@@ -240,7 +265,12 @@ class ServeLoop:
         then attach the router's group views from one synchronous column
         pull (initial attach rides the epoch-resync machinery on empty
         queues)."""
-        self.router.needs_resync.update(range(self.g))
+        if self.tier is not None:
+            # attach only the resident (genesis) cohort; cold logical ids
+            # attach when a miss admits them
+            self.router.needs_resync.update(self.tier.residents())
+        else:
+            self.router.needs_resync.update(range(self.g))
         spent = 0
         while self.router.needs_resync and spent < max_rounds:
             self.cluster.run(
@@ -335,6 +365,14 @@ class ServeLoop:
     def _gate(self, session) -> Rejected | None:
         if not session.open:
             return self._rejected(Rejected(REJECT_SESSION_CLOSED))
+        if self.tier is not None and not self.tier.resident(session.group):
+            # hibernated group: the miss queues its re-admission (the
+            # request is itself a scorer touch) and the client gets a
+            # typed retry-later — never a drop
+            self.tier.request_admit(session.group, self.round)
+            return self._rejected(
+                Rejected(REJECT_COLD_GROUP, f"group={session.group}")
+            )
         if not self.router.views[session.group].attached:
             return self._rejected(
                 Rejected(REJECT_NO_LEADER, f"group={session.group}")
@@ -357,6 +395,20 @@ class ServeLoop:
         self.metrics.rounds = self.round
         self.router.round = self.round
         sp = self.spans
+        if self.tier is not None:
+            self.tier.tick(self.round)
+            if self.tier.pending():
+                # dispatch-boundary batch: evictions detach their views
+                # (attribution parks with the cold record's exact rows);
+                # admissions re-attach through the resync machinery below
+                # — the restored leader re-attaches the same round
+                with self.stats.timed("tier"):
+                    evicted, admitted = self.tier.apply(self.round)
+                for g in evicted:
+                    self.router.views[g].detach()
+                    self.router.needs_resync.discard(g)
+                for g in admitted:
+                    self.router.needs_resync.add(g)
         with self.stats.timed("admission"):
             self.admission.tick()
         with self.stats.timed("coalesce"), (
@@ -443,7 +495,9 @@ class ServeLoop:
         scalar KVStore — the acceptance oracle the digests must match."""
         from raft_tpu.serve.kv import replay
 
-        return replay(self.g, self.router.applied_log, self.round)
+        return replay(
+            self.logical_groups, self.router.applied_log, self.round
+        )
 
     def metrics_snapshot(self) -> dict:
         """Merged host-plane snapshot: serving counters + notify-latency

@@ -77,6 +77,30 @@ class GroupView:
         self.attached = False
 
 
+class ViewTable:
+    """Lazy GroupView map: views materialize on first touch. Under
+    RAFT_TPU_TIER the group key space is LOGICAL (millions of ids, few
+    ever served); dense preallocation would defeat the tier's O(active)
+    host-memory claim. Indexing is list-compatible (`views[g]`), and a
+    view survives its group's eviction — watermark/epoch continuity
+    across hibernation cycles rides on that."""
+
+    def __init__(self):
+        self._views: dict[int, GroupView] = {}
+
+    def __getitem__(self, gid: int) -> GroupView:
+        v = self._views.get(gid)
+        if v is None:
+            v = self._views[gid] = GroupView(gid)
+        return v
+
+    def __iter__(self):
+        return iter(self._views.values())
+
+    def __len__(self) -> int:
+        return len(self._views)
+
+
 class CompletionRouter:
     def __init__(
         self,
@@ -97,9 +121,20 @@ class CompletionRouter:
         self.admission = admission
         self.coalescer = coalescer
         self.compact_lag = compact_lag
-        self.views = [GroupView(g) for g in range(n_groups)]
+        self.views = ViewTable()
         # per group: log index -> ProposeTicket (ours), in ascending order
-        self.cmd_log: list[dict] = [{} for _ in range(n_groups)]
+        # (lazy like the views — keyed by logical group id under the tier)
+        self.cmd_log: dict[int, dict] = {}
+        # lane <-> group indirection, rebindable by the tier (ServeLoop
+        # wires TierEngine.group_of_lane / lane_of_group here): defaults
+        # are the static identity layout. lane_to_group may return None
+        # (parked lane — no logical group resides there); base_lane may
+        # return None (the group is cold, no resident lanes).
+        self.lane_to_group = lambda lane: lane // self.v
+        self.base_lane = lambda gid: gid * self.v
+        # activity hook (lgid, round) — the tier's scorer feed, called
+        # once per active-lane bundle row
+        self.on_group_activity = None
         self.needs_resync: set[int] = set()
         self.round = 0  # the serving loop's clock, stamped before each run
         # apply-ordered (group, Command, tick) log for the scalar twin
@@ -119,13 +154,21 @@ class CompletionRouter:
         """Called right after coalescer.build: indexes were assigned, make
         them resolvable before the round's commits arrive."""
         for view, batch in injections:
-            log = self.cmd_log[view.gid]
+            log = self.cmd_log.setdefault(view.gid, {})
             for t in batch:
                 log[t.index] = t
 
     @property
     def inflight_cmds(self) -> int:
-        return sum(len(d) for d in self.cmd_log)
+        return sum(len(d) for d in self.cmd_log.values())
+
+    def groups_with_inflight(self) -> set:
+        """Groups holding attributed-but-unresolved proposals or released
+        read batches — the tier's eviction shield (evicting one of these
+        mid-flight would orphan its attribution)."""
+        out = {g for g, d in self.cmd_log.items() if d}
+        out.update(b.group for b, _ in self._served_batches)
+        return out
 
     # -- the egress sink --------------------------------------------------
 
@@ -142,7 +185,14 @@ class CompletionRouter:
         for j in range(count):
             lane_local = int(active[j])
             glane = lo + lane_local
-            view = self.views[glane // self.v]
+            gid = self.lane_to_group(glane)
+            if gid is None:
+                continue  # parked lane (tier): no logical group here
+            if self.on_group_activity is not None:
+                # the tier scorer's egress feed: this lane changed state
+                # this dispatch — exactly the activity signal, for free
+                self.on_group_activity(gid, self.round)
+            view = self.views[gid]
             if glane != view.leader_lane:
                 continue
             if (
@@ -161,9 +211,9 @@ class CompletionRouter:
 
     def _advance(self, view: GroupView, committed: int) -> None:
         """Resolve every attributed index in (watermark, committed]."""
-        log = self.cmd_log[view.gid]
+        log = self.cmd_log.get(view.gid)
         for idx in range(view.watermark + 1, committed + 1):
-            t = log.pop(idx, None)
+            t = log.pop(idx, None) if log else None
             if t is None:
                 continue  # not ours (election empty entry, pre-attach)
             t.commit_round = self.round
@@ -201,7 +251,7 @@ class CompletionRouter:
         view = self.views[batch.group]
         if glane != view.leader_lane:
             # released by a lane we no longer trust; re-batch the tickets
-            self.coalescer.read_wait[batch.group].extend(batch.tickets)
+            self.coalescer._read_wait(batch.group).extend(batch.tickets)
             return
         self._served_batches.append((batch, index))
         self._serve_ready_batches()
@@ -245,7 +295,13 @@ class CompletionRouter:
         reattached = 0
         for gid in sorted(self.needs_resync):
             view = self.views[gid]
-            lanes = range(gid * self.v, (gid + 1) * self.v)
+            base = self.base_lane(gid)
+            if base is None:
+                # the group went cold while flagged (tier eviction):
+                # nothing to attach to; the admit path re-flags it
+                self.needs_resync.discard(gid)
+                continue
+            lanes = range(base, base + self.v)
             leaders = [l for l in lanes if int(state[l]) == _LEADER]
             if len(leaders) != 1:
                 continue  # mid-election; keep the flag, retry next round
@@ -259,15 +315,14 @@ class CompletionRouter:
             # an attributed index. Every in-flight ticket re-proposes; a
             # command whose first copy did commit commits twice in the log
             # and the (session, seq) cursor collapses the second apply.
-            survivors = [
-                self.cmd_log[gid].pop(i) for i in sorted(self.cmd_log[gid])
-            ]
+            log = self.cmd_log.get(gid) or {}
+            survivors = [log.pop(i) for i in sorted(log)]
             for t in survivors:
                 t.index = None
                 t.inject_round = None
             self.coalescer.requeue_front(gid, survivors)
             for rt in self.coalescer.drop_group_reads(gid):
-                self.coalescer.read_wait[gid].append(rt)
+                self.coalescer._read_wait(gid).append(rt)
             if was_attached:  # the initial bootstrap attach is not a resync
                 self.metrics.counters.inc("epoch_resyncs")
             self.needs_resync.discard(gid)

@@ -1,0 +1,77 @@
+"""Hot/cold group tiering: O(resident) HBM, O(total) logical groups.
+
+Production multi-raft fleets quiesce idle ranges — at fleet scale most
+groups are cold at any instant, yet every logical group in this repo
+historically occupied a resident lane in the carry, making per-chip HBM
+the hard capacity cap (ROADMAP item 2). This package turns that ceiling
+into a working-set knob: a fixed pool of resident lanes steps at full
+device speed while quiescent groups hibernate in a host-RAM cold store
+(optionally spilled to disk) and re-admit on demand.
+
+Layering (host-role split per Podracer, PAPERS.md arxiv 2104.06272):
+
+  lanes.py    logical-group-id <-> resident-lane-slot mapping with a
+              free-list; the stable indirection the serve plane, WAL
+              addressing and trace explain() keep working through
+  scorer.py   host-side activity scorer (exponential decay + hysteresis:
+              separate evict/admit thresholds, minimum-residency
+              cooldown) fed by egress DeltaBundles + serve admissions
+  engine.py   the eviction/re-admission engine: batched device gather ->
+              compact host cold records -> batched scatter restore,
+              riding the existing dispatch/donation fences
+
+Everything is gated by RAFT_TPU_TIER=1 and fully elided off: with the
+knob unset no tier object is constructed, no tier jit is ever traced,
+and every cluster behaves exactly as before (the auditor's
+check_elision covers the "tier" counter plane).
+"""
+
+from __future__ import annotations
+
+from raft_tpu import config
+
+
+def tier_enabled() -> bool:
+    """Master switch (RAFT_TPU_TIER=1): build tier machinery at cluster
+    construction. Off => zero tier code paths, zero tier jits."""
+    return config.env_flag("RAFT_TPU_TIER", False)
+
+
+def evict_threshold() -> float:
+    """Activity score at or below which a resident group is evictable
+    (RAFT_TPU_TIER_EVICT). Must sit below the admit threshold — the
+    hysteresis band is what stops borderline groups from flapping."""
+    return config.env_float("RAFT_TPU_TIER_EVICT", 0.25)
+
+
+def admit_threshold() -> float:
+    """Accumulated score at which a cold group's queued admission is
+    granted (RAFT_TPU_TIER_ADMIT). A single serve arrival contributes
+    1.0, so the default admits on first touch."""
+    return config.env_float("RAFT_TPU_TIER_ADMIT", 1.0)
+
+
+def residency_cooldown() -> int:
+    """Minimum rounds a group stays resident after (re-)admission before
+    it is evict-eligible again (RAFT_TPU_TIER_COOLDOWN). The second half
+    of the anti-thrash hysteresis."""
+    return config.env_int("RAFT_TPU_TIER_COOLDOWN", 32)
+
+
+def score_halflife() -> float:
+    """Rounds for an activity score to decay to half
+    (RAFT_TPU_TIER_HALFLIFE)."""
+    return config.env_float("RAFT_TPU_TIER_HALFLIFE", 16.0)
+
+
+def spill_dir() -> str | None:
+    """Directory for cold-record disk spill (RAFT_TPU_TIER_SPILL_DIR);
+    None keeps every cold record in host RAM."""
+    return config.env_raw("RAFT_TPU_TIER_SPILL_DIR") or None
+
+
+def ram_budget_mb() -> int:
+    """Cold-store host-RAM budget in MiB (RAFT_TPU_TIER_RAM_MB) before
+    records spill to RAFT_TPU_TIER_SPILL_DIR; 0 = unbounded (never
+    spill unless a spill dir is set AND the budget is exceeded)."""
+    return config.env_int("RAFT_TPU_TIER_RAM_MB", 0)

@@ -1,0 +1,130 @@
+"""Resident-lane allocator: the logical-group-id <-> lane-slot mapping.
+
+The device carry holds a FIXED number of group slots (what every other
+layer calls `n_groups`); the tier makes that a cache over a larger
+logical id space. This module owns the binding:
+
+  - `slot` — a resident group slot in [0, n_slots); slot s owns carry
+    lanes [s*v, (s+1)*v) (plus a block/shard lane base for the blocked
+    drivers, applied by the coordinator, not here).
+  - `lgid` — a logical group id in [0, n_logical); stable for the life
+    of the group, the id the serve plane / WAL / explain() speak.
+
+Evicted slots go on a FIFO free list and are recycled for the next
+admission. The `GroupRef` handle is the stable indirection callers hold
+across evict/re-admit cycles: it resolves lazily through the allocator,
+so a ref taken before an eviction still answers correctly (resident ->
+its current slot, cold -> None) after re-admission lands the group on a
+different slot.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class LaneAllocator:
+    """Slot bookkeeping for one resident pool (one FusedCluster carry).
+
+    Pure host-side python/numpy — never touches device arrays. All
+    operations O(1); memory O(n_slots + resident), NOT O(n_logical):
+    cold groups that were never resident cost nothing here.
+    """
+
+    def __init__(self, n_slots: int, n_voters: int):
+        if n_slots <= 0:
+            raise ValueError(f"n_slots must be positive, got {n_slots}")
+        self.n_slots = int(n_slots)
+        self.v = int(n_voters)
+        # slot -> lgid (-1 = free/parked); lgid -> slot only for residents
+        self.lgid_of = np.full((self.n_slots,), -1, dtype=np.int64)
+        self.slot_of: dict[int, int] = {}
+        self.free: deque[int] = deque(range(self.n_slots))
+
+    # -- binding ---------------------------------------------------------
+
+    def bind_initial(self, lgid: int) -> int:
+        """Bind the next free slot at construction time (genesis cohort:
+        the groups resident from round 0, occupying slots in order so a
+        tier-on cluster with n_logical == n_slots is lane-identical to a
+        tier-off one)."""
+        return self.alloc(lgid)
+
+    def alloc(self, lgid: int) -> int:
+        """Bind `lgid` to a free slot; raises if full or already bound."""
+        lgid = int(lgid)
+        if lgid in self.slot_of:
+            raise ValueError(f"group {lgid} is already resident")
+        if not self.free:
+            raise RuntimeError("no free resident slots")
+        slot = self.free.popleft()
+        self.lgid_of[slot] = lgid
+        self.slot_of[lgid] = slot
+        return slot
+
+    def release(self, lgid: int) -> int:
+        """Unbind a resident group (eviction); its slot joins the free
+        list tail. Returns the freed slot."""
+        slot = self.slot_of.pop(int(lgid))
+        self.lgid_of[slot] = -1
+        self.free.append(slot)
+        return slot
+
+    # -- queries ---------------------------------------------------------
+
+    def resident(self, lgid: int) -> bool:
+        return int(lgid) in self.slot_of
+
+    def slot(self, lgid: int) -> int | None:
+        return self.slot_of.get(int(lgid))
+
+    def group_at(self, slot: int) -> int | None:
+        """Logical id bound to a slot, or None when the slot is parked."""
+        g = int(self.lgid_of[int(slot)])
+        return None if g < 0 else g
+
+    def lane_range(self, lgid: int) -> range | None:
+        """Carry-lane range of a resident group (block-local for blocked
+        drivers), or None when cold."""
+        s = self.slot_of.get(int(lgid))
+        if s is None:
+            return None
+        return range(s * self.v, (s + 1) * self.v)
+
+    def group_of_lane(self, lane: int) -> int | None:
+        """Logical id owning a carry lane, or None for parked lanes."""
+        return self.group_at(int(lane) // self.v)
+
+    def residents(self) -> list[int]:
+        """Currently bound logical ids (slot order, deterministic)."""
+        return [int(g) for g in self.lgid_of if g >= 0]
+
+    def free_slots(self) -> int:
+        return len(self.free)
+
+    def ref(self, lgid: int) -> "GroupRef":
+        return GroupRef(self, int(lgid))
+
+
+@dataclass(frozen=True)
+class GroupRef:
+    """Stable handle on a logical group, valid across evict/re-admit
+    cycles; resolves through the allocator at read time."""
+
+    alloc: LaneAllocator
+    lgid: int
+
+    @property
+    def resident(self) -> bool:
+        return self.alloc.resident(self.lgid)
+
+    @property
+    def slot(self) -> int | None:
+        return self.alloc.slot(self.lgid)
+
+    @property
+    def lanes(self) -> range | None:
+        return self.alloc.lane_range(self.lgid)

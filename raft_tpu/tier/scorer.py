@@ -1,0 +1,153 @@
+"""Host-side group activity scorer with hysteresis.
+
+Feeds on the two signals the host already sees for free:
+
+  - egress DeltaBundles: the O(active) Ready stream names exactly the
+    lanes that changed this dispatch — the router's `on_bundle` path
+    forwards (lgid, weight) touches here without any extra device work.
+  - serve admissions: every admitted proposal/read touches its group
+    (weight 1.0), and a *miss* on a cold group is itself the admission
+    signal that queues re-admission.
+
+Scores decay exponentially (half-life in rounds, lazy evaluation: a
+score is only brought current when read, so cold groups cost nothing
+per round). Hysteresis has two parts, both required to stop thrash:
+
+  - separate thresholds: evict at score <= evict_thresh, admit a queued
+    cold group at score >= admit_thresh, with admit_thresh >
+    evict_thresh so a group bouncing around one boundary doesn't flap;
+  - minimum-residency cooldown: a freshly (re-)admitted group is not
+    evict-eligible for `cooldown` rounds regardless of score. Groups
+    passed over ONLY because of cooldown count as thrash_suppressed —
+    the metric that shows the hysteresis doing work.
+
+Memory is O(groups touched recently): entries decayed below EPSILON are
+dropped on read/compact, never resurrected until touched again.
+"""
+
+from __future__ import annotations
+
+from raft_tpu import tier as tier_cfg
+
+# scores below this are dead: entry dropped, reads return 0.0
+EPSILON = 1e-4
+
+
+class ActivityScorer:
+    """Exponential-decay activity scores over logical group ids."""
+
+    def __init__(
+        self,
+        *,
+        halflife: float | None = None,
+        evict_thresh: float | None = None,
+        admit_thresh: float | None = None,
+        cooldown: int | None = None,
+    ):
+        self.halflife = float(
+            tier_cfg.score_halflife() if halflife is None else halflife
+        )
+        if self.halflife <= 0:
+            raise ValueError(f"halflife must be > 0, got {self.halflife}")
+        self.evict_thresh = float(
+            tier_cfg.evict_threshold() if evict_thresh is None
+            else evict_thresh
+        )
+        self.admit_thresh = float(
+            tier_cfg.admit_threshold() if admit_thresh is None
+            else admit_thresh
+        )
+        self.cooldown = int(
+            tier_cfg.residency_cooldown() if cooldown is None else cooldown
+        )
+        self._decay = 0.5 ** (1.0 / self.halflife)
+        # lgid -> (score, round it was last brought current)
+        self._score: dict[int, tuple[float, int]] = {}
+        # lgid -> round of last (re-)admission, for the cooldown window
+        self._admitted_round: dict[int, int] = {}
+        self.thrash_suppressed = 0
+
+    # -- signal ingestion ------------------------------------------------
+
+    def touch(self, lgid: int, round_id: int, weight: float = 1.0) -> None:
+        """Record activity for a group at a round (monotone round ids;
+        out-of-order touches are clamped to the entry's clock)."""
+        lgid = int(lgid)
+        score = self._current(lgid, round_id) + float(weight)
+        self._score[lgid] = (score, max(round_id, self._clock(lgid)))
+
+    def note_admitted(self, lgid: int, round_id: int) -> None:
+        """Stamp a (re-)admission: starts the cooldown window."""
+        self._admitted_round[int(lgid)] = int(round_id)
+
+    def note_evicted(self, lgid: int) -> None:
+        self._admitted_round.pop(int(lgid), None)
+
+    # -- queries ---------------------------------------------------------
+
+    def score(self, lgid: int, round_id: int) -> float:
+        return self._current(int(lgid), round_id)
+
+    def admit_ready(self, lgid: int, round_id: int) -> bool:
+        """Has this (cold, queued) group accumulated enough signal?"""
+        return self._current(int(lgid), round_id) >= self.admit_thresh
+
+    def evict_eligible(self, lgid: int, round_id: int) -> bool:
+        """Quiet enough AND out of its post-admission cooldown. Counts a
+        cooldown-only block as thrash_suppressed (the group WOULD have
+        been evicted but hysteresis held it resident)."""
+        lgid = int(lgid)
+        if self._current(lgid, round_id) > self.evict_thresh:
+            return False
+        born = self._admitted_round.get(lgid)
+        if born is not None and round_id - born < self.cooldown:
+            self.thrash_suppressed += 1
+            return False
+        return True
+
+    def pick_victims(
+        self,
+        residents,
+        need: int,
+        round_id: int,
+        protect: set[int] | None = None,
+    ) -> list[int]:
+        """Up to `need` evict-eligible residents, quietest first.
+        `protect` shields groups with in-flight serve work."""
+        if need <= 0:
+            return []
+        protect = protect or set()
+        eligible = [
+            (self._current(g, round_id), g)
+            for g in residents
+            if g not in protect and self.evict_eligible(g, round_id)
+        ]
+        eligible.sort()
+        return [g for _, g in eligible[:need]]
+
+    def compact(self) -> None:
+        """Drop dead entries (score below EPSILON at their own clock);
+        bounds memory to recently-touched groups."""
+        self._score = {
+            g: (s, r) for g, (s, r) in self._score.items() if s >= EPSILON
+        }
+
+    # -- internals -------------------------------------------------------
+
+    def _clock(self, lgid: int) -> int:
+        ent = self._score.get(lgid)
+        return ent[1] if ent is not None else 0
+
+    def _current(self, lgid: int, round_id: int) -> float:
+        ent = self._score.get(lgid)
+        if ent is None:
+            return 0.0
+        score, last = ent
+        dt = round_id - last
+        if dt > 0:
+            score *= self._decay ** dt
+            if score < EPSILON:
+                del self._score[lgid]
+                return 0.0
+            self._score[lgid] = (score, round_id)
+        return score

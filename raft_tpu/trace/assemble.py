@@ -174,10 +174,15 @@ def explain(
     *,
     events=None,
     lifecycle=None,
+    spans=None,
     v: int = 1,
 ) -> list[str]:
     """Round-ordered, human-readable timeline of one raft group: its
-    lanes' recorded transitions plus its proposals' lifecycles."""
+    lanes' recorded transitions plus its proposals' lifecycles, plus —
+    when a host SpanRecorder (or its span list) is passed — the group's
+    tier transitions (tier_evict / tier_admit, RAFT_TPU_TIER). Under the
+    tier, `group` is the LOGICAL id for lifecycle/span lines; device
+    event lanes are physical and follow the group's current slot."""
     lines: list[tuple[int, int, str]] = []  # (round, order, text)
     if events is not None:
         for rnd, lane, kind, arg in np.asarray(events).tolist():
@@ -204,6 +209,28 @@ def explain(
                 f"injected r{inject}, committed r{commit}, "
                 f"notified r{notify} "
                 f"(+{int(notify) - int(submit)} rounds)",
+            ))
+    if spans is not None:
+        for name, _t0, _dur, labels in getattr(spans, "spans", spans):
+            if not str(name).startswith("tier_") or not labels:
+                continue
+            if int(labels.get("group", -1)) != group:
+                continue
+            rnd = int(labels.get("round", 0))
+            if name == "tier_evict":
+                verb = "tier: evicted to cold store"
+            elif labels.get("genesis"):
+                verb = "tier: born (genesis admission)"
+            else:
+                verb = "tier: re-admitted from cold store"
+            extra = ", ".join(
+                f"{k}={labels[k]}"
+                for k in sorted(labels)
+                if k not in ("group", "round")
+            )
+            lines.append((
+                rnd, 2,
+                f"r{rnd:05d}  {verb}" + (f" ({extra})" if extra else ""),
             ))
     lines.sort(key=lambda t: (t[0], t[1]))
     return [s for _, _, s in lines]

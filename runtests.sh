@@ -90,7 +90,8 @@ smokes() {
     && run_bench benches/trace_ab.py \
     && run_bench benches/diet_ab.py --smoke \
     && run_bench benches/multichip_ab.py --smoke \
-    && run_bench benches/paged_ab.py --smoke
+    && run_bench benches/paged_ab.py --smoke \
+    && run_bench benches/tier_ab.py --smoke
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
@@ -158,6 +159,11 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
     # signatures, plus one K=4 interpreted megakernel on a paged carry
     # and an 8-device sharded identity run
     run_chunk tests/test_paged.py
+    # the hot/cold tiering suite gets its own process: module-scoped tier
+    # clusters + ServeLoops (tier carries are their own jit signatures),
+    # the mid-election/mid-confchange eviction chaos soak, and the 1M
+    # logical-group Zipfian serve acceptance demo
+    run_chunk tests/test_tier.py
     # the mesh-blocked driver gets its own process before test_sharded:
     # its sharded x blocked twins are all 8-device shard_map programs
     # (plus one subprocess A/B child trio), same crash profile as

@@ -1,0 +1,495 @@
+"""Hot/cold tiering (raft_tpu/tier/): lane recycling, the hysteresis
+scorer, cold-record round-trips, and the acceptance oracles of the
+hibernation tier — suspend-to-RAM eviction must be bit-exact (a group
+evicted MID-ELECTION or MID-CONFCHANGE and re-admitted lands on the
+identical trajectory as a never-evicted twin), committed entries never
+regress, and the counter identity
+
+    tier_evictions - tier_admissions == tier_cold
+
+holds exactly (genesis admissions count as tier_births, never
+tier_admissions).
+
+Device-backed tests share one module-scoped tier cluster and one tier
+ServeLoop to keep the XLA:CPU compile count low; every test asserts on
+deltas/derived state so ordering stays free."""
+
+import hashlib
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from raft_tpu.analysis.registry import PROFILES, env_profile
+from raft_tpu.serve.admission import (
+    REJECT_COLD_GROUP,
+    REJECT_NO_LEADER,
+    Rejected,
+)
+from raft_tpu.tier.engine import ColdRecord, ColdStore, PARKED_TIMEOUT
+from raft_tpu.tier.lanes import LaneAllocator
+from raft_tpu.tier.scorer import ActivityScorer
+
+DIGEST_FIELDS = (
+    "term", "vote", "lead", "state", "committed", "last",
+    "log_term", "log_type", "log_bytes", "error_bits",
+)
+
+_TIER_ENV = dict(PROFILES["tier"], RAFT_TPU_METRICS="1")
+
+
+# -- host-side layers (no device) -------------------------------------------
+
+
+def test_lane_allocator_recycles_fifo_and_keeps_refs_stable():
+    a = LaneAllocator(4, 3)
+    for g in (10, 11, 12, 13):
+        a.bind_initial(g)
+    assert a.residents() == [10, 11, 12, 13]
+    assert a.free_slots() == 0
+    r11 = a.ref(11)
+    s11 = a.release(11)
+    assert s11 == 1 and not r11.resident and r11.slot is None
+    a.release(13)
+    # FIFO recycling: the first freed slot is handed out first
+    assert a.alloc(99) == 1 and a.alloc(11) == 3
+    assert r11.resident and r11.slot == 3
+    assert a.group_of_lane(3 * 3) == 11 and a.group_of_lane(5) == 99
+    assert list(a.lane_range(11)) == [9, 10, 11]
+    full = LaneAllocator(1, 3)
+    full.alloc(5)
+    with pytest.raises(RuntimeError):
+        full.alloc(6)  # no free slot
+    with pytest.raises(ValueError):
+        full.alloc(5)  # double bind
+
+
+def test_scorer_hysteresis_admit_evict_and_cooldown():
+    sc = ActivityScorer(
+        evict_thresh=0.25, admit_thresh=1.0, cooldown=8, halflife=2.0,
+    )
+    sc.touch(7, 0)
+    assert sc.admit_ready(7, 0)          # fresh touch sits at 1.0
+    assert not sc.admit_ready(7, 1)      # one round of decay misses
+    sc.touch(7, 1)
+    assert sc.admit_ready(7, 1)          # second touch crosses
+    sc.note_admitted(7, 1)
+    # still hot: the score gate alone refuses (no thrash counted)
+    assert not sc.evict_eligible(7, 2)
+    assert sc.thrash_suppressed == 0
+    # quiet but inside the min-residency cooldown: hysteresis holds it
+    assert not sc.evict_eligible(7, 7)
+    assert sc.thrash_suppressed == 1
+    # quiet AND past the cooldown window
+    assert sc.evict_eligible(7, 20)
+    # victims come quietest-first and respect the protect set
+    sc.touch(1, 10, weight=0.3)
+    sc.touch(2, 18, weight=0.4)
+    assert sc.pick_victims([1, 2, 7], 2, 20, protect={7}) == [1, 2]
+
+
+def test_cold_store_spill_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    def rec(lgid):
+        st = [rng.integers(0, 99, (3, 4)).astype(np.int32),
+              rng.random((3,)) < 0.5]          # bool leaf bit-packs 8:1
+        fb = [rng.integers(0, 9, (3, 2)).astype(np.uint16)]
+        return ColdRecord(lgid, st, fb, watermark=5, evict_round=9), st, fb
+
+    cs = ColdStore(spill_dir=str(tmp_path), ram_budget_mb=0)
+    cs.ram_budget = 1  # force every record past the RAM budget
+    made = {}
+    for g in (3, 4):
+        r, st, fb = rec(g)
+        made[g] = (st, fb)
+        cs.put(r)
+    assert len(cs) == 2 and 3 in cs and cs.spill_bytes > 0
+    for g in (3, 4):
+        st, fb = made[g]
+        got = cs.pop(g)
+        got_st, got_fb = got.rows()
+        for a, b in zip(st, got_st):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(fb, got_fb):
+            np.testing.assert_array_equal(a, b)
+        assert got.watermark == 5 and got.evict_round == 9
+    assert len(cs) == 0 and cs.bytes() == 0
+
+
+def test_tier_off_cluster_has_no_tier_and_elides_every_tier_op():
+    from raft_tpu.analysis.jaxpr_audit import traced_counter_deltas
+    from raft_tpu.ops.fused import FusedCluster
+
+    with env_profile(PROFILES["planes_off"]):
+        cl = FusedCluster(2, 3, seed=1)
+    assert cl.tier is None
+    with pytest.raises(ValueError):
+        with env_profile(PROFILES["planes_off"]):
+            FusedCluster(2, 3, seed=1, logical_groups=8)
+    # tracing the round program bumps no tier counter: RAFT_TPU_TIER=0
+    # means zero tier primitives in any compiled program
+    _, deltas = traced_counter_deltas(cl.audit_programs()[0])
+    assert deltas.get("tier", 0) == 0
+
+
+# -- device-backed: one tier FusedCluster -----------------------------------
+
+
+@pytest.fixture(scope="module")
+def tier_cluster():
+    from raft_tpu.ops.fused import FusedCluster
+
+    with env_profile(_TIER_ENV):
+        c = FusedCluster(4, 3, seed=3, logical_groups=8)
+    assert c.tier is not None and c.tier.n_logical == 8
+    return c
+
+
+def _ensure_elected(c, max_rounds=400):
+    spent = 0
+    while len(c.leader_lanes()) < c.g and spent < max_rounds:
+        c.run(8, auto_propose=True)
+        spent += 8
+    assert len(c.leader_lanes()) == c.g
+
+
+def _group_rows(c, g):
+    st = c.host_state()
+    lane0 = c.tier.lane_of_group(g)
+    sl = slice(lane0, lane0 + c.v)
+    return {k: np.asarray(getattr(st, k))[sl].copy() for k in DIGEST_FIELDS}
+
+
+def test_evict_admit_roundtrip_is_bit_exact(tier_cluster):
+    c = tier_cluster
+    eng = c.tier
+    _ensure_elected(c)
+    g = eng.residents()[1]
+    lane0 = eng.lane_of_group(g)
+    leader = [l for l in c.leader_lanes() if lane0 <= l < lane0 + c.v]
+    before = _group_rows(c, g)
+    ev0, ad0 = eng.evictions, eng.admissions
+
+    eng.request_evict(g)
+    evicted, admitted = eng.apply(1000)
+    assert evicted == [g] and admitted == []
+    assert not eng.resident(g) and g in eng.cold
+    # the freed slot parks muted with anti-campaign sentinels
+    slot0 = lane0  # genesis slot lanes == the group's old lanes
+    m = np.asarray(c.mute)
+    assert m[slot0:slot0 + c.v].all()
+    rto = np.asarray(c.host_state().randomized_election_timeout)
+    assert (rto[slot0:slot0 + c.v] == PARKED_TIMEOUT).all()
+
+    eng.request_admit(g, 1000)  # same-round touch sits at the threshold
+    evicted, admitted = eng.apply(1000)
+    assert admitted == [g] and eng.resident(g) and g not in eng.cold
+    after = _group_rows(c, g)
+    for k in DIGEST_FIELDS:
+        np.testing.assert_array_equal(before[k], after[k], err_msg=k)
+    assert not np.asarray(c.mute)[slot0:slot0 + c.v].any()
+    # the leader survived hibernation: no re-election on the hot path
+    assert leader and leader[0] in set(c.leader_lanes())
+    assert eng.evictions - ev0 == 1 and eng.admissions - ad0 == 1
+    assert eng.evictions - eng.admissions == len(eng.cold)
+    c.run(8, auto_propose=True)
+    c.check_no_errors()
+
+
+def test_genesis_admission_births_and_counter_identity(tier_cluster):
+    c = tier_cluster
+    eng = c.tier
+    _ensure_elected(c)
+    newborn = 7  # logical id outside every cohort so far
+    if eng.resident(newborn):  # ordering-independent: already born
+        pytest.skip("newborn already admitted by a previous test")
+    b0, e0 = eng.births, eng.evictions
+    eng.request_admit(newborn, 2000)
+    eng.apply(2000)
+    assert eng.resident(newborn)
+    assert eng.births - b0 == 1
+    # the full pool had to evict a quiet victim to make room
+    assert eng.evictions - e0 == 1
+    assert eng.evictions - eng.admissions == len(eng.cold)
+    # the newborn is a live follower that can elect and serve
+    _ensure_elected(c)
+    stats = eng.stats()
+    assert stats["tier_resident"] == c.g
+    assert stats["tier_births"] == eng.births
+    # metrics fold: the cluster snapshot mirrors the tier counters
+    snap = c.metrics_snapshot()["counters"]
+    assert snap["tier_evictions"] == eng.evictions
+    assert snap["tier_cold"] == len(eng.cold)
+
+
+def test_explain_renders_tier_transitions(tier_cluster):
+    from raft_tpu.trace.assemble import explain
+
+    c = tier_cluster
+    eng = c.tier
+    _ensure_elected(c)
+    g = eng.residents()[0]
+    rec = SimpleNamespace(spans=[])
+    eng.set_spans(rec)
+    try:
+        eng.request_evict(g)
+        eng.apply(3000)
+        eng.request_admit(g, 3001)
+        eng.request_admit(g, 3002)
+        eng.apply(3002)
+    finally:
+        eng.set_spans(None)
+    assert eng.resident(g)
+    lines = explain(g, spans=rec, v=c.v)
+    assert any("tier: evicted to cold store" in l for l in lines)
+    assert any("tier: re-admitted from cold store" in l for l in lines)
+    assert any("watermark=" in l for l in lines)
+
+
+# -- the chaos soak: hibernate mid-election and mid-confchange ---------------
+
+
+def _digest_all(c) -> str:
+    st = c.host_state()
+    h = hashlib.sha256()
+    for name in DIGEST_FIELDS:
+        h.update(np.ascontiguousarray(np.asarray(getattr(st, name))).tobytes())
+    return h.hexdigest()
+
+
+def _committed_total(c) -> int:
+    return int(np.asarray(c.state.committed, np.int64).sum())
+
+
+def test_chaos_soak_evict_mid_election_and_mid_confchange():
+    """Suspend-to-RAM under fire: groups evicted while votes and joint-
+    consensus entries are in flight, re-admitted at the same dispatch
+    boundary, must land the IDENTICAL trajectory as a never-evicted twin
+    — and committed entries never regress."""
+    from raft_tpu.config import Shape
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.testing.confchange_flow import replace_leader_joint_flow
+
+    def mk():
+        shape = Shape(
+            n_lanes=4 * 4, max_peers=4, log_window=32,
+            max_msg_entries=2, max_inflight=2,
+        )
+        with env_profile(PROFILES["tier"]):
+            return FusedCluster(
+                4, 4, seed=7, shape=shape, learner_ids=(4,),
+            )
+
+    a, b = mk(), mk()
+
+    def hiccup(g, r):
+        eng = a.tier
+        eng.request_evict(g)
+        ev, _ = eng.apply(r)
+        assert ev == [g] and g in eng.cold
+        eng.request_admit(g, r)
+        _, ad = eng.apply(r)
+        assert ad == [g]
+
+    # kick every group's election, then hibernate group 1 while the vote
+    # messages are still in the fabric
+    hups = {l: True for l in range(0, a.g * a.v, a.v)}
+    for c in (a, b):
+        c.run(1, ops=c.ops(hup=hups), do_tick=False)
+        c.run(1, auto_propose=True)
+    hiccup(1, 2)
+    for c in (a, b):
+        c.run(3, auto_propose=True)
+    assert len(a.leader_lanes()) == a.g == len(b.leader_lanes())
+    assert _digest_all(a) == _digest_all(b)
+
+    # the joint-consensus replace-leader flow, hibernating two groups in
+    # A at every phase boundary (enter-joint pending, transfer pending,
+    # leave-joint pending — each a mid-confchange suspend)
+    committed_floor = _committed_total(a)
+    phases = []
+
+    def on_phase(name):
+        phases.append(name)
+        hiccup(0, 100 + len(phases))
+        hiccup(2, 200 + len(phases))
+        nonlocal committed_floor
+        now = _committed_total(a)
+        assert now >= committed_floor  # no committed-entry loss, ever
+        committed_floor = now
+
+    replace_leader_joint_flow(a, on_phase=on_phase)
+    replace_leader_joint_flow(b)
+    assert len(phases) >= 3
+    assert _digest_all(a) == _digest_all(b)
+    assert _committed_total(a) >= committed_floor
+    assert a.tier.evictions - a.tier.admissions == len(a.tier.cold) == 0
+    a.check_no_errors()
+
+
+# -- device-backed: the serving loop over the tier ---------------------------
+
+
+@pytest.fixture(scope="module")
+def tier_loop():
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.serve.loop import ServeLoop
+
+    env = dict(
+        _TIER_ENV,
+        RAFT_TPU_EGRESS="1",
+        RAFT_TPU_TIER_HALFLIFE="2",
+        RAFT_TPU_TIER_COOLDOWN="2",
+    )
+    with env_profile(env):
+        sl = ServeLoop(FusedCluster(4, 3, seed=3, logical_groups=12))
+        sl.bootstrap()
+    return sl
+
+
+def _session_where(sl, pred, limit=5000):
+    for i in range(limit):
+        s = sl.open_session(f"tn{i}")
+        if pred(s.group):
+            return s
+        sl.close_session(s)
+    raise AssertionError("no session matched the placement predicate")
+
+
+def test_serve_cold_miss_is_typed_retry_never_a_drop(tier_loop):
+    sl = tier_loop
+    resident = set(sl.tier.residents())
+    s = _session_where(sl, lambda g: g not in resident)
+    r = sl.put(s, "ck", "cv")
+    assert isinstance(r, Rejected) and r.reason == REJECT_COLD_GROUP
+    assert f"group={s.group}" in (r.detail or "")
+    ticket = None
+    waited = 0
+    for waited in range(1, 129):
+        sl.step()
+        sl.flush()
+        ticket = sl.put(s, "ck", "cv")
+        if not isinstance(ticket, Rejected):
+            break
+    assert not isinstance(ticket, Rejected), "never re-admitted"
+    assert waited < 128
+    assert sl.drain(300)
+    assert ticket.done and ticket.applied
+    assert sl.kv.get(s.group, "ck", sl.round) == "cv"
+    st = sl.tier.stats()
+    assert st["tier_evictions"] - st["tier_admissions"] == st["tier_cold"]
+    assert sl.digest() == sl.twin_digest()
+
+
+def test_serve_hot_path_unaffected_and_metrics_fold(tier_loop):
+    sl = tier_loop
+    resident = set(sl.tier.residents())
+    s = _session_where(sl, lambda g: g in resident)
+    t = sl.put(s, "hk", "hv")
+    assert not isinstance(t, Rejected)
+    assert sl.drain(300) and t.done and t.applied
+    ctr = sl.cluster.metrics_snapshot()["counters"]
+    st = sl.tier.stats()
+    for k, v in st.items():
+        assert ctr[k] == v
+    assert ctr["tier_resident"] == sl.cluster.g
+
+
+def test_million_logical_groups_zipf_serve():
+    """The acceptance demo: >= 1M logical groups over a few hundred
+    resident lanes, Zipf-popular tenants, zero committed-entry loss and
+    exact counter accounting while cold misses churn the pool."""
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.serve.loop import ServeLoop
+
+    L = 1 << 20
+    # halflife 8: a tenant recurring every few rounds accumulates score
+    # across misses (halflife 1 would decay each touch below the admit
+    # threshold before the next dispatch-boundary apply)
+    env = dict(
+        _TIER_ENV,
+        RAFT_TPU_EGRESS="1",
+        RAFT_TPU_TIER_HALFLIFE="8",
+        RAFT_TPU_TIER_COOLDOWN="0",
+    )
+    with env_profile(env):
+        sl = ServeLoop(FusedCluster(64, 3, seed=11, logical_groups=L))
+        sl.bootstrap()
+    lanes = int(sl.cluster.state.term.shape[0])
+    assert sl.logical_groups == L
+    assert lanes <= 128 * 1024 and L // lanes >= 8
+
+    rng = np.random.default_rng(5)
+    names = rng.zipf(1.3, size=300)  # few hot names, long one-off tail
+    sessions: dict[str, object] = {}
+    tickets = []
+    cold_rejects = 0
+    for i, n in enumerate(names):
+        tenant = f"z{int(n)}"
+        s = sessions.get(tenant)
+        if s is None:
+            s = sessions[tenant] = sl.open_session(tenant)
+        r = sl.put(s, f"k{i}", i)
+        if isinstance(r, Rejected):
+            # typed retry, never a drop: cold miss, or a freshly-born
+            # group still electing its first leader
+            assert r.reason in (REJECT_COLD_GROUP, REJECT_NO_LEADER)
+            if r.reason == REJECT_COLD_GROUP:
+                cold_rejects += 1
+        else:
+            tickets.append(r)
+        sl.step()
+    assert sl.drain(600)
+    assert tickets and all(t.done and t.applied for t in tickets)
+    assert cold_rejects > 0  # the tail really missed
+    st = sl.tier.stats()
+    assert st["tier_evictions"] - st["tier_admissions"] == st["tier_cold"]
+    assert st["tier_births"] > 0
+    assert st["tier_resident"] == 64
+    assert sl.digest() == sl.twin_digest()
+    sl.cluster.check_no_errors()
+
+
+# -- device-backed: the blocked scheduler path -------------------------------
+
+
+def test_blocked_tier_cross_block_addressing_and_roundtrip():
+    from raft_tpu.scheduler import BlockedFusedCluster
+    from raft_tpu.serve.loop import ServeLoop
+
+    with env_profile(dict(_TIER_ENV, RAFT_TPU_EGRESS="1")):
+        cl = BlockedFusedCluster(
+            8, 3, block_groups=4, seed=5, logical_groups=32
+        )
+        assert cl.tier is not None and cl.tier.n_logical == 32
+        # block 0 owns [0,16): genesis 0..3; block 1 owns [16,32)
+        assert sorted(cl.tier.residents()) == [0, 1, 2, 3, 16, 17, 18, 19]
+        assert cl.tier.lane_of_group(16) == 12
+        assert cl.tier.group_of_lane(12) == 16
+        assert cl.tier.group_of_lane(0) == 0
+        sl = ServeLoop(cl)
+        sl.bootstrap()
+    cl.tier.request_evict(17)
+    sl.step()
+    sl.flush()
+    assert not cl.tier.resident(17)
+    st = cl.tier.stats()
+    assert st["tier_evictions"] - st["tier_admissions"] == st["tier_cold"] == 1
+    s = _session_where(sl, lambda g: g == 17)
+    r = sl.put(s, "k17", "v17")
+    assert isinstance(r, Rejected) and r.reason == REJECT_COLD_GROUP
+    ticket = None
+    for _ in range(64):
+        sl.step()
+        sl.flush()
+        ticket = sl.put(s, "k17", "v17")
+        if not isinstance(ticket, Rejected):
+            break
+    assert not isinstance(ticket, Rejected), "never re-admitted"
+    assert sl.drain(300)
+    assert sl.kv.get(17, "k17", sl.round) == "v17"
+    assert sl.digest() == sl.twin_digest()
+    st = cl.tier.stats()
+    assert st["tier_evictions"] - st["tier_admissions"] == st["tier_cold"]
+    snap = cl.metrics_snapshot()["counters"]
+    assert snap["tier_admissions"] == st["tier_admissions"]
