@@ -14,8 +14,11 @@ provisioning (AB_POOL_PAGES override); the Zipfian tail is what makes
 that safe, and error_bits would flag (never silently drop) if not.
 
 Arm matrix (fresh subprocess per arm, planes enabled like diet_ab.py):
-paged off/on x engine (xla, pallas K=1, pallas K=AB_K). One bench JSON
-line per arm plus a summary, with the probes in `extra`:
+paged off/on x engine (xla, pallas K=1, pallas K=AB_K), then the same
+three engines again with RAFT_TPU_PAGED_INKERNEL=1 x diet off/on (six
+more arms; the pallas in-kernel arms pin RAFT_TPU_PALLAS_TILE =
+lanes/2 so the pool splits into two per-grid-step segments). One bench
+JSON line per arm plus a summary, with the probes in `extra`:
 
   - ms_per_round: wall clock over AB_ITERS timed Zipfian sweeps
   - resident_bytes_per_lane: nbytes of the between-dispatch carry
@@ -24,13 +27,22 @@ line per arm plus a summary, with the probes in `extra`:
   - paged_*: pool occupancy / fault / exhaustion counters (paged arm)
 
 Asserted invariants:
-  - all six arms end on ONE identical sha256 digest of the host_state
-    trajectory INCLUDING the log columns — paging is invisible, across
-    engines, at every K
+  - ALL arms (six host-boundary + six in-kernel) end on ONE identical
+    sha256 digest of the host_state trajectory INCLUDING the log
+    columns — paging is invisible, across engines, at every K, at
+    either paging boundary, diet on or off
   - error_bits stays zero everywhere (no silent ERR_PAGE_EXHAUSTED)
   - the pallas children really ran pallas: no engine fallback
   - paged-on resident bytes/lane STRICTLY lower than paged-off, on every
     engine, on every backend (CPU included)
+  - compiled-program probe (parent process, CPU included): the
+    in-kernel pallas round program moves STRICTLY fewer bytes/round
+    than the host-boundary paged pallas one (ledger.round_bytes_probe,
+    the same computation `--ledger` budgets) at K=1 and K=AB_K, and its
+    temp allocation stays under the `round.pallas.paged_inkernel`
+    record's hard cap scaled to the probe geometry — the two
+    whole-fleet [N, W] gather/scatter passes and the full-window HBM
+    temporary are really gone from the lowering
   - [TPU only] paged-on ms/round <= AB_TOL x paged-off per engine
     (groups*ticks/s flat or better)
 
@@ -63,8 +75,13 @@ W, PAGE_WINDOW, PAGE_ENTRIES = 16, 8, 4
 def default_pool(groups: int, v: int) -> int:
     """About one page per two lanes — full provisioning would be
     kmax = ceil((W - W_res) / PE) + 1 = 3 pages per lane, but only the
-    Zipf-hot groups outrun their resident window at all."""
-    return max(16, groups * v // 2 + 8)
+    Zipf-hot groups outrun their resident window at all. The fixed
+    +kmax+1 headroom covers the in-kernel arms: per-ROUND reallocation
+    sees transient mid-dispatch depth peaks the dispatch-boundary
+    allocator never materializes (the same trajectory, paged at a finer
+    boundary, briefly holds a few more pages). Even by construction, so
+    the pallas in-kernel arms' two-segment split stays legal."""
+    return max(16, groups * v // 2 + 8) + 4
 
 
 def child():
@@ -145,8 +162,14 @@ def child():
     for name in DIGEST_FIELDS:
         digest.update(np.ascontiguousarray(np.asarray(getattr(st, name))).tobytes())
     c.check_no_errors()
+    inkernel = config.env_str("RAFT_TPU_PAGED_INKERNEL", default="0")
     print(json.dumps({
-        "config": f"paged_ab:{engine}:paged={config.env_str('RAFT_TPU_PAGED', default='0')}",
+        "config": (
+            f"paged_ab:{engine}"
+            f":paged={config.env_str('RAFT_TPU_PAGED', default='0')}"
+            f":inkernel={inkernel}"
+            f":diet={config.env_str('RAFT_TPU_DIET', default='0')}"
+        ),
         "value": round(ms_per_round, 4),
         "unit": "ms/round",
         "extra": {
@@ -154,6 +177,8 @@ def child():
             "engine_after": c.engine,
             "fallbacks": ENGINE_EVENTS.get("engine_pallas_fallback"),
             "paged": c.paged is not None,
+            "paged_inkernel": bool(getattr(c, "_paged_inkernel", False)),
+            "paged_segs": getattr(c, "_paged_segs", None),
             "ms_per_round": ms_per_round,
             "resident_bytes_per_lane": resident / lanes,
             "groups_ticks_per_s": groups * 1e3 / max(ms_per_round, 1e-9),
@@ -162,6 +187,105 @@ def child():
             **stats,
         },
     }), flush=True)
+
+
+# probe_gate geometry: the smallest legal in-kernel split (12 lanes,
+# tile 6 -> two pool segments) at K=1, so the two AOT lowerings stay
+# cheap even on a single-core CPU host. Direction of the bytes win is
+# geometry-independent: in-kernel paging deletes the two whole-fleet
+# [N, W] gather/scatter passes regardless of N.
+PROBE_GROUPS, PROBE_V, PROBE_TILE, PROBE_POOL = 4, 3, 6, 16
+
+# hard temp budget for the in-kernel lowering at the probe geometry,
+# mirroring the `round.pallas.paged_inkernel` registry record's
+# temp_cap_per_lane: measured 2430.7 B/lane; one full-window log-column
+# set is 192 B/lane at W=16, so headroom (~119) is deliberately smaller
+# than the smallest full-window temporary that could creep back.
+PROBE_TEMP_CAP_PER_LANE = 2550.0
+
+
+def probe_gate(ab_k: int) -> list[str]:
+    """Parent-process compiled-program gate (every backend, CPU
+    included): AOT-lower the host-boundary and in-kernel paged pallas
+    round programs at a fixed small geometry and compare the ledger's
+    own bytes-moved computation (`round_bytes_probe`, the number the
+    `--ledger` gate budgets). The in-kernel lowering must move strictly
+    fewer bytes per round — the whole-fleet page_in/page_out passes are
+    really gone — and its temp allocation must stay under a hard cap
+    sized so any full-window [N, W] temporary trips it."""
+    from raft_tpu.config import Shape
+    from raft_tpu.ops import fused
+    from raft_tpu.analysis import ledger
+
+    knobs = {
+        "RAFT_TPU_PAGED": "1",
+        "RAFT_TPU_PAGED_INKERNEL": "0",
+        "RAFT_TPU_PAGE_WINDOW": str(PAGE_WINDOW),
+        "RAFT_TPU_PAGE_ENTRIES": str(PAGE_ENTRIES),
+        "RAFT_TPU_POOL_PAGES": str(PROBE_POOL),
+        "RAFT_TPU_PALLAS_TILE": str(PROBE_TILE),
+        "RAFT_TPU_PALLAS_AUTOTUNE": "0",
+    }
+    lanes = PROBE_GROUPS * PROBE_V
+    shape = Shape(
+        n_lanes=lanes, max_peers=PROBE_V, log_window=W,
+        max_msg_entries=2, max_inflight=2, max_read_index=2,
+    )
+    saved = {k: os.environ.get(k) for k in knobs}
+    try:
+        os.environ.update(knobs)
+        host = fused.FusedCluster(
+            PROBE_GROUPS, PROBE_V, seed=42, shape=shape, engine="pallas"
+        )
+        os.environ["RAFT_TPU_PAGED_INKERNEL"] = "1"
+        ink = fused.FusedCluster(
+            PROBE_GROUPS, PROBE_V, seed=42, shape=shape, engine="pallas"
+        )
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+    fails = []
+    b_host = ledger.round_bytes_probe(host, 1)
+    # one lowering serves both probes (bytes moved + temp): interpret-
+    # mode pallas compiles are minutes-slow on a small CPU host
+    try:
+        comp_ink = ink.lower_round_program(1, donate=False).compile()
+    except Exception:
+        comp_ink = None
+    b_ink = None if comp_ink is None else ledger.bytes_accessed(comp_ink)
+    if b_host is None or b_ink is None:
+        fails.append(
+            "probe: backend exposes no cost model — cannot certify the "
+            "in-kernel bytes/round win"
+        )
+    elif b_ink >= b_host:
+        fails.append(
+            "probe: in-kernel pallas round program does not move strictly "
+            f"fewer bytes/round ({b_host:.0f} -> {b_ink:.0f}) — the "
+            "whole-fleet page_in/page_out passes are back in the lowering"
+        )
+    temp = (None if comp_ink is None
+            else ledger.memory_metrics(comp_ink).get("temp_bytes"))
+    temp_per_lane = None if temp is None else temp / lanes
+    if temp_per_lane is not None and temp_per_lane > PROBE_TEMP_CAP_PER_LANE:
+        fails.append(
+            f"probe: in-kernel temp {temp_per_lane:.1f} B/lane exceeds the "
+            f"hard cap {PROBE_TEMP_CAP_PER_LANE} — a full-window [N, W] "
+            "temporary (or an allocation of that class) crept back"
+        )
+    print(json.dumps({
+        "metric": "paged_ab_probe",
+        "bytes_per_round_host_boundary": b_host,
+        "bytes_per_round_inkernel": b_ink,
+        "inkernel_temp_bytes_per_lane": temp_per_lane,
+        "temp_cap_per_lane": PROBE_TEMP_CAP_PER_LANE,
+        "ok": not fails,
+    }), flush=True)
+    return fails
 
 
 def run_child(engine: str, paged: str, extra_env: dict | None = None) -> dict:
@@ -210,6 +334,36 @@ def main():
             print(json.dumps(r), flush=True)
             arms[(eng, paged)] = r
 
+    # in-kernel arms: same engines, paging fused into the round program,
+    # crossed with diet so the storage layers are proven to compose at
+    # the in-kernel boundary too. The pallas arms pin tile = lanes/2 so
+    # the pool splits into two per-grid-step segments (geometry: the
+    # default pool is even and each half holds >= kmax+1 pages).
+    groups = int(os.environ.get("AB_GROUPS", 4096))
+    v = int(os.environ.get("AB_VOTERS", 3))
+    ink = {}
+    for eng, kenv in (
+        ("xla", None),
+        ("pallas", {"RAFT_TPU_PALLAS_ROUNDS": "1"}),
+        (f"pallas K={ab_k}", {"RAFT_TPU_PALLAS_ROUNDS": str(ab_k)}),
+    ):
+        for diet in ("0", "1"):
+            extra = dict(kenv or {})
+            extra["RAFT_TPU_PAGED_INKERNEL"] = "1"
+            extra["RAFT_TPU_DIET"] = diet
+            if eng.startswith("pallas"):
+                extra["RAFT_TPU_PALLAS_TILE"] = str(groups * v // 2)
+                # two pool segments, each with its own trash page and
+                # its own Zipf-lumpy share of the hot lanes: give each
+                # segment the same kmax+1 transient headroom the global
+                # pool already gets (AB_POOL_PAGES still overrides)
+                extra["RAFT_TPU_POOL_PAGES"] = os.environ.get(
+                    "AB_POOL_PAGES", str(default_pool(groups, v) + 8)
+                )
+            r = run_child(eng.split()[0], "1", extra)
+            print(json.dumps(r), flush=True)
+            ink[(eng, diet)] = r
+
     fails = []
     base = arms[("xla", "0")]["extra"]
     on_tpu = base["backend"] == "tpu"
@@ -232,6 +386,41 @@ def main():
                 f"{key}: pool exhausted {ex['paged_exhausted']} times — "
                 "the Zipfian tail no longer fits the undersized pool"
             )
+    for (eng, diet), r in ink.items():
+        ex = r["extra"]
+        key = f"inkernel:{eng}:diet={diet}"
+        if ex["digest"] != base["digest"]:
+            fails.append(
+                f"{key}: trajectory digest diverged from xla paged-off — "
+                "in-kernel paging is not invisible"
+            )
+        if not ex.get("paged_inkernel"):
+            fails.append(f"{key}: child did not run with in-kernel paging")
+        if ex["engine_requested"] == "pallas" and (
+            ex["engine_after"] != "pallas" or ex["fallbacks"]
+        ):
+            fails.append(
+                f"{key}: child fell back to {ex['engine_after']} "
+                f"({ex['fallbacks']} fallback(s))"
+            )
+        if ex["engine_after"] == "pallas" and ex.get("paged_segs") != 2:
+            fails.append(
+                f"{key}: expected 2 pool segments (tile = lanes/2), got "
+                f"{ex.get('paged_segs')}"
+            )
+        if ex.get("paged_exhausted"):
+            fails.append(
+                f"{key}: pool exhausted {ex['paged_exhausted']} times — "
+                "the Zipfian tail no longer fits the undersized pool"
+            )
+        if on_tpu:
+            ratio = r["value"] / max(arms[(eng, "1")]["value"], 1e-9)
+            if ratio > tol:
+                fails.append(
+                    f"{key}: in-kernel paging regressed round time vs the "
+                    f"host-boundary paged arm (ratio {ratio:.3f} > tol {tol})"
+                )
+    fails += probe_gate(ab_k)
     for eng in ("xla", "pallas", f"pallas K={ab_k}"):
         off = arms[(eng, "0")]["extra"]
         on = arms[(eng, "1")]["extra"]
@@ -248,9 +437,12 @@ def main():
                 f"(ratio {ratio:.3f} > tol {tol})"
             )
     on_x = arms[("xla", "1")]["extra"]
+    ink_x = ink[("xla", "0")]["extra"]
     print(json.dumps({
         "metric": "paged_ab",
         "ok": not fails,
+        "inkernel_alloc_skipped": ink_x.get("paged_alloc_skipped"),
+        "inkernel_pages_dirty": ink_x.get("paged_pages_dirty"),
         "resident_bytes_per_lane_off": base["resident_bytes_per_lane"],
         "resident_bytes_per_lane_on": on_x["resident_bytes_per_lane"],
         "shrink_pct": round(

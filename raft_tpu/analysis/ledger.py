@@ -164,8 +164,22 @@ def run_ledger(pairs=None, *, update: bool = False, path: str | None = None,
     meta = {"backend": jax.default_backend(), "jax": jax.__version__}
 
     current = {}
+    cap_findings: list = []
     for entry, rec in pairs:
-        current[entry.name] = entry_metrics(rec)
+        cur = entry_metrics(rec)
+        current[entry.name] = cur
+        # optional per-record absolute cap (rec["temp_cap_per_lane"]):
+        # unlike the baseline diff, this budget holds even across a
+        # re-baseline — the registry record owns the number, so
+        # --update-ledger can never quietly ratify a regression
+        cap = rec.get("temp_cap_per_lane")
+        if cap is not None and cur.get("temp_bytes_per_lane", 0.0) > cap:
+            cap_findings.append(Finding(entry.name, "ledger", (
+                f"temp_bytes_per_lane={cur['temp_bytes_per_lane']} exceeds "
+                f"the record's hard cap {cap} — a full-window [N, W] "
+                "temporary (or an allocation of that class) crept back "
+                "into the compiled program"
+            )))
 
     report = {
         "path": path,
@@ -175,7 +189,7 @@ def run_ledger(pairs=None, *, update: bool = False, path: str | None = None,
         "updated": update,
         "diff": "",
     }
-    findings: list = []
+    findings: list = list(cap_findings)
     per_entry_rows: dict = {}
 
     baseline = budgets.load_ledger(path)
@@ -187,7 +201,7 @@ def run_ledger(pairs=None, *, update: bool = False, path: str | None = None,
             per_entry_rows[name] = rows
         budgets.save_ledger(path, meta, current)
         report["diff"] = budgets.render_diff(per_entry_rows)
-        return [], report
+        return list(cap_findings), report
 
     if baseline is None:
         findings.append(Finding("LEDGER.json", "ledger", (

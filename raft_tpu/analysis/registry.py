@@ -64,6 +64,9 @@ _BASE = {
     # the tier plane is pinned OFF in every profile except "tier": the
     # RAFT_TPU_TIER=0 elision claim is asserted on every other entry
     "RAFT_TPU_TIER": None,
+    # in-kernel paging is pinned OFF everywhere except its own profile:
+    # the host-boundary page_in/page_out records must stay the baseline
+    "RAFT_TPU_PAGED_INKERNEL": None,
 }
 
 PROFILES = {
@@ -131,6 +134,25 @@ PROFILES = {
         RAFT_TPU_PAGED="0",
         RAFT_TPU_EGRESS="1",
     ),
+    # in-kernel paged megakernel (RAFT_TPU_PAGED_INKERNEL=1): the paged
+    # profile with paging fused into the K-round grid — the tile is
+    # pinned to 6 so the 12-lane audit cluster runs TWO grid steps (two
+    # allocation segments: pool addressing, not just the 1-tile special
+    # case, is what gets audited)
+    "paged_inkernel": dict(
+        _BASE,
+        RAFT_TPU_METRICS="1",
+        RAFT_TPU_CHAOS="0",
+        RAFT_TPU_TRACELOG="0",
+        RAFT_TPU_DIET="0",
+        RAFT_TPU_DONATE="1",
+        RAFT_TPU_PAGED="1",
+        RAFT_TPU_PAGED_INKERNEL="1",
+        RAFT_TPU_PALLAS_TILE="6",
+        RAFT_TPU_PAGE_WINDOW=None,
+        RAFT_TPU_PAGE_ENTRIES=None,
+        RAFT_TPU_POOL_PAGES=None,
+    ),
     # the hot/cold tier's dispatch-boundary jits (tier/engine.py): planes
     # off so the gather/scatter jaxprs are pure row movement, donation on
     # (the scatter's dominant tier-on path consumes the carry in place)
@@ -190,6 +212,22 @@ def _round_xla_off():
 
 def _round_pallas():
     return _cluster("pallas", rounds_per_call=2).audit_programs()
+
+
+def _round_pallas_inkernel():
+    recs = _cluster("pallas", rounds_per_call=2).audit_programs()
+    for r in recs:
+        r["name"] = "round.pallas.paged_inkernel"
+        # hard ledger cap (survives --update-ledger): the whole point of
+        # in-kernel paging is that the TWO whole-fleet [N, W] gather/
+        # scatter passes and their full-window HBM temporary are gone.
+        # One full-window log-column set costs W * 3 cols * 4 B = 192
+        # B/lane at the default W=16 split; the program measures ~7262
+        # B/lane of temps on the CPU interpret lowering, so a cap of
+        # 7400 leaves jitter headroom while any full-window temporary
+        # (>= +192) trips it
+        r["temp_cap_per_lane"] = 7400.0
+    return recs
 
 
 def _round_diet_paged():
@@ -480,6 +518,14 @@ ENTRIES = (
           expect_on={"metrics": True, "chaos": False, "trace": False,
                      "paged": True, "tier": False},
           diet=True),
+    # the in-kernel paged megakernel (ISSUE 17): page_in/page_out fused
+    # into the K=2 pallas grid over two lane tiles — elision, capture,
+    # donation, and carry stability all audited with the pool/pt riding
+    # the scan carry instead of the dispatch boundary
+    Entry("round.pallas.paged_inkernel", "paged_inkernel",
+          _round_pallas_inkernel, compile_budget=1,
+          expect_on={"metrics": True, "chaos": False, "trace": False,
+                     "paged": True, "tier": False}),
     # the hot/cold tier's dispatch-boundary pair (tier/engine.py): the
     # evict-snapshot gather and the donating admit-restore scatter; every
     # OTHER entry above asserts "tier": False under its pinned-off

@@ -181,10 +181,18 @@ TRACE_COUNTERS = (
 #   paged_exhausted        cumulative page_out clamp events (lane x
 #                          dispatch); nonzero means ERR_PAGE_EXHAUSTED is
 #                          set on some lane — raise pool_pages
+#   paged_pages_dirty      cumulative pages written back to the pool by
+#                          the allocator (page_out scatter volume), summed
+#                          over lanes
+#   paged_alloc_skipped    dispatches (or in-kernel rounds) where the
+#                          conditional allocator pass was elided because
+#                          no lane's log moved (RAFT_TPU_PAGED_INKERNEL)
 PAGED_COUNTERS = (
     "paged_pool_in_use",
     "paged_page_faults",
     "paged_exhausted",
+    "paged_pages_dirty",
+    "paged_alloc_skipped",
 )
 
 # hot/cold tier counter families (host plane — pure python counters from
@@ -202,6 +210,9 @@ PAGED_COUNTERS = (
 #   tier_cold_bytes        gauge: cold-record bytes (host RAM + disk spill)
 #   tier_thrash_suppressed evictions blocked ONLY by the minimum-residency
 #                          cooldown — the hysteresis doing work
+#   paged_pressure_evictions  victims that held mapped pool pages when
+#                          picked under paged pool pressure (the scorer's
+#                          page_weight bias doing work; 0 with paging off)
 TIER_COUNTERS = (
     "tier_evictions",
     "tier_admissions",
@@ -210,6 +221,7 @@ TIER_COUNTERS = (
     "tier_cold",
     "tier_cold_bytes",
     "tier_thrash_suppressed",
+    "paged_pressure_evictions",
 )
 
 

@@ -1656,6 +1656,7 @@ def fused_rounds(
     auto_compact_lag: int | None = None,
     ops_first_round_only: bool = True,
     straddle: StraddleSpec | None = None,
+    paged_inkernel: bool = False,
     metrics: "metmod.MetricsState | None" = None,
     chaos: "chmod.ChaosState | None" = None,
     trace: "trmod.TraceState | None" = None,
@@ -1696,7 +1697,16 @@ def fused_rounds(
     it unchanged, and the result re-splits (page_out) before returning,
     with the updated PagedLog appended LAST in the result tuple. None
     compiles the exact unpaged program plus a stale-slot scrub so raw
-    carries and stream bytes match paged mode bit-for-bit."""
+    carries and stream bytes match paged mode bit-for-bit.
+
+    paged_inkernel (static): the XLA twin of the Pallas in-kernel paging
+    mode — page_in/page_out_cond move INTO the scan body (per round, on
+    the stored-domain carry) so the full [N, W] window is a scan-local
+    temporary instead of a whole-dispatch one, and the allocator pass is
+    elided on rounds where no lane's depth moved. Bit-identity with the
+    boundary mode is structural (page_out . page_in is value-identity on
+    scrubbed windows); only the faults/dirty/skipped counter cadence
+    differs."""
     from raft_tpu.state import fat_state, is_packed, slim_state
 
     if chaos is not None and straddle is not None:
@@ -1714,7 +1724,12 @@ def fused_rounds(
     else:
         state = slim_state(state)
         fab = slim_fabric(fab)
-    if paged is not None:
+    inkernel = paged is not None and paged_inkernel
+    if inkernel:
+        # allocator elision is only sound when every in-round log write
+        # lands inside the resident window (see pgmod.page_out_cond)
+        pg_can_skip = int(fab.rep.ent_term.shape[-1]) <= paged.w_res
+    elif paged is not None:
         # reconstruct the full [N, W] window from resident tail + pool;
         # the scan below is byte-identical to the unpaged program
         state, paged = pgmod.page_in(state, paged)
@@ -1729,7 +1744,7 @@ def fused_rounds(
             peer_mute = aligned_peer_mute(mute, v)
 
     def body(carry, i):
-        st, f, met, ch, tr = carry
+        st, f, met, ch, tr, pg = carry
         o = ops
         if ops_first_round_only:
             first = i == 0
@@ -1739,6 +1754,13 @@ def fused_rounds(
                 ),
                 ops,
             )
+        pg_last_pre = pg_snap_pre = None
+        if pg is not None:
+            # in-kernel twin: page in on the stored-domain carry (the
+            # same order the boundary mode pages, before the diet widen)
+            st, pg = pgmod.page_in(st, pg)
+            pg_last_pre = st.last.astype(I32)
+            pg_snap_pre = st.snap_index.astype(I32)
         if packed:
             st_fat, f_fat = load_carry(st, f)
         else:
@@ -1785,17 +1807,25 @@ def fused_rounds(
             st, f2 = store_carry(st, f2)
         else:
             st, f2 = slim_state(st), slim_fabric(f2)
-        return (st, f2, met, ch, tr), None
+        if pg is not None:
+            st, pg = pgmod.page_out_cond(
+                st, pg, pg_last_pre, pg_snap_pre, can_skip=pg_can_skip
+            )
+        return (st, f2, met, ch, tr, pg), None
 
-    # a None metrics/chaos/trace slot is an empty pytree: the scan carry
-    # shape is unchanged when a plane is off
-    (state, fab, metrics, chaos, trace), _ = jax.lax.scan(
+    # a None metrics/chaos/trace/paged slot is an empty pytree: the scan
+    # carry shape is unchanged when a plane (or in-kernel paging) is off
+    (state, fab, metrics, chaos, trace, pg_out), _ = jax.lax.scan(
         body,
-        (state, fab, metrics, chaos, trace),
+        (state, fab, metrics, chaos, trace, paged if inkernel else None),
         jnp.arange(n_rounds, dtype=I32),
         unroll=min(_SCAN_UNROLL, n_rounds),
     )
-    if paged is not None:
+    if inkernel:
+        # every round already re-split inside the scan body; the exit
+        # state is resident and canonical, no boundary pass needed
+        paged = pg_out
+    elif paged is not None:
         # re-split into resident tail + pool (page_out output is
         # canonical-by-construction: stale slots read back as zeros)
         state, paged = pgmod.page_out(state, paged)
@@ -1823,6 +1853,7 @@ _FUSED_STATIC = (
     "auto_compact_lag",
     "ops_first_round_only",
     "straddle",
+    "paged_inkernel",
 )
 
 # The default dispatch path DONATES the (state, fab, metrics) carry: XLA
@@ -1979,13 +2010,40 @@ class FusedCluster:
         # the jaxpr entirely.
         self.paged = None
         self._page_plan = None
-        # sub-pool segment count for the host-boundary paged ops: 1 here;
-        # ShardedFusedCluster sets n_shards so host views interpret the
-        # dispatch-allocated shard-local page ids correctly
+        # sub-pool segment count for the host-boundary paged ops: 1 here
+        # (or n_tiles under in-kernel pallas paging, where the allocation
+        # segment is the kernel tile); ShardedFusedCluster re-keys to its
+        # own segmentation so host views always interpret the
+        # dispatch-allocated segment-local page ids correctly
         self._paged_segs = 1
+        # in-kernel paging (RAFT_TPU_PAGED_INKERNEL, read once like the
+        # other planes): page_in/page_out fuse into the round program
+        self._paged_inkernel = False
         if pgmod.paged_enabled():
             self._page_plan = pgmod.validate_page_plan(self.shape, n)
-            self.state, self.paged = pgmod.split_state(self.state, self._page_plan)
+            self._paged_inkernel = pgmod.paged_inkernel_enabled()
+            segs = 1
+            if self._paged_inkernel and self.engine == "pallas":
+                # the pool slices per grid step, so the tile is pinned
+                # NOW, without autotune: the allocation segmentation is
+                # part of the carry layout, not a sweepable perf knob
+                t = self._tile_req
+                if t is None:
+                    t = config.env_int("RAFT_TPU_PALLAS_TILE", default=0) or None
+                if t is None:
+                    t = plr.cached_tile(
+                        plr.shape_key(self.shape, jax.default_backend())
+                    )
+                if t is None:
+                    t = plr.default_tile(n, self.v)
+                plr.check_tile(n, self.v, t)
+                self._pallas_tile = t
+                segs = n // t
+                pgmod.check_pool_segments(self._page_plan, segs)
+            self._paged_segs = segs
+            self.state, self.paged = pgmod.split_state(
+                self.state, self._page_plan, segs
+            )
         # default tier binding: identity cohort (lgids == slots). The
         # blocked/mesh drivers re-attach per-block engines with their own
         # cohorts/lane bases (scheduler.py / parallel/mesh.py).
@@ -2071,6 +2129,7 @@ class FusedCluster:
                     auto_propose=auto_propose,
                     auto_compact_lag=auto_compact_lag,
                     ops_first_round_only=ops_first_round_only,
+                    paged_inkernel=self._paged_inkernel,
                     metrics=self.metrics,
                     chaos=self.chaos,
                     trace=self.trace,
@@ -2088,6 +2147,7 @@ class FusedCluster:
                 auto_propose=auto_propose,
                 auto_compact_lag=auto_compact_lag,
                 ops_first_round_only=ops_first_round_only,
+                paged_inkernel=self._paged_inkernel,
                 metrics=self.metrics,
                 chaos=self.chaos,
                 trace=self.trace,
@@ -2134,6 +2194,7 @@ class FusedCluster:
             auto_propose=False,
             auto_compact_lag=None,
             ops_first_round_only=True,
+            paged_inkernel=self._paged_inkernel,
         )
         static.update(overrides)
         return static
@@ -2308,6 +2369,7 @@ class FusedCluster:
             auto_compact_lag=auto_compact_lag,
             ops_first_round_only=ops_first_round_only,
             interpret=self._pallas_interpret,
+            paged_inkernel=self._paged_inkernel,
             metrics=self.metrics,
             chaos=self.chaos,
             trace=self.trace,
@@ -2335,6 +2397,13 @@ class FusedCluster:
                 e,
             )
             self.engine = "xla"
+            if self.paged is not None and self._paged_segs != 1:
+                # the XLA redrive allocates whole-fleet (segment = batch):
+                # re-key the tile-local page ids before it runs
+                self.state, self.paged = pgmod.resegment(
+                    self.state, self.paged, self._paged_segs, 1
+                )
+                self._paged_segs = 1
             return None
 
     def _resolve_pallas_tile(self) -> int:
@@ -2391,14 +2460,17 @@ class FusedCluster:
                 k = plan[1]
         if k is None:
             if backend == "tpu" and plr.autotune_enabled():
-                # a pinned tile (ctor/env) restricts the sweep's tile axis
-                # but still sweeps K
+                # a pinned tile (ctor/env — or the ctor-resolved tile the
+                # in-kernel paged split committed to) restricts the
+                # sweep's tile axis but still sweeps K
                 pinned = self._tile_req
                 if pinned is None:
                     pinned = (
                         config.env_int("RAFT_TPU_PALLAS_TILE", default=0)
                         or None
                     )
+                if pinned is None:
+                    pinned = self._pallas_tile
                 tiles = None
                 if pinned is not None:
                     plr.check_tile(n, self.v, pinned)
@@ -2433,6 +2505,7 @@ class FusedCluster:
             auto_compact_lag=None,
             ops_first_round_only=True,
             interpret=False,
+            paged_inkernel=self._paged_inkernel,
             metrics=self.metrics,
             chaos=self.chaos,
             paged=self.paged,

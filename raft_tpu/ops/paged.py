@@ -24,13 +24,28 @@ contiguous (`(snap+1)>>lpe .. lo_res>>lpe`, at most kmax keys — see
 resolve_page_plan), so with M = next_pow2(kmax) the mod-M slots are
 distinct and the mapping is exact.
 
-Paging is DISPATCH-granular: `page_in` reconstructs the full `[N, W]`
-window at the top of a fused/pallas dispatch (inside the jit), the round
-scan runs on the full window exactly as before — the Pallas megakernel is
-untouched, so K>1 bit-identity is structural — and `page_out` re-splits
-the result before the dispatch returns. What the pool buys is the
-*between-dispatch* resident footprint (the carry XLA keeps live across
-round calls and streams over WAL/egress fences), not in-kernel VMEM.
+Paging is DISPATCH-granular by default: `page_in` reconstructs the full
+`[N, W]` window at the top of a fused/pallas dispatch (inside the jit),
+the round scan runs on the full window exactly as before — the Pallas
+megakernel is untouched, so K>1 bit-identity is structural — and
+`page_out` re-splits the result before the dispatch returns. What the
+pool buys is the *between-dispatch* resident footprint (the carry XLA
+keeps live across round calls and streams over WAL/egress fences), not
+in-kernel VMEM.
+
+RAFT_TPU_PAGED_INKERNEL=1 moves the paging passes INTO the round
+program (ROADMAP item 3's stretch goal): each Pallas grid step pages in
+its own tile's slice of the pool/page-table, runs the K rounds on the
+reconstructed window in VMEM, and re-splits before writing back — the
+two whole-fleet `[N, W]` gather/scatter passes and the full-window HBM
+temporary disappear from the dispatch. The XLA engine gets a tile-free
+jnp twin inside its scan body. Because page_out . page_in is
+value-identity on scrubbed windows (dead slots never influence round
+output), paging at any granularity yields bit-identical trajectories;
+only the bookkeeping counters (faults/dirty/skipped cadence) differ
+across modes. The allocator additionally becomes conditional in this
+mode — `page_out_cond` skips the realloc pass when no lane's log moved
+past its resident window since the matching page_in.
 
 `page_out` is a realloc-from-scratch allocator: every dispatch recomputes
 `need` pages per lane, assigns page ids by exclusive cumsum (the same
@@ -74,6 +89,15 @@ def paged_enabled() -> bool:
     value is baked into each cluster at construction — the carry split
     never flips mid-run."""
     return config.env_flag("RAFT_TPU_PAGED", default=False)
+
+
+def paged_inkernel_enabled() -> bool:
+    """Read RAFT_TPU_PAGED_INKERNEL lazily (default OFF): fuse the
+    page_in/page_out passes into the round program itself instead of
+    running them as whole-fleet passes at the dispatch boundary. Baked
+    at cluster construction alongside the engine choice; a no-op unless
+    RAFT_TPU_PAGED=1."""
+    return config.env_flag("RAFT_TPU_PAGED_INKERNEL", default=False)
 
 
 def _next_pow2(x: int) -> int:
@@ -154,6 +178,8 @@ class PagedLog:
     pool_bytes: Any
     faults: Any
     exhausted: Any
+    dirty: Any  # [N] i32, cumulative pages (re)written by the allocator
+    skipped: Any  # [N] i32, allocator passes elided by page_out_cond
     # static geometry rides in the treedef (meta fields), so jit twins and
     # shard_map see it for free and shard-local pool shapes come from the
     # leaves themselves
@@ -163,7 +189,10 @@ class PagedLog:
 
 jax.tree_util.register_dataclass(
     PagedLog,
-    data_fields=["pt", "pool_term", "pool_type", "pool_bytes", "faults", "exhausted"],
+    data_fields=[
+        "pt", "pool_term", "pool_type", "pool_bytes",
+        "faults", "exhausted", "dirty", "skipped",
+    ],
     meta_fields=["w", "w_res"],
 )
 
@@ -183,6 +212,8 @@ def init_paged(plan: PagePlan, state: RaftState) -> PagedLog:
         pool_bytes=pool(state.log_bytes),
         faults=jnp.zeros((n,), I32),
         exhausted=jnp.zeros((n,), I32),
+        dirty=jnp.zeros((n,), I32),
+        skipped=jnp.zeros((n,), I32),
         w=plan.w,
         w_res=plan.w_res,
     )
@@ -227,6 +258,32 @@ def page_in(state: RaftState, paged: PagedLog):
     return full, dataclasses.replace(paged, faults=faults)
 
 
+def _resident_tail(state: RaftState, paged: PagedLog) -> RaftState:
+    """The allocator-free half of page_out: mask a full `[N, W]` state
+    down to its resident `[N, W_res]` tail (entry i at slot
+    i & (W_res - 1), canonical zeros elsewhere). Shared by page_out and
+    page_out_cond's skip branch."""
+    w, w_res = paged.w, paged.w_res
+    last = state.last.astype(I32)
+    snap = state.snap_index.astype(I32)
+    lo_res = jnp.maximum(snap, last - w_res)
+    r = jnp.arange(w_res, dtype=I32)[None, :]
+    i_r = last[:, None] - ((last[:, None] - r) & (w_res - 1))
+    rvalid = i_r > lo_res[:, None]
+    rsl = i_r & (w - 1)
+
+    def res_col(full_col):
+        z = jnp.zeros((), full_col.dtype)
+        return jnp.where(rvalid, jnp.take_along_axis(full_col, rsl, axis=1), z)
+
+    return dataclasses.replace(
+        state,
+        log_term=res_col(state.log_term),
+        log_type=res_col(state.log_type),
+        log_bytes=res_col(state.log_bytes),
+    )
+
+
 def page_out(state: RaftState, paged: PagedLog):
     """Split a full `[N, W]` state into the resident `[N, W_res]` tail +
     a freshly rebuilt pool/page-table. Lanes whose pages do not fit the
@@ -240,16 +297,6 @@ def page_out(state: RaftState, paged: PagedLog):
     last = state.last.astype(I32)
     snap = state.snap_index.astype(I32)
     lo_res = jnp.maximum(snap, last - w_res)
-
-    # resident tail: entry i sits at slot i & (W_res - 1), zeros elsewhere
-    r = jnp.arange(w_res, dtype=I32)[None, :]
-    i_r = last[:, None] - ((last[:, None] - r) & (w_res - 1))
-    rvalid = i_r > lo_res[:, None]
-    rsl = i_r & (w - 1)
-
-    def res_col(full_col):
-        z = jnp.zeros((), full_col.dtype)
-        return jnp.where(rvalid, jnp.take_along_axis(full_col, rsl, axis=1), z)
 
     # allocate: contiguous page-id ranges by exclusive cumsum over per-lane
     # need, ids starting at 1 (page 0 = trash row)
@@ -290,11 +337,7 @@ def page_out(state: RaftState, paged: PagedLog):
 
     err = state.error_bits | jnp.where(exh, ERR_PAGE_EXHAUSTED, 0).astype(I32)
     resident = dataclasses.replace(
-        state,
-        log_term=res_col(state.log_term),
-        log_type=res_col(state.log_type),
-        log_bytes=res_col(state.log_bytes),
-        error_bits=err,
+        _resident_tail(state, paged), error_bits=err
     )
     new_paged = PagedLog(
         pt=pid.astype(paged.pt.dtype),
@@ -303,10 +346,42 @@ def page_out(state: RaftState, paged: PagedLog):
         pool_bytes=pool_col(state.log_bytes),
         faults=paged.faults,
         exhausted=paged.exhausted + exh.astype(I32),
+        dirty=paged.dirty + n_alloc.astype(I32),
+        skipped=paged.skipped,
         w=w,
         w_res=w_res,
     )
     return resident, new_paged
+
+
+def page_out_cond(state: RaftState, paged: PagedLog, last_pre, snap_pre,
+                  *, can_skip: bool):
+    """Conditional page_out for the in-kernel path: elide the
+    realloc-from-scratch allocator pass when no lane's `last` or
+    `snap_index` moved since the matching page_in (`last_pre`/`snap_pre`
+    are int32 snapshots captured right after it). Static `can_skip` must
+    only be True when every in-dispatch log write lands inside the
+    resident window (append fan-in E <= w_res): then unmoved last/snap
+    means the paged region `(snap, lo_res]` is untouched and the
+    deterministic allocator would rebuild the exact same pt/pool, so the
+    skip branch's resident-tail-only split is value-identical (only the
+    dirty/exhausted accumulators would differ — bookkeeping, never
+    compared across modes). Snapshots, compaction, and truncation all
+    move last or snap, so they always take the full branch."""
+    if not can_skip:
+        return page_out(state, paged)
+
+    def full_branch(st):
+        return page_out(st, paged)
+
+    def skip_branch(st):
+        bump = dataclasses.replace(paged, skipped=paged.skipped + 1)
+        return _resident_tail(st, paged), bump
+
+    moved = jnp.any(state.last.astype(I32) != last_pre) | jnp.any(
+        state.snap_index.astype(I32) != snap_pre
+    )
+    return jax.lax.cond(moved, full_branch, skip_branch, state)
 
 
 # --------------------------------------------------------------------------
@@ -369,6 +444,45 @@ def split_state(state: RaftState, plan: PagePlan, segs: int = 1):
     return page_out_host(state, init_paged(plan, state), segs)
 
 
+def resegment(state: RaftState, paged: PagedLog, old_segs: int,
+              new_segs: int):
+    """Re-key the pool/page-table from one allocation segmentation to
+    another (engine fallback, sharded adoption of a mono carry): read
+    the full window under the old segmentation, re-split under the new.
+    Page ids are local to the sub-pool the allocator saw, so tables
+    written under one segmentation must never be read under another —
+    this is the only legal conversion. Value-identity on the logical
+    log is structural (page_out . page_in roundtrip)."""
+    if old_segs == new_segs:
+        return state, paged
+    full = page_in_view(state, paged, old_segs)
+    return page_out_host(full, paged, new_segs)
+
+
+def check_pool_segments(plan: PagePlan, segs: int) -> None:
+    """Config-time geometry gate for segment-local allocation (raise,
+    never fall back): the pool must split evenly into `segs` sub-pools
+    (one per kernel tile per shard under RAFT_TPU_PAGED_INKERNEL) and
+    each sub-pool must still hold one lane's full page set plus its own
+    trash row."""
+    if segs <= 1:
+        return
+    if plan.pool_pages % segs:
+        raise ValueError(
+            f"pool_pages={plan.pool_pages} must divide evenly into "
+            f"{segs} allocation segments (one sub-pool per kernel tile "
+            "per shard); pin Shape.pool_pages / RAFT_TPU_POOL_PAGES to "
+            "a multiple"
+        )
+    if plan.pool_pages // segs < plan.kmax + 1:
+        raise ValueError(
+            f"pool_pages={plan.pool_pages} over {segs} allocation "
+            f"segments leaves {plan.pool_pages // segs} pages per "
+            f"segment; each needs at least kmax+1 = {plan.kmax + 1} "
+            "(one lane's page set plus the segment's trash row)"
+        )
+
+
 def audit_records(resident_state: RaftState, paged: PagedLog,
                   full_state: RaftState, paged0: PagedLog) -> list:
     """Audit records for the two host-boundary programs (raft_tpu/
@@ -407,7 +521,18 @@ def paged_stats(paged: PagedLog) -> dict:
         "paged_pool_pages": int(paged.pool_term.shape[0]),
         "paged_page_faults": int(np.asarray(paged.faults.sum())),
         "paged_exhausted": int(np.asarray(paged.exhausted.sum())),
+        "paged_pages_dirty": int(np.asarray(paged.dirty.sum())),
+        "paged_alloc_skipped": int(np.asarray(paged.skipped.sum())),
     }
+
+
+def mapped_pages_per_lane(paged: PagedLog):
+    """Host-side per-lane mapped-page counts (numpy [N] int64) — the
+    tier scorer's pool-pressure signal. One device sync; call at
+    dispatch boundaries only."""
+    import numpy as np
+
+    return np.asarray((paged.pt > 0).sum(axis=1)).astype(np.int64)
 
 
 def paged_bytes_per_lane(paged: PagedLog) -> float:
@@ -415,5 +540,5 @@ def paged_bytes_per_lane(paged: PagedLog) -> float:
     lane's share of the pool); the bench adds the resident log columns."""
     n = paged.pt.shape[0]
     leaves = (paged.pt, paged.pool_term, paged.pool_type, paged.pool_bytes,
-              paged.faults, paged.exhausted)
+              paged.faults, paged.exhausted, paged.dirty, paged.skipped)
     return sum(x.size * x.dtype.itemsize for x in leaves) / n

@@ -401,6 +401,7 @@ def pallas_rounds(
     auto_compact_lag: int | None = None,
     ops_first_round_only: bool = True,
     interpret: bool = False,
+    paged_inkernel: bool = False,
     metrics=None,
     chaos=None,
     trace=None,
@@ -421,12 +422,28 @@ def pallas_rounds(
     growth, and the event stream is bit-identical to the XLA engine's by
     construction).
 
-    paged: the paged entry log (ops/paged.py) reconstructs the full
-    [N, W] window BEFORE the kernel specs are built and re-splits after
-    the scan, all inside this jit — the megakernel itself is untouched
-    (it sees the same full-window tiles as ever), so K>1 bit-identity is
-    structural; what the pool reduces is the between-dispatch resident
-    carry, not in-kernel VMEM."""
+    paged: the paged entry log (ops/paged.py). Host-boundary mode
+    (paged_inkernel=False) reconstructs the full [N, W] window BEFORE
+    the kernel specs are built and re-splits after the scan, all inside
+    this jit — the megakernel itself is untouched (it sees the same
+    full-window tiles as ever), so K>1 bit-identity is structural; what
+    the pool reduces is the between-dispatch resident carry, not
+    in-kernel VMEM.
+
+    paged_inkernel (RAFT_TPU_PAGED_INKERNEL, static): move the paging
+    passes INTO the grid step. Each tile reads its resident-window
+    columns plus ITS OWN slice of the pool ([P/n_tiles, PE] BlockSpecs,
+    one segment-local trash row each) and page table, reconstructs the
+    [TILE, W] window in VMEM via page_in, runs the K rounds unchanged,
+    and re-splits with page_out_cond before writing back — the two
+    whole-fleet [N, W] gather/scatter passes and the full-window HBM
+    temporary disappear from the dispatch. Page ids become TILE-local
+    (allocation segment = tile; FusedCluster._paged_segs = n_tiles),
+    and the allocator pass is elided for calls where no lane's
+    last/snap_index moved. Bit-identity with every other mode is
+    structural: page_out . page_in is value-identity on scrubbed
+    windows, so paging granularity is invisible to the trajectory (only
+    the faults/dirty/skipped counters differ in cadence)."""
     maybe_force_fail()
     validate_round_plan(rounds_per_call)
     # diet-v2: a packed carry (bitset masks + u16 indexes) rides the
@@ -440,10 +457,26 @@ def pallas_rounds(
     else:
         state = slim_state(state)
         fab = fmod.slim_fabric(fab)
-    if paged is not None:
+    inkernel = paged is not None and paged_inkernel
+    if paged is not None and not inkernel:
         state, paged = pgmod.page_in(state, paged)
     n = state.term.shape[0]
     check_tile(n, v, tile_lanes)
+    n_tiles = n // tile_lanes
+    can_skip = False
+    if inkernel:
+        if paged.pool_term.shape[0] % n_tiles:
+            raise TileError(
+                f"pool_pages={paged.pool_term.shape[0]} does not divide "
+                f"into {n_tiles} tiles of {tile_lanes} lanes: in-kernel "
+                "paging slices the pool per grid step (segment-local "
+                "allocation); pin RAFT_TPU_POOL_PAGES / "
+                "RAFT_TPU_PALLAS_TILE so the pool splits evenly"
+            )
+        # allocator elision is only sound when every in-round log write
+        # lands inside the resident window (append fan-in E <= W_res);
+        # see pgmod.page_out_cond
+        can_skip = int(fab.rep.ent_term.shape[-1]) <= paged.w_res
 
     has_mute = mute is not None
     has_met = metrics is not None
@@ -453,7 +486,9 @@ def pallas_rounds(
     flat_s, tree_s = jax.tree.flatten(state)
     flat_f, tree_f = jax.tree.flatten(fab)
     flat_o, tree_o = jax.tree.flatten(ops)
+    flat_pg, tree_pg = jax.tree.flatten(paged) if inkernel else ([], None)
     ls, lf, lo = len(flat_s), len(flat_f), len(flat_o)
+    lpg = len(flat_pg)
     grid = (n // tile_lanes,)
 
     nc = len(metmod.COUNTERS)
@@ -465,8 +500,37 @@ def pallas_rounds(
         nd = x.ndim
         return pl.BlockSpec(bs, lambda i, nd=nd: (i,) + (0,) * (nd - 1))
 
+    # in-kernel paging specs: per-lane pg leaves (pt, counters) tile like
+    # state; the pool columns slice per grid step ([P/n_tiles, PE], each
+    # tile owning its own sub-pool incl. its segment-local trash row 0).
+    # Built as a PagedLog of specs so the order matches tree.flatten.
+    pg_block_specs = []
+    if inkernel:
+
+        def pool_spec(x):
+            return pl.BlockSpec(
+                (x.shape[0] // n_tiles, x.shape[1]), lambda i: (i, 0)
+            )
+
+        pg_block_specs = jax.tree.flatten(
+            pgmod.PagedLog(
+                pt=lane_spec(paged.pt),
+                pool_term=pool_spec(paged.pool_term),
+                pool_type=pool_spec(paged.pool_type),
+                pool_bytes=pool_spec(paged.pool_bytes),
+                faults=lane_spec(paged.faults),
+                exhausted=lane_spec(paged.exhausted),
+                dirty=lane_spec(paged.dirty),
+                skipped=lane_spec(paged.skipped),
+                w=paged.w,
+                w_res=paged.w_res,
+            ),
+            is_leaf=lambda x: isinstance(x, pl.BlockSpec),
+        )[0]
+
     # -- shared specs / shapes (partials are per-K, added in make_call) ----
     in_specs = [lane_spec(x) for x in flat_s + flat_f + flat_o]
+    in_specs += pg_block_specs
     if has_mute:
         in_specs.append(lane_spec(mute))
     if has_met:
@@ -479,11 +543,18 @@ def pallas_rounds(
         in_specs.append(pl.BlockSpec((1, 4), lambda i: (0, 0), **smem))
 
     out_leaves = list(flat_s + flat_f)
-    if has_met:
-        out_leaves += [metrics.samp_index, metrics.samp_round]
-    if has_ch:
-        out_leaves += [getattr(chaos, k) for k in _CH_PROBE]
     out_specs = [lane_spec(x) for x in out_leaves]
+    if inkernel:
+        out_leaves += list(flat_pg)
+        out_specs += list(pg_block_specs)
+    if has_met:
+        extra = [metrics.samp_index, metrics.samp_round]
+        out_leaves += extra
+        out_specs += [lane_spec(x) for x in extra]
+    if has_ch:
+        extra = [getattr(chaos, k) for k in _CH_PROBE]
+        out_leaves += extra
+        out_specs += [lane_spec(x) for x in extra]
     out_shape = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in out_leaves]
 
     def make_call(kc: int):
@@ -500,28 +571,36 @@ def pallas_rounds(
                 return out
 
             s_in, f_in, o_in = take(ls), take(lf), take(lo)
+            pg_in_refs = take(lpg) if inkernel else None
             mute_ref = take(1)[0] if has_mute else None
             samp_in = take(2) if has_met else None
             knob_in = take(len(_CH_KNOBS)) if has_ch else None
             probe_in = take(len(_CH_PROBE)) if has_ch else None
             scal_ref = take(1)[0] if has_scal else None
             s_out, f_out = take(ls), take(lf)
+            pg_out_refs = take(lpg) if inkernel else None
             samp_out = take(2) if has_met else None
             probe_out = take(len(_CH_PROBE)) if has_ch else None
             part_ref = take(1)[0] if has_scal else None
 
+            st_sl = jax.tree.unflatten(tree_s, [r[...] for r in s_in])
+            fb_sl = jax.tree.unflatten(tree_f, [r[...] for r in f_in])
+            pg_t = last_pre = snap_pre = None
+            if inkernel:
+                # page in this tile's window from its own pool slice, in
+                # the STORED domain (the order the host-boundary twin
+                # pages in, before the diet widen) so dtypes line up
+                pg_t = jax.tree.unflatten(
+                    tree_pg, [r[...] for r in pg_in_refs]
+                )
+                st_sl, pg_t = pgmod.page_in(st_sl, pg_t)
+                last_pre = st_sl.last.astype(I32)
+                snap_pre = st_sl.snap_index.astype(I32)
             if packed:
-                st, fb = fmod.load_carry(
-                    jax.tree.unflatten(tree_s, [r[...] for r in s_in]),
-                    jax.tree.unflatten(tree_f, [r[...] for r in f_in]),
-                )
+                st, fb = fmod.load_carry(st_sl, fb_sl)
             else:
-                st = fat_state(
-                    jax.tree.unflatten(tree_s, [r[...] for r in s_in])
-                )
-                fb = fmod.fat_fabric(
-                    jax.tree.unflatten(tree_f, [r[...] for r in f_in])
-                )
+                st = fat_state(st_sl)
+                fb = fmod.fat_fabric(fb_sl)
             op = jax.tree.unflatten(tree_o, [r[...] for r in o_in])
             # in-kernel rounds k>0 of an ops_first_round_only dispatch see
             # zero ops: the one global round that applies ops is k==0 of
@@ -629,10 +708,20 @@ def pallas_rounds(
                 st_w, f_w = fmod.store_carry(st2, f2)
             else:
                 st_w, f_w = slim_state(st2), fmod.slim_fabric(f2)
+            if inkernel:
+                # re-split in the stored domain (mirroring the
+                # host-boundary page_out order); the conditional form
+                # elides the allocator when no lane's depth moved
+                st_w, pg_t = pgmod.page_out_cond(
+                    st_w, pg_t, last_pre, snap_pre, can_skip=can_skip
+                )
             for r, x in zip(s_out, jax.tree.leaves(st_w)):
                 r[...] = x
             for r, x in zip(f_out, jax.tree.leaves(f_w)):
                 r[...] = x
+            if inkernel:
+                for r, x in zip(pg_out_refs, jax.tree.leaves(pg_t)):
+                    r[...] = x
             if has_met:
                 samp_out[0][...] = mt2.samp_index
                 samp_out[1][...] = mt2.samp_round
@@ -664,7 +753,7 @@ def pallas_rounds(
 
     # -- one K-round dispatch ----------------------------------------------
     def run_block(callee, kc, carry, first):
-        fs, ff, met, ch, tr = carry
+        fs, ff, fpg, met, ch, tr = carry
         # pre-round captures for the flight recorder (kc == 1 whenever tr
         # is not None): the carry state before the kernel, the chaos carry
         # before its round advance
@@ -681,7 +770,7 @@ def pallas_rounds(
             o_leaves = [
                 jnp.where(first, x, jnp.zeros_like(x)) for x in flat_o
             ]
-        inputs = list(fs) + list(ff) + list(o_leaves)
+        inputs = list(fs) + list(ff) + list(o_leaves) + list(fpg)
         if has_mute:
             inputs.append(mute)
         if has_met:
@@ -713,6 +802,7 @@ def pallas_rounds(
             return res
 
         new_fs, new_ff = take(ls), take(lf)
+        new_fpg = take(lpg) if inkernel else fpg
         if has_met:
             samp_i, samp_r = take(2)
         if has_ch:
@@ -751,7 +841,7 @@ def pallas_rounds(
                 chaos=ch_pre,
                 lane_offset=trace_lane_offset,
             )
-        return (new_fs, new_ff, met, ch, tr)
+        return (new_fs, new_ff, new_fpg, met, ch, tr)
 
     # -- scan of full-K calls + remainder tail -----------------------------
     kc = rounds_per_call
@@ -762,7 +852,7 @@ def pallas_rounds(
     kc = max(1, min(kc, n_rounds)) if n_rounds else 1
     n_full, rem = divmod(n_rounds, kc)
 
-    carry = (flat_s, flat_f, metrics, chaos, trace)
+    carry = (flat_s, flat_f, flat_pg, metrics, chaos, trace)
     if n_full:
         call_main = make_call(kc)
 
@@ -773,9 +863,13 @@ def pallas_rounds(
     if rem:
         # a second, remainder-sized megakernel program in the same trace
         carry = run_block(make_call(rem), rem, carry, n_full == 0)
-    flat_s, flat_f, metrics, chaos, trace = carry
+    flat_s, flat_f, flat_pg, metrics, chaos, trace = carry
     state_out = jax.tree.unflatten(tree_s, flat_s)
-    if paged is not None:
+    if inkernel:
+        # the kernel already re-split each tile (state is resident and
+        # canonical); no boundary page_out, no full-window temporary
+        paged = jax.tree.unflatten(tree_pg, flat_pg)
+    elif paged is not None:
         state_out, paged = pgmod.page_out(state_out, paged)
     else:
         # canonical layout on the unpaged exit too, mirroring fused_rounds
@@ -805,6 +899,7 @@ _PALLAS_STATIC = (
     "auto_compact_lag",
     "ops_first_round_only",
     "interpret",
+    "paged_inkernel",
 )
 
 # donating/copying twins, mirroring ops/fused.py: the donating twin MUST be

@@ -465,18 +465,19 @@ class MeshBlockedCluster:
                 shape=shape, log_bytes=lb_i, **cfg
             )
             if rc.paged is not None:
-                # the mono restore allocated page ids against ONE global
-                # pool, but in-dispatch paging runs shard-local: round-trip
-                # through the full window and re-split with n_shards
-                # sub-pools so every page id lands in its shard's local id
-                # space, then re-shard (device_put on the lane sharding —
-                # shard_lanes routes by leading dim == n_lanes and would
-                # replicate the pool)
+                # the mono restore allocated page ids against its own
+                # segmentation, but in-dispatch paging runs segment-local
+                # on the mesh grid: round-trip through the full window and
+                # re-split with the mesh driver's segment count so every
+                # page id lands in its segment's local id space, then
+                # re-shard (device_put on the lane sharding — shard_lanes
+                # routes by leading dim == n_lanes and would replicate
+                # the pool)
                 from raft_tpu.ops import paged as pgmod
 
-                full = pgmod.page_in_view(rc.state, rc.paged, 1)
+                full = pgmod.page_in_view(rc.state, rc.paged, rc._paged_segs)
                 res_st, pg_new = pgmod.page_out_host(
-                    full, rc.paged, b.n_shards
+                    full, rc.paged, b.inner._paged_segs
                 )
                 b.inner.state = jax.tree.map(b._shard_lanes, res_st)
                 b.inner.paged = jax.tree.map(

@@ -454,14 +454,34 @@ class ShardedFusedCluster:
                     "sub-pool with its own trash page; pin Shape.pool_pages "
                     "/ RAFT_TPU_POOL_PAGES to a multiple of the mesh size)"
                 )
+            from raft_tpu.ops import paged as pgmod
+
+            segs = self.n_shards
+            if self.inner._paged_inkernel and self.inner.engine == "pallas":
+                # in-kernel paging allocates per kernel grid step: each
+                # (shard, tile) pair owns its own sub-pool slice (with its
+                # own trash page), so the allocation segment count is
+                # shards x tiles-per-shard
+                tile = self._resolve_shard_tile()
+                segs = self.n_shards * (self.lanes_per_shard // tile)
+                pgmod.check_pool_segments(self.inner._page_plan, segs)
+            if segs != self.inner._paged_segs:
+                # the inner ctor split against its own (mono) segmentation;
+                # rewrite the page ids for the sharded grid's segments
+                st, pgl = pgmod.resegment(
+                    self.inner.state, self.inner.paged,
+                    self.inner._paged_segs, segs,
+                )
+                self.inner.state = jax.tree.map(shard_lanes, st)
+                self.inner.paged = pgl
             self.inner.paged = jax.tree.map(
                 lambda x: jax.device_put(x, self.lane_sharding),
                 self.inner.paged,
             )
             # host-boundary paged ops (rebase / WAL view / adopt) must
-            # interpret the dispatch-allocated shard-local page ids
+            # interpret the dispatch-allocated segment-local page ids
             # against the matching sub-pool, not the global pool
-            self.inner._paged_segs = self.n_shards
+            self.inner._paged_segs = segs
         self._no_ops = jax.tree.map(shard_lanes, no_ops(n))
         self._shard_lanes = shard_lanes
         self._cache = {}
@@ -588,6 +608,7 @@ class ShardedFusedCluster:
                     interpret=interp, metrics=mt, chaos=c,
                     trace=t_loc, trace_lane_offset=lane_off,
                     paged=p_in,
+                    paged_inkernel=self.inner._paged_inkernel,
                 )
             else:
                 res = fused_rounds(
@@ -598,6 +619,7 @@ class ShardedFusedCluster:
                     straddle=self._spec, metrics=mt, chaos=c,
                     trace=t_loc, trace_lane_offset=lane_off,
                     paged=p_in,
+                    paged_inkernel=self.inner._paged_inkernel,
                 )
             out = [res[0], res[1]]
             j = 2
@@ -889,6 +911,24 @@ class ShardedFusedCluster:
             err,
         )
         self.inner.engine = "xla"
+        if (
+            self.inner.paged is not None
+            and self.inner._paged_segs != self.n_shards
+        ):
+            # the in-kernel pallas grid allocated per (shard, tile); the
+            # XLA twin allocates per shard — rewrite the page ids before
+            # the next dispatch
+            from raft_tpu.ops import paged as pgmod
+
+            st, pgl = pgmod.resegment(
+                self.inner.state, self.inner.paged,
+                self.inner._paged_segs, self.n_shards,
+            )
+            self.inner.state = jax.tree.map(self._shard_lanes, st)
+            self.inner.paged = jax.tree.map(
+                lambda x: jax.device_put(x, self.lane_sharding), pgl
+            )
+            self.inner._paged_segs = self.n_shards
 
     def set_chaos(self, **cols):
         """Install chaos columns, then re-shard them over the mesh (the

@@ -316,6 +316,9 @@ class TierEngine:
         self.evictions = 0
         self.admissions = 0
         self.births = 0
+        # victims that were holding pool pages when picked under paged
+        # pool pressure (the scorer's page_weight signal doing work)
+        self.paged_pressure_evictions = 0
 
     # -- indirection (GroupRef contract) --------------------------------
 
@@ -401,10 +404,17 @@ class TierEngine:
             + self.reserve_slots
         )
         if shortfall > 0:
-            evict += self.scorer.pick_victims(
+            page_weight = self._page_weights()
+            victims = self.scorer.pick_victims(
                 [g for g in self.alloc.residents() if g not in set(evict)],
                 shortfall, round_id, protect=protect,
+                page_weight=page_weight,
             )
+            if page_weight is not None:
+                self.paged_pressure_evictions += sum(
+                    1 for g in victims if page_weight.get(int(g), 0) > 0
+                )
+            evict += victims
         room = self.alloc.free_slots() + len(evict)
         grant = grant[:room]  # the rest stays queued for the next apply
         for g in grant:
@@ -413,6 +423,32 @@ class TierEngine:
             return [], []
         self._commit(evict, grant, round_id)
         return evict, grant
+
+    # paged pool occupancy fraction at or above which victim picking
+    # becomes page-aware (scorer prefers page-heavy among equally-quiet)
+    POOL_PRESSURE = 0.75
+
+    def _page_weights(self) -> dict[int, int] | None:
+        """Mapped-page counts per resident logical group from the HOST
+        side of the page table, or None when paging is off or the pool is
+        below the pressure threshold (no reason to bias victim picking
+        while pages are plentiful)."""
+        pg = getattr(self.cl, "paged", None)
+        if pg is None:
+            return None
+        from raft_tpu.ops import paged as pgmod
+
+        per_lane = pgmod.mapped_pages_per_lane(pg)
+        pool = int(pg.pool_term.shape[0])
+        if pool <= 0 or int(per_lane.sum()) < self.POOL_PRESSURE * pool:
+            return None
+        weights: dict[int, int] = {}
+        for g in self.alloc.residents():
+            # cluster-local lanes, matching _commit's gather indexing
+            # (lane_base only globalizes names for the mesh drivers)
+            lo = self.alloc.slot(g) * self.v
+            weights[int(g)] = int(per_lane[lo : lo + self.v].sum())
+        return weights
 
     def _commit(self, evict, admit, round_id):
         """The device phase: one gather for the evict batch, one scatter
@@ -664,6 +700,7 @@ class TierEngine:
             "tier_cold": len(self.cold),
             "tier_cold_bytes": self.cold.bytes(),
             "tier_thrash_suppressed": self.scorer.thrash_suppressed,
+            "paged_pressure_evictions": self.paged_pressure_evictions,
         }
         if mirror:
             from raft_tpu.metrics.host import record_tier_stats

@@ -111,18 +111,27 @@ class ActivityScorer:
         need: int,
         round_id: int,
         protect: set[int] | None = None,
+        page_weight: dict[int, int] | None = None,
     ) -> list[int]:
         """Up to `need` evict-eligible residents, quietest first.
-        `protect` shields groups with in-flight serve work."""
+        `protect` shields groups with in-flight serve work.
+
+        `page_weight` (lgid -> mapped pool pages) is the paged-pressure
+        signal: among equally-quiet groups the page-heavy ones go first,
+        so evicting under pool pressure actually frees pages. Score stays
+        the primary key — a busy page-heavy group is never preferred over
+        a quiet page-light one. Fully-decayed groups all read exactly 0.0,
+        so under pressure the weight genuinely reorders the cold set."""
         if need <= 0:
             return []
         protect = protect or set()
+        pw = page_weight or {}
         eligible = [
-            (self._current(g, round_id), g)
+            ((self._current(g, round_id), -pw.get(int(g), 0), int(g)), g)
             for g in residents
             if g not in protect and self.evict_eligible(g, round_id)
         ]
-        eligible.sort()
+        eligible.sort(key=lambda t: t[0])
         return [g for _, g in eligible[:need]]
 
     def compact(self) -> None:

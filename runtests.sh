@@ -156,9 +156,13 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
     run_chunk tests/test_diet.py
     # the paged entry-log suite mirrors the diet profile one storage
     # layer down: paged off/on twins per engine are distinct carry
-    # signatures, plus one K=4 interpreted megakernel on a paged carry
-    # and an 8-device sharded identity run
-    run_chunk tests/test_paged.py
+    # signatures, plus one K=4 interpreted megakernel on a paged carry,
+    # an 8-device sharded identity run, and the in-kernel paging block
+    # (kernel-level K=1/K=4 bit-identity, segment twins, tier x paged
+    # conservation); the slow-marked sharded pallas in-kernel twin is
+    # interpret-mode under shard_map — minutes on CPU, excluded here
+    # like everywhere else
+    run_chunk tests/test_paged.py -m "not slow"
     # the hot/cold tiering suite gets its own process: module-scoped tier
     # clusters + ServeLoops (tier carries are their own jit signatures),
     # the mid-election/mid-confchange eviction chaos soak, and the 1M

@@ -31,6 +31,28 @@ jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 
+def pytest_addoption(parser, pluginmanager):
+    """Keep the pytest.ini xdist defaults (-n 6 --dist loadfile
+    --max-worker-restart 0) parseable when the xdist plugin is disabled
+    (`-p no:xdist`, e.g. the ROADMAP tier-1 verify command): register
+    inert stand-ins for the options xdist would own, so the values
+    parse and are ignored and the run proceeds in-process (the
+    modifyitems warning below still flags full-suite single-process
+    runs). The group's private _addoption is the only way to claim a
+    lowercase short option (-n) from a conftest — same mechanism xdist
+    itself uses."""
+    if pluginmanager.hasplugin("xdist"):
+        return
+    group = parser.getgroup("xdist-standin")
+    group._addoption(
+        "-n", "--numprocesses", dest="numprocesses", default=None
+    )
+    group._addoption("--dist", dest="dist", default="no")
+    group._addoption(
+        "--max-worker-restart", dest="maxworkerrestart", default=None
+    )
+
+
 def pytest_collection_modifyitems(config, items):
     """Warn when the FULL suite is collected into one process: XLA:CPU
     reproducibly aborts once a few hundred distinct programs have been

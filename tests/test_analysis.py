@@ -221,9 +221,11 @@ def test_registry_matrix_green_and_purely_static():
         # dispatch is the paged cluster ctor splitting its initial
         # window (page_out at the host boundary) — once for the paged
         # profile, once more for the diet_paged profile (packed carry =
-        # a distinct page_out signature)
+        # a distinct page_out signature), once more for the
+        # paged_inkernel profile (its ctor splits the same way; only
+        # the round program moves the boundary in-kernel)
         build_compiles, _ = recompile._bucket(watch.counts)
-        assert build_compiles.pop("paged.page_out") <= 2
+        assert build_compiles.pop("paged.page_out") <= 3
         assert all(c == 0 for c in build_compiles.values()), build_compiles
         watch.reset()
         audit_findings, rows = jaxpr_audit.audit_entries(pairs)

@@ -59,9 +59,10 @@ def _set_env(monkeypatch, **kw):
     """Pin the full knob surface: unset keys are DELETED so a test never
     inherits a stray RAFT_TPU_* from the invoking shell."""
     knobs = (
-        "DIET", "ENGINE", "PALLAS_ROUNDS", "DONATE",
-        "TRACELOG", "METRICS", "CHAOS",
+        "DIET", "ENGINE", "PALLAS_ROUNDS", "PALLAS_TILE", "DONATE",
+        "TRACELOG", "METRICS", "CHAOS", "TIER",
         "PAGED", "PAGE_WINDOW", "PAGE_ENTRIES", "POOL_PAGES",
+        "PAGED_INKERNEL",
     )
     for k in knobs:
         v = kw.pop(k.lower(), None)
@@ -601,3 +602,282 @@ def test_sharded_digest_identity(monkeypatch):
         assert _digest(on.host_state()) == _digest(off.host_state())
     finally:
         jax.config.update("jax_compilation_cache_dir", old)
+
+
+# -- in-kernel paging (RAFT_TPU_PAGED_INKERNEL, ISSUE 17) ------------------
+# page_in/page_out move from the dispatch boundary into the round program
+# itself: per-round in the XLA scan body, per grid step in the pallas
+# megakernel (each lane tile owns its slice of the pool — allocation
+# segment = tile). pg counters (faults/dirty/skipped/exhausted) are
+# MODE-LOCAL bookkeeping and are never compared across paging modes; the
+# bit-identity contract is on the reconstructed full window + fabric.
+
+
+def test_inkernel_kernel_bit_identity_k1_k4(monkeypatch):
+    """Kernel-level: in-kernel pallas at K=1 and K=4 (9 rounds = 4+4+1
+    remainder tail) and the in-kernel XLA scan twin all reconstruct the
+    exact window the host-boundary run produces, on the same operands."""
+    from raft_tpu.ops import fused as fmod
+    from raft_tpu.ops import pallas_round as plr
+
+    g, v = 4, 3
+    shape = Shape(n_lanes=g * v, max_peers=v, log_window=8,
+                  max_msg_entries=2, max_inflight=2, max_read_index=2)
+    kw = dict(
+        v=v, n_rounds=9, do_tick=True, auto_propose=True,
+        auto_compact_lag=4, ops_first_round_only=True,
+        metrics=None, chaos=None,
+    )
+    _set_env(monkeypatch, paged="1", page_window="2")
+    c = FusedCluster(g, v, seed=7, shape=shape)
+    assert c.paged is not None
+    host = fmod._fused_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute, straddle=None,
+        paged=c.paged, **kw
+    )
+    ink_x = fmod._fused_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute, straddle=None,
+        paged=c.paged, paged_inkernel=True, **kw
+    )
+    k1 = plr._pallas_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute,
+        tile_lanes=2 * v, interpret=True, paged=c.paged,
+        paged_inkernel=True, **kw
+    )
+    k4 = plr._pallas_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute,
+        tile_lanes=2 * v, interpret=True, rounds_per_call=4,
+        paged=c.paged, paged_inkernel=True, **kw
+    )
+    ref_full = pgmod.page_in_view(host[0], host[-1], 1)
+    for name, out, segs in (
+        ("xla", ink_x, 1), ("pallas K=1", k1, 2), ("pallas K=4", k4, 2),
+    ):
+        full = pgmod.page_in_view(out[0], out[-1], segs)
+        _assert_trees_equal(full, ref_full, f"{name} state")
+        _assert_trees_equal(out[1], host[1], f"{name} fabric")
+        assert int(np.asarray(out[-1].exhausted).sum()) == 0, name
+
+
+@pytest.mark.parametrize("diet", ["0", "1"])
+def test_inkernel_xla_digest_identity_and_alloc_skip(monkeypatch, diet):
+    """Cluster-level XLA twin, diet stacked on/off: the in-kernel arm
+    lands the host-boundary digest, and the conditional allocator
+    actually elides rounds where no lane's log moved."""
+    off = _twin(monkeypatch, "1", diet=diet)
+    _set_env(monkeypatch, paged="1", paged_inkernel="1", diet=diet)
+    on = _drive(FusedCluster(G, V, seed=11, shape=_small_shape()))
+    assert on._paged_inkernel and on._paged_segs == 1
+    assert _digest(on.host_state()) == _digest(off.host_state())
+    stats = pgmod.paged_stats(on.paged)
+    assert stats["paged_alloc_skipped"] > 0
+    assert stats["paged_pages_dirty"] > 0
+
+
+def test_inkernel_pallas_cluster_digest_identity(monkeypatch):
+    """Cluster-level pallas engine: the in-kernel megakernel arm (two
+    lane tiles -> two allocation segments) lands the host-boundary pallas
+    digest; page ids stay inside each tile's sub-pool slice."""
+    _set_env(monkeypatch, paged="1")
+    ref = FusedCluster(G, V, seed=11, shape=_small_shape(),
+                       engine="pallas", tile_lanes=2 * V)
+    ref.run(16, auto_propose=True, auto_compact_lag=4)
+    ref.check_no_errors()
+    _set_env(monkeypatch, paged="1", paged_inkernel="1")
+    on = FusedCluster(G, V, seed=11, shape=_small_shape(),
+                      engine="pallas", tile_lanes=2 * V)
+    on.run(16, auto_propose=True, auto_compact_lag=4)
+    on.check_no_errors()
+    assert on.engine == "pallas" and on._paged_inkernel
+    assert on._paged_segs == (G * V) // (2 * V)
+    sub = on.paged.pool_term.shape[0] // on._paged_segs
+    assert int(np.asarray(on.paged.pt).max()) < sub
+    assert _digest(on.host_state()) == _digest(ref.host_state())
+
+
+def test_inkernel_exhaustion_mid_k_clamps_and_flags(monkeypatch):
+    """A pool too small for the batch, paged in-kernel at K=4: the
+    per-round page_out_cond clamps INSIDE the grid, flags
+    ERR_PAGE_EXHAUSTED, and the run keeps going — never a crash, never a
+    silent wrap."""
+    from raft_tpu.ops import pallas_round as plr
+
+    _set_env(monkeypatch, paged="1", page_window="4", page_entries="2",
+             pool_pages="8")
+    shape = _small_shape(4, 3, page_window=4, page_entries=2, pool_pages=8)
+    c = FusedCluster(4, 3, seed=11, shape=shape)
+    c.run(40)
+    c.run(24, auto_propose=True, auto_compact_lag=14)  # overruns the pool
+    ex0 = int(np.asarray(c.paged.exhausted).sum())
+    assert ex0 > 0
+    kw = dict(v=3, n_rounds=8, do_tick=True, auto_propose=True,
+              auto_compact_lag=14, ops_first_round_only=True,
+              metrics=None, chaos=None)
+    out = plr._pallas_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute, tile_lanes=12, interpret=True,
+        rounds_per_call=4, paged=c.paged, paged_inkernel=True, **kw
+    )
+    st, pg = out[0], out[-1]
+    bits = np.asarray(st.error_bits)
+    assert (bits & ERR_PAGE_EXHAUSTED).any()
+    assert int(np.asarray(pg.exhausted).sum()) >= ex0
+
+
+# -- segment-aware pool addressing (sharded / mesh) ------------------------
+
+
+@pytest.mark.parametrize("segs", [2, 4])
+def test_resegment_round_trip(segs):
+    """resegment rewrites page ids between allocation segmentations (the
+    sharded ctor / engine-fallback path) without touching values: the
+    reconstructed window is identical before and after, and ids stay
+    local to the new sub-pools."""
+    st = _random_logged_state(3)
+    plan = pgmod.validate_page_plan(_small_shape(), G * V)
+    canon = lg.scrub_stale_slots(st)
+    res, pgd = pgmod.page_out_host(canon, pgmod.init_paged(plan, st), 1)
+    res2, pgd2 = pgmod.resegment(res, pgd, 1, segs)
+    sub = pgd2.pool_term.shape[0] // segs
+    assert int(np.asarray(pgd2.pt).max()) < sub
+    full2 = pgmod.page_in_view(res2, pgd2, segs)
+    full1 = pgmod.page_in_view(res, pgd, 1)
+    _assert_trees_equal(
+        (full2.log_term, full2.log_type, full2.log_bytes),
+        (full1.log_term, full1.log_type, full1.log_bytes),
+        f"resegment 1->{segs}",
+    )
+    res3, pgd3 = pgmod.resegment(res2, pgd2, segs, 1)
+    _assert_trees_equal(pgd3.pt, pgd.pt, "resegment back: page table")
+    _assert_trees_equal(pgd3.pool_term, pgd.pool_term,
+                        "resegment back: pool")
+
+
+def test_check_pool_segments_rejects_bad_geometry():
+    plan = pgmod.validate_page_plan(
+        _small_shape(4, 3, pool_pages=9), 12
+    )
+    with pytest.raises(ValueError, match="allocation segments"):
+        pgmod.check_pool_segments(plan, 2)  # 9 % 2 != 0
+    pgmod.check_pool_segments(plan, 1)  # mono is always fine
+    plan8 = pgmod.validate_page_plan(
+        _small_shape(4, 3, pool_pages=8), 12
+    )
+    with pytest.raises(ValueError, match="allocation segments"):
+        # 8 // 4 = 2 < kmax + 1: a sub-pool couldn't hold one lane's
+        # worst-case tail plus its trash row
+        pgmod.check_pool_segments(plan8, 4)
+
+
+def test_sharded_inkernel_xla_digest_identity(monkeypatch):
+    """Sharded in-kernel XLA twin: paging runs per round inside
+    shard_map (segment = shard), digest-identical to the host-boundary
+    sharded run."""
+    from raft_tpu.parallel.sharded import ShardedFusedCluster
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        off = _sharded_twin(monkeypatch, "0")
+        _set_env(monkeypatch, paged="1", paged_inkernel="1")
+        on = ShardedFusedCluster(n_groups=8, n_voters=3, seed=13,
+                                 shape=_small_shape())
+        on.run(40)
+        on.run(16, auto_propose=True, auto_compact_lag=8)
+        on.check_no_errors()
+        assert on.inner._paged_inkernel
+        assert on.inner._paged_segs == 8  # xla engine: segment = shard
+        assert _digest(on.host_state()) == _digest(off.host_state())
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+
+
+@pytest.mark.slow
+def test_sharded_inkernel_pallas_segments_and_digest(monkeypatch):
+    """Sharded in-kernel pallas: two shards x two tiles per shard ->
+    four allocation segments; still digest-identical to the host-boundary
+    sharded run. Interpret-mode pallas under shard_map is minutes-slow on
+    CPU, hence the slow mark (the paged_ab bench smokes the same path)."""
+    from raft_tpu.parallel.sharded import ShardedFusedCluster
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        dev = jax.devices()[:2]
+        _set_env(monkeypatch, paged="1")
+        off = ShardedFusedCluster(n_groups=8, n_voters=3, seed=13,
+                                  shape=_small_shape(), devices=dev)
+        off.run(24, auto_propose=True, auto_compact_lag=8)
+        off.check_no_errors()
+        _set_env(monkeypatch, paged="1", paged_inkernel="1")
+        on = ShardedFusedCluster(n_groups=8, n_voters=3, seed=13,
+                                 shape=_small_shape(), devices=dev,
+                                 engine="pallas", tile_lanes=6)
+        on.run(24, auto_propose=True, auto_compact_lag=8)
+        on.check_no_errors()
+        assert on.inner.engine == "pallas"
+        assert on.inner._paged_segs == 2 * (12 // 6)
+        assert _digest(on.host_state()) == _digest(off.host_state())
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+
+
+# -- tier x paged (satellite: eviction must capture the deep paged tail) ---
+
+
+def test_tier_paged_pool_conservation_and_deep_tail(monkeypatch):
+    """Evicting a group whose log spills into the pool returns its pages
+    exactly (paged_pool_in_use conserved across the evict/admit cycle),
+    round-trips the deep tail bit-exactly, and the hiccuped cluster lands
+    the identical trajectory as a never-evicted twin."""
+    _set_env(monkeypatch, paged="1", page_window="2", tier="1")
+    shape = _small_shape(4, 3, page_window=2)
+
+    def mk():
+        return FusedCluster(4, 3, seed=3, shape=shape, logical_groups=8)
+
+    a, b = mk(), mk()
+    assert a.tier is not None and a.paged is not None
+    for c in (a, b):
+        c.run(40)
+        c.run(24, auto_propose=True, auto_compact_lag=8)
+    per_lane = pgmod.mapped_pages_per_lane(a.paged)
+    in_use0 = pgmod.paged_stats(a.paged)["paged_pool_in_use"]
+    assert in_use0 > 0
+    eng = a.tier
+    # pick a victim that actually holds pool pages (deep tail)
+    g = next(
+        g for g in eng.residents()
+        if per_lane[eng.lane_of_group(g):eng.lane_of_group(g) + a.v].sum()
+    )
+    lane0 = eng.lane_of_group(g)
+    vp = int(per_lane[lane0:lane0 + a.v].sum())
+    full0 = a.host_state()
+    rows0 = {k: np.asarray(getattr(full0, k))[lane0:lane0 + a.v].copy()
+             for k in DIGEST_FIELDS}
+    assert (rows0["last"] - np.asarray(full0.snap_index)[
+        lane0:lane0 + a.v]).max() > 2, "victim's tail must be paged-deep"
+
+    eng.request_evict(g)
+    ev, _ = eng.apply(1000)
+    assert ev == [g]
+    assert pgmod.paged_stats(a.paged)["paged_pool_in_use"] == in_use0 - vp
+
+    eng.request_admit(g, 1000)
+    _, ad = eng.apply(1000)
+    assert ad == [g]
+    assert pgmod.paged_stats(a.paged)["paged_pool_in_use"] == in_use0
+    full1 = a.host_state()
+    for k in DIGEST_FIELDS:
+        np.testing.assert_array_equal(
+            rows0[k], np.asarray(getattr(full1, k))[lane0:lane0 + a.v],
+            err_msg=f"deep tail round-trip: {k}",
+        )
+    # chaos-soak digest twin: keep driving both, the hiccup is invisible
+    for c in (a, b):
+        c.run(16, auto_propose=True, auto_compact_lag=8)
+        c.check_no_errors()
+    assert _digest(a.host_state()) == _digest(b.host_state())
