@@ -1,0 +1,183 @@
+"""Cross-host fabric A/B: a multi-process fabric fleet vs the monolithic
+blocked scheduler, plus the EQuARX-style wire diet.
+
+Three fresh-subprocess arms on one mostly-local placement (two hosts, one
+spanning group, every other group host-local):
+
+  mono         BlockedFusedCluster(groups, block_groups=groups) — the
+               single-process twin, digested with the same per-host-mask
+               trajectory chains the fabric uses
+  fabric       run_fabric_workers: one spawned engine process per host,
+               length-prefixed frames over pipes, np wide codec (the pb
+               raftpb codec's parity is pinned by tests/test_fabric.py)
+  fabric_diet  same fleet + RAFT_TPU_FABRIC_DIET=1 — every diet-bounded
+               field narrowed below int16 on the wire, same np framing,
+               so the bytes gate is an apples-to-apples column diet
+
+Asserted invariants (exit 0 = pass, 1 = regression):
+
+  - ONE identical sha256 fleet trajectory digest across all three arms —
+    process partitioning and wire quantization are invisible to raft
+  - wire bytes flowed (> 0) in both fabric arms
+  - cross-host messages are STRICTLY fewer than total messages: the
+    placement keeps host-local groups off the wire entirely
+  - fabric_diet put strictly fewer bytes on the wire than fabric
+
+`--smoke` shrinks the workload for CI. Env: AB_GROUPS, AB_VOTERS,
+AB_ROUNDS, AB_SEED, AB_MODE (child arm selector), RAFT_TPU_* (forwarded).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu import config
+
+
+def _placement():
+    from raft_tpu.fabric.placement import Placement
+
+    groups = int(os.environ.get("AB_GROUPS", 8))
+    v = int(os.environ.get("AB_VOTERS", 3))
+    return Placement.mostly_local(groups, v, 2, spanning=(1,))
+
+
+def child():
+    import time
+
+    mode = os.environ.get("AB_MODE", "mono")
+    pl = _placement()
+    rounds = int(os.environ.get("AB_ROUNDS", 24))
+    seed = int(os.environ.get("AB_SEED", 5))
+    v = pl.n_voters
+    ops_spec = {"hup": {g * v: True for g in range(pl.n_groups)}}
+
+    t0 = time.perf_counter()
+    if mode == "mono":
+        from raft_tpu.fabric.driver import mono_fleet_digest
+        from raft_tpu.scheduler import BlockedFusedCluster
+
+        c = BlockedFusedCluster(
+            pl.n_groups, v, block_groups=pl.n_groups, seed=seed
+        )
+        digest = mono_fleet_digest(
+            c, pl, rounds, ops_spec=ops_spec, auto_propose=True
+        )
+        c.check_no_errors()
+        counters = {}
+    else:
+        from raft_tpu.fabric.driver import run_fabric_workers, workers_fleet_digest
+
+        res = run_fabric_workers(
+            pl, rounds=rounds, seed=seed, ops_spec=ops_spec,
+            run_kw=dict(auto_propose=True), timeout=480,
+        )
+        digest = workers_fleet_digest(res)
+        counters = {}
+        for r in res:
+            for k, n in r["counters"].items():
+                counters[k] = counters.get(k, 0) + int(n)
+    dt = time.perf_counter() - t0
+
+    print(json.dumps({
+        "config": (
+            f"fabric_ab:{mode}:g={pl.n_groups}:v={v}:r={rounds}"
+        ),
+        "value": round(rounds / dt, 2),
+        "unit": "rounds/s",
+        "extra": {
+            "mode": mode,
+            "digest": digest,
+            "wire_bytes": counters.get("fabric_bytes_sent", 0),
+            "msgs_cross": counters.get("fabric_msgs_exported", 0),
+            "msgs_total": counters.get("fabric_msgs_total", 0),
+            "frames": counters.get("fabric_frames_sent", 0),
+            "diet": config.env_str("RAFT_TPU_FABRIC_DIET", default="0"),
+            "codec": config.env_str("RAFT_TPU_FABRIC_CODEC", default=""),
+        },
+    }), flush=True)
+
+
+def run_child(mode: str) -> dict:
+    env = dict(
+        os.environ,
+        AB_MODE=mode,
+        RAFT_TPU_METRICS="1",
+        RAFT_TPU_CHAOS="0",  # device fault plane off: parity oracle arms
+        RAFT_TPU_DIET=config.env_str("RAFT_TPU_DIET", default="1"),
+        RAFT_TPU_DONATE=config.env_str("RAFT_TPU_DONATE", default="1"),
+        RAFT_TPU_FABRIC="1" if mode != "mono" else "0",
+    )
+    if mode != "mono":
+        # both fabric arms frame with the np codec so the diet bytes gate
+        # compares identical framing (pb frames are byte-exact raftpb and
+        # cannot narrow; their parity is pinned by tests/test_fabric.py)
+        env["RAFT_TPU_FABRIC_CODEC"] = "np"
+        env["RAFT_TPU_FABRIC_DIET"] = "1" if mode == "fabric_diet" else "0"
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    if "--smoke" in sys.argv:
+        os.environ.setdefault("AB_GROUPS", "4")
+        os.environ.setdefault("AB_ROUNDS", "16")
+    arms = {}
+    for mode in ("mono", "fabric", "fabric_diet"):
+        r = run_child(mode)
+        print(json.dumps(r), flush=True)
+        arms[mode] = r
+
+    fails = []
+    base = arms["mono"]["extra"]
+    for mode in ("fabric", "fabric_diet"):
+        ex = arms[mode]["extra"]
+        if ex["digest"] != base["digest"]:
+            fails.append(
+                f"{mode}: fleet trajectory digest diverged from mono — "
+                "the multi-process partition is not invisible"
+            )
+        if ex["wire_bytes"] <= 0:
+            fails.append(f"{mode}: no bytes crossed the wire")
+        if not 0 < ex["msgs_cross"] < ex["msgs_total"]:
+            fails.append(
+                f"{mode}: cross-host messages ({ex['msgs_cross']}) not a "
+                f"strict subset of total traffic ({ex['msgs_total']}) — "
+                "host-local groups leaked onto the wire"
+            )
+    fat = arms["fabric"]["extra"]["wire_bytes"]
+    slim = arms["fabric_diet"]["extra"]["wire_bytes"]
+    if not slim < fat:
+        fails.append(
+            f"fabric_diet: wire diet did not shrink frames "
+            f"({slim} B vs {fat} B)"
+        )
+    print(json.dumps({
+        "metric": "fabric_ab",
+        "ok": not fails,
+        "digest": base["digest"][:16],
+        "wire_bytes": fat,
+        "wire_bytes_diet": slim,
+        "diet_ratio": round(slim / max(fat, 1), 3),
+        "msgs_cross": arms["fabric"]["extra"]["msgs_cross"],
+        "msgs_total": arms["fabric"]["extra"]["msgs_total"],
+    }), flush=True)
+    for f in fails:
+        print(f"FAIL: {f}", file=sys.stderr)
+    sys.exit(1 if fails else 0)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -90,6 +90,7 @@ BENCH_ALLOW = {
     "benches/diet_ab.py": {"child"},
     "benches/dispatch_ab.py": set(),
     "benches/egress_ab.py": set(),
+    "benches/fabric_ab.py": {"child"},
     "benches/latency_probe.py": {"measure", "measure_blocked"},
     "benches/metrics_smoke.py": set(),
     "benches/multichip_ab.py": set(),

@@ -67,6 +67,10 @@ _BASE = {
     # in-kernel paging is pinned OFF everywhere except its own profile:
     # the host-boundary page_in/page_out records must stay the baseline
     "RAFT_TPU_PAGED_INKERNEL": None,
+    # the cross-host fabric is host-side plumbing around the round
+    # program (no round-jaxpr footprint), pinned off except in its own
+    # profile where its extract/inject boundary jits are audited
+    "RAFT_TPU_FABRIC": None,
 }
 
 PROFILES = {
@@ -165,6 +169,20 @@ PROFILES = {
         RAFT_TPU_DONATE="1",
         RAFT_TPU_PAGED="0",
         RAFT_TPU_TIER="1",
+    ),
+    # the cross-host fabric's dispatch-boundary jits (fabric/extract.py,
+    # fabric/inject.py): planes off so the jaxprs are pure gather/scatter
+    # over the fabric carry; the carry buffers they return feed the next
+    # donated round, so hygiene/donation are load-bearing
+    "fabric": dict(
+        _BASE,
+        RAFT_TPU_METRICS="0",
+        RAFT_TPU_CHAOS="0",
+        RAFT_TPU_TRACELOG="0",
+        RAFT_TPU_DIET="0",
+        RAFT_TPU_DONATE="0",
+        RAFT_TPU_PAGED="0",
+        RAFT_TPU_FABRIC="1",
     ),
 }
 
@@ -479,6 +497,44 @@ def _tier_entries():
     ]
 
 
+def _fabric_entries():
+    import jax.numpy as jnp
+
+    from raft_tpu.fabric import extract as fx
+    from raft_tpu.fabric import inject as fi
+    from raft_tpu.fabric.placement import Placement
+
+    # the canonical milestone-1 geometry: two hosts, group 1 spanning
+    pl = Placement.mostly_local(4, 3, 2, spanning=(1,))
+    cl = _cluster("xla")
+    host = 0
+    cap = len(fx.CHANNELS) * pl.n_cross_cells(host)
+    xedge = jnp.asarray(pl.xedge(host))
+    own = jnp.asarray(pl.own_mask(host))
+    e = int(cl.fab.rep.ent_term.shape[-1])
+    n = cl.state.term.shape[0]
+    chan = jnp.zeros((cap,), jnp.int32)
+    cell = jnp.zeros((cap,), jnp.int32)
+    valid = jnp.zeros((cap,), bool)
+    cols = {f: jnp.zeros((cap,), jnp.int32) for f in fx.SCALAR_FIELDS}
+    cols.update(
+        {f: jnp.zeros((cap, e), jnp.int32) for f in fx.ENT_FIELDS}
+    )
+    common = dict(
+        kwargs={}, static={}, donate=False,
+        donate_argnums=(), donate_argnames=(),
+        checks=("capture", "hygiene", "donation"),
+        lanes=n, rounds=1,
+    )
+    return [
+        dict(common, name="fabric.extract", fn=fx.extract_bundle,
+             jit=fx._extract_jit, args=(cl.fab, xedge, own),
+             static=dict(cap=cap)),
+        dict(common, name="fabric.inject", fn=fi.inject_bundle,
+             jit=fi._inject_jit, args=(cl.fab, chan, cell, valid, cols)),
+    ]
+
+
 _ALL_ON = {"metrics": True, "chaos": True, "trace": True, "paged": False,
            "tier": False}
 _ALL_OFF = {"metrics": False, "chaos": False, "trace": False,
@@ -534,6 +590,11 @@ ENTRIES = (
           expect_on=_TIER_ON),
     Entry("tier.scatter", "tier", _tier_entries, compile_budget=1,
           expect_on=_TIER_ON),
+    # the cross-host fabric's per-round boundary pair (ISSUE 18): the
+    # O(active) outbound gather-and-clear and the capped inbound scatter
+    # — each produces the fabric carry the next donated round consumes
+    Entry("fabric.extract", "fabric", _fabric_entries, compile_budget=1),
+    Entry("fabric.inject", "fabric", _fabric_entries, compile_budget=1),
 )
 
 

@@ -44,6 +44,15 @@ class _Event:
     asymmetric: bool = False
 
 
+@dataclasses.dataclass
+class _WireEvent:
+    kind: str  # "wire_partition" | "wire_delay"
+    start: int
+    end: int  # exclusive
+    edges: tuple  # directed (src_host, dst_host) pairs
+    delay: int = 0  # extra rounds a deferred frame waits (wire_delay)
+
+
 class ChaosSchedule:
     """Fault scenario over G groups x V voters. Every builder returns self
     for chaining; rounds are absolute. Scenarios that end in a heal
@@ -54,6 +63,11 @@ class ChaosSchedule:
         self.events: list[_Event] = []
         # heal phases: round -> set of groups expected to recover by then
         self.heals: dict[int, set] = {}
+        # wire-plane faults (cross-host fabric, raft_tpu/fabric): whole
+        # frames dropped or deferred on directed (src_host, dst_host)
+        # edges — consulted by the fabric drivers via wire_plan(), never
+        # compiled into device columns
+        self.wire_events: list[_WireEvent] = []
 
     # -- scenario builders -------------------------------------------------
 
@@ -174,10 +188,73 @@ class ChaosSchedule:
         self._heal(last, gs)
         return self
 
+    # -- wire-plane builders (cross-host fabric) ---------------------------
+
+    @staticmethod
+    def _wire_edges(edges, symmetric):
+        es = {(int(a), int(b)) for a, b in edges}
+        if symmetric:
+            es |= {(b, a) for a, b in es}
+        return tuple(sorted(es))
+
+    def wire_partition(self, edges, at, duration, groups=(), symmetric=True):
+        """Drop WHOLE frames on the given directed (src_host, dst_host)
+        wire edges for [at, at+duration) — the cross-host analog of
+        partition(): every spanning-group message riding those edges is
+        lost, deterministically, while host-local traffic is untouched.
+        `groups` (spanning groups expected to re-elect once the wire
+        heals) registers a recovery-probe phase at the heal round, same
+        SLO machinery as the device-plane faults."""
+        self.wire_events.append(
+            _WireEvent(
+                "wire_partition", int(at), int(at + duration),
+                self._wire_edges(edges, symmetric),
+            )
+        )
+        if groups:
+            self._heal(at + duration, self._groups(groups))
+        return self
+
+    def wire_delay(self, edges, at, duration, rounds=1, symmetric=True):
+        """Defer frames on the given wire edges by `rounds` extra round
+        boundaries for [at, at+duration): a deterministic slow link. No
+        probe phase — delay is degradation, not an outage (raft absorbs
+        it as message latency)."""
+        if rounds < 1:
+            raise ValueError("wire_delay needs rounds >= 1")
+        self.wire_events.append(
+            _WireEvent(
+                "wire_delay", int(at), int(at + duration),
+                self._wire_edges(edges, symmetric), delay=int(rounds),
+            )
+        )
+        return self
+
+    def wire_plan(self, rnd: int) -> dict:
+        """The wire faults in force at absolute round `rnd`:
+        {"drop": set[(src, dst)], "delay": {(src, dst): extra_rounds}}.
+        Overlapping delays on one edge: the largest wins; a dropped edge
+        is dropped regardless of delays."""
+        drop: set = set()
+        delay: dict = {}
+        for e in self.wire_events:
+            if not e.start <= rnd < e.end:
+                continue
+            if e.kind == "wire_partition":
+                drop.update(e.edges)
+            else:
+                for edge in e.edges:
+                    delay[edge] = max(delay.get(edge, 0), e.delay)
+        return {"drop": drop, "delay": delay}
+
     # -- compilation -------------------------------------------------------
 
     def horizon(self) -> int:
-        ends = [e.end for e in self.events] + list(self.heals)
+        ends = (
+            [e.end for e in self.events]
+            + [e.end for e in self.wire_events]
+            + list(self.heals)
+        )
         return max(ends, default=0)
 
     def segments(self, settle: int) -> list[tuple[int, int]]:

@@ -1,0 +1,48 @@
+"""Cross-host fabric: N independent engine processes as one logical fleet.
+
+The mesh driver (parallel/mesh.py) tops out at one process; this package
+is ROADMAP item 4's milestone 1 — federate several engine PROCESSES over
+a framed wire so groups placed host-local never touch the network and
+only cross-host quorums pay it (the bridge-framing path, scaled from the
+per-message RawNode bridge to the fused engine's channel fabric):
+
+  placement.py  global (group, voter) id space partitioned into per-host
+                shards; spanning groups get their cross-host fabric edges
+                marked at construction (static [N, V] masks)
+  extract.py    jitted O(active) kernel (ops/ready_mask.py style) pulling
+                only the cross-host outbound cells from the round carry
+                into a compact host bundle, clearing them so ghost lanes
+                never receive locally
+  wire.py       length-prefixed frames over sockets/pipes — byte-exact
+                raftpb via runtime/codec.py's columnar frame codec, or a
+                raw columnar encoding with an EQuARX-style sub-int16 diet
+                (RAFT_TPU_FABRIC_DIET)
+  inject.py     decoded frames land as fabric ops at the destination
+                host's next round boundary, exactly like local ops
+  driver.py     round-synchronous lockstep coordinator (milestone 1) +
+                the multiprocess launcher tests/benches fork workers with
+
+Everything is gated behind RAFT_TPU_FABRIC (default OFF, read through
+config accessors at construction): with the knob off no fabric object can
+be built and no fabric jit exists — the same full-elision contract as the
+metrics/chaos/trace planes.
+"""
+
+from __future__ import annotations
+
+from raft_tpu import config
+
+
+def fabric_enabled() -> bool:
+    """RAFT_TPU_FABRIC (default OFF), read at construction like the other
+    planes: FabricHost/LockstepFabric refuse to build when off, so the
+    extract/inject jits never exist in a fabric-off process."""
+    return config.env_flag("RAFT_TPU_FABRIC", default=False)
+
+
+def fabric_cap() -> int:
+    """RAFT_TPU_FABRIC_CAP: static extract/inject bundle capacity override
+    (messages per round per host). 0 (default) derives the lossless bound
+    4 x cross-host cells — one message per channel per edge per round is
+    the most one round can emit, so the default can never drop."""
+    return config.env_int("RAFT_TPU_FABRIC_CAP", default=0)

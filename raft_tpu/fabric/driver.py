@@ -1,0 +1,547 @@
+"""Round-synchronous fabric drivers: lockstep coordinator + mp launcher.
+
+Milestone-1 protocol (ROADMAP item 4): every host runs the FULL
+monolithic geometry FusedCluster(G, V, seed) — identical per-lane PRNG
+streams and randomized timeouts as the single-process cluster — with
+non-owned lanes marked as ghosts (bridge.py idiom: own-view learner bit,
+so no tick can ever campaign them). Each lockstep round is then
+
+    inject pending frames -> run(1) -> extract cross-host cells (clear
+    them) -> exchange one frame per (peer, round)
+
+which reproduces the monolithic emit-round-r / consume-round-r+1 message
+latency exactly, in both directions: a cross-host message extracted
+after round r is injected at the destination before round r+1, landing
+in the ghost sender's outbox cell so the next round's route transpose
+delivers it like resident traffic. Owned-lane state trajectories are
+therefore BIT-IDENTICAL to the monolithic run — the digest-parity
+oracle tests/test_fabric.py and benches/fabric_ab.py gate on.
+
+Persist-before-send: the fused round's synchronous persist has already
+advanced `stabled` past every appended entry by the time run(1) returns
+(the WAL push happens inside the round program's dispatch fence), so any
+frame encoded from the post-round carry only carries messages whose
+entries are locally stable — the raft thesis §10.2 ordering, inherited
+rather than re-implemented.
+
+Wire chaos (ChaosSchedule.wire_partition / wire_delay) is applied on the
+SENDER side through WireGate: a dropped edge still sends an empty frame
+(the frame is the round barrier), a delayed bundle is held and merged
+into a later round's frame. Both drivers consult the same schedule so
+in-process and multi-process runs replay identical fault timelines.
+
+Two drivers:
+  LockstepFabric     all hosts in one process (units, chaos probes,
+                     per-round trajectory digests without IPC)
+  run_fabric_workers spawn one OS process per host, pairwise pipes,
+                     blocking recv per (peer, round) as the barrier —
+                     the real multi-process milestone artifact
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+import traceback
+
+import numpy as np
+
+from raft_tpu.fabric import fabric_enabled
+from raft_tpu.fabric.extract import (
+    Bundle,
+    FabricExtractor,
+    merge_bundles,
+    split_bundle,
+)
+from raft_tpu.fabric.inject import FabricInjector
+from raft_tpu.fabric.placement import Placement
+from raft_tpu.fabric.wire import FabricWire, recv_frame, send_frame
+from raft_tpu.metrics.host import HostCounters
+from raft_tpu.utils.profiling import SpanRecorder
+
+
+# -- trajectory digests ----------------------------------------------------
+
+
+def state_leaves(cluster) -> list:
+    """Global [N]-leading numpy leaves of a cluster's slim-canonical
+    host_state, concatenating blocks in lane order for blocked clusters —
+    the digest's byte source (jax.tree leaf order is deterministic)."""
+    import jax
+
+    blocks = getattr(cluster, "blocks", None)
+    if not blocks:
+        return [np.asarray(x) for x in jax.tree.leaves(cluster.host_state())]
+    per = [
+        [np.asarray(x) for x in jax.tree.leaves(b.host_state())]
+        for b in blocks
+    ]
+    return [np.concatenate([rows[i] for rows in per]) for i in range(len(per[0]))]
+
+
+def owned_rows(cluster, own: np.ndarray) -> list:
+    """The host's owned-lane slice of every state leaf."""
+    own = np.asarray(own)
+    return [leaf[own] for leaf in state_leaves(cluster)]
+
+
+class TrajectoryDigest:
+    """Chained per-round sha256 over a fixed lane subset's state leaves.
+    A multi-host run hashes each host's OWNED rows independently (no
+    cross-process stitching needed); the monolithic twin reproduces each
+    host's chain by masking its own global state with that host's own
+    mask at the same round boundaries. fleet_digest folds the per-host
+    chains into the single run digest the oracles compare."""
+
+    def __init__(self):
+        self._h = hashlib.sha256()
+
+    def update(self, rows) -> None:
+        for r in rows:
+            self._h.update(np.ascontiguousarray(r).tobytes())
+
+    def hex(self) -> str:
+        return self._h.hexdigest()
+
+
+def fleet_digest(host_hexes) -> str:
+    h = hashlib.sha256()
+    for x in host_hexes:
+        h.update(bytes.fromhex(x))
+    return h.hexdigest()
+
+
+def mono_fleet_digest(cluster, placement, rounds, ops_spec=None, **run_kw) -> str:
+    """Run the monolithic twin round by round and fold the per-host-mask
+    trajectory chains exactly like the fabric drivers do. `cluster` is a
+    FusedCluster or BlockedFusedCluster on the same (G, V, seed)."""
+    tds = [TrajectoryDigest() for _ in range(placement.n_hosts)]
+    masks = [placement.own_mask(h) for h in range(placement.n_hosts)]
+    for r in range(rounds):
+        ops = cluster.ops(**ops_spec) if (ops_spec and r == 0) else None
+        cluster.run(1, ops=ops, **run_kw)
+        leaves = state_leaves(cluster)
+        for td, own in zip(tds, masks):
+            td.update([leaf[own] for leaf in leaves])
+    return fleet_digest([td.hex() for td in tds])
+
+
+# -- ops + ghost plumbing --------------------------------------------------
+
+
+def _filter_ops_spec(spec: dict, own: np.ndarray) -> dict:
+    """Restrict a {field: {lane: value}} ops spec to owned lanes. Specs
+    are dict-of-dicts only (the make_local_ops dict form): ghosts must
+    never receive local ops, and the owner applies the identical value
+    the monolithic twin does."""
+    out = {}
+    for field, lanes in spec.items():
+        if not isinstance(lanes, dict):
+            raise TypeError(
+                f"fabric ops spec field {field!r} must be a dict of "
+                "{lane: value} so it can be split by owner"
+            )
+        kept = {ln: v for ln, v in lanes.items() if own[int(ln)]}
+        if kept:
+            out[field] = kept
+    return out
+
+
+def _mark_ghosts(cl, ghost: np.ndarray, v: int) -> None:
+    """bridge.py's ghost idiom on a built FusedCluster: set the ghost's
+    learner bit in its OWN learners row (promotable() reads the mask at
+    the self slot, so no tick can ever campaign it) plus the is_learner
+    mirror; other lanes' masks are untouched and still count the member
+    as a voter. Diet-aware: mutate the unpacked view, restore the packed
+    layout."""
+    import jax.numpy as jnp
+
+    from raft_tpu.state import is_packed, pack_state, unpack_state
+
+    packed = is_packed(cl.state)
+    st = unpack_state(cl.state)
+    lanes = np.nonzero(ghost)[0]
+    lrn = np.asarray(st.learners).copy()
+    lrn[lanes, lanes % v] = True
+    st = dataclasses.replace(
+        st,
+        learners=jnp.asarray(lrn, dtype=st.learners.dtype),
+        is_learner=jnp.asarray(
+            np.asarray(st.is_learner) | ghost, dtype=st.is_learner.dtype
+        ),
+    )
+    cl.state = pack_state(st) if packed else st
+
+
+# -- wire chaos gate -------------------------------------------------------
+
+
+class WireGate:
+    """Sender-side wire fault application (ChaosSchedule wire plane).
+    Deterministic by construction: both drivers consult the same absolute
+    round, and faults never depend on payload contents."""
+
+    def __init__(self, schedule, counters: HostCounters, n_ents: int):
+        self.schedule = schedule
+        self.counters = counters
+        self.e = n_ents
+        self._held: dict = {}  # (src, dst) -> [(release_round, Bundle)]
+
+    def outbound(self, rnd: int, src: int, dst: int, bundle) -> Bundle:
+        """Gate one edge's outbound bundle at round `rnd` -> the bundle to
+        put on this round's frame (empty when dropped/deferred; deferred
+        bundles from earlier rounds merge in once due)."""
+        edge = (src, dst)
+        held = self._held.setdefault(edge, [])
+        ready = [b for rel, b in held if rel <= rnd]
+        held[:] = [(rel, b) for rel, b in held if rel > rnd]
+        if self.schedule is None:
+            return merge_bundles([bundle] + ready, self.e, rnd)
+        plan = self.schedule.wire_plan(rnd)
+        d = plan["delay"].get(edge, 0)
+        if d and bundle is not None and bundle.count:
+            held.append((rnd + d, bundle))
+            self.counters.inc("fabric_frames_deferred")
+            bundle = None
+        out = merge_bundles([bundle] + ready, self.e, rnd)
+        if edge in plan["drop"]:
+            if out.count:
+                self.counters.inc("fabric_frames_dropped")
+            out = Bundle.empty(self.e, rnd)
+        return out
+
+
+# -- one host's view -------------------------------------------------------
+
+
+class FabricHost:
+    """One host's slice of the fleet: the full-geometry engine with ghost
+    lanes, the extract/inject endpoints, the wire codec, counters, spans,
+    and (optionally) the owned-lane trajectory chain."""
+
+    def __init__(
+        self,
+        placement: Placement,
+        host: int,
+        seed: int = 1,
+        shape=None,
+        cap: int | None = None,
+        schedule=None,
+        track_trajectory: bool = False,
+        **cfg,
+    ):
+        if not fabric_enabled():
+            raise RuntimeError(
+                "cross-host fabric is disabled: set RAFT_TPU_FABRIC=1"
+            )
+        from raft_tpu.ops.fused import FusedCluster
+
+        self.placement = placement
+        self.host = int(host)
+        self.cl = FusedCluster(
+            placement.n_groups, placement.n_voters, seed=seed, shape=shape, **cfg
+        )
+        self.v = placement.n_voters
+        self.e = int(self.cl.fab.rep.ent_term.shape[-1])
+        self.own = placement.own_mask(host)
+        if (~self.own).any():
+            _mark_ghosts(self.cl, ~self.own, self.v)
+        self.counters = HostCounters()
+        # pre-seed the full fabric family so snapshots export a stable
+        # schema (a zero drop counter is a signal, not a missing series)
+        from raft_tpu.metrics.host import FABRIC_COUNTERS
+
+        for name in FABRIC_COUNTERS:
+            self.counters.inc(name, 0)
+        self.spans = SpanRecorder()
+        self.extractor = FabricExtractor(placement, host, cap)
+        self.injector = FabricInjector(placement, host, cap)
+        self.wire = FabricWire(self.v, self.e, counters=self.counters)
+        self.gate = WireGate(schedule, self.counters, self.e)
+        self.peers = placement.peers(host)
+        self.trajectory = TrajectoryDigest() if track_trajectory else None
+        self._pending: list = []
+        self.round = 0
+
+    # -- one lockstep round ------------------------------------------------
+
+    def step(self, ops_spec=None, **run_kw) -> dict:
+        """Inject pending -> run(1) -> extract -> gate + encode. Returns
+        {peer: frame_bytes}, ALWAYS one frame per peer (empty frames are
+        the round barrier). ops_spec is the global {field: {lane: value}}
+        dict, filtered to owned lanes here (the mono twin applies it
+        whole)."""
+        rnd = self.round
+        merged = merge_bundles(self._pending, self.e, rnd)
+        self._pending = []
+        if merged.count:
+            fab, injected, dropped = self.injector(self.cl.fab, merged)
+            self.cl.fab = fab
+            self.counters.inc("fabric_msgs_injected", injected)
+            if dropped:
+                self.counters.inc("fabric_injection_drops", dropped)
+        ops = None
+        if ops_spec:
+            kept = _filter_ops_spec(ops_spec, self.own)
+            if kept:
+                ops = self.cl.ops(**kept)
+        self.cl.run(1, ops=ops, **run_kw)
+        fab, bundle, total = self.extractor(self.cl.fab, rnd)
+        if bundle is not None:
+            self.cl.fab = fab
+            self.counters.inc("fabric_msgs_exported", bundle.count)
+        self.counters.inc("fabric_msgs_total", int(total))
+        parts = split_bundle(bundle, self.placement, self.e)
+        frames = {}
+        for p in self.peers:
+            out = self.gate.outbound(rnd, self.host, p, parts.get(p))
+            frame = self.wire.encode(out, rnd)
+            if out.count:
+                self.spans.spans.append((
+                    "fabric_tx", time.perf_counter(), 0.0,
+                    dict(round=rnd, peer=p, msgs=out.count,
+                         bytes=len(frame), groups=self._groups_of(out)),
+                ))
+            frames[p] = frame
+        if self.trajectory is not None:
+            self.trajectory.update(owned_rows(self.cl, self.own))
+        self.round += 1
+        return frames
+
+    def receive(self, frame: bytes, peer: int = -1) -> None:
+        """Decoded frames become next round's injections (bridge IMPORT)."""
+        b = self.wire.decode(frame)
+        if b.count:
+            self._pending.append(b)
+            self.spans.spans.append((
+                "fabric_rx", time.perf_counter(), 0.0,
+                dict(round=b.round, peer=peer, msgs=b.count,
+                     bytes=len(frame), groups=self._groups_of(b)),
+            ))
+
+    def _groups_of(self, bundle: Bundle) -> tuple:
+        vv = self.v * self.v
+        return tuple(sorted({int(c) // vv for c in bundle.cell}))
+
+    # -- observability -----------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """Fabric counters folded with the engine's device snapshot (when
+        RAFT_TPU_METRICS=1), mirrored process-wide for /metrics exports."""
+        from raft_tpu.metrics.host import merge_snapshots, record_fabric_stats
+
+        record_fabric_stats(self.counters.counts)
+        snaps = [self.counters.snapshot()]
+        eng = self.cl.metrics_snapshot()
+        if eng is not None:
+            snaps.append(eng)
+        return merge_snapshots(snaps)
+
+
+# -- in-process lockstep coordinator ---------------------------------------
+
+
+class LockstepFabric:
+    """All hosts of a placement in one process, stepped in lockstep —
+    the unit-test / chaos-probe driver (no IPC, same protocol and same
+    WireGate semantics as the spawned workers)."""
+
+    def __init__(self, placement: Placement, seed: int = 1, **host_kw):
+        self.placement = placement
+        self.hosts = [
+            FabricHost(placement, h, seed=seed, **host_kw)
+            for h in range(placement.n_hosts)
+        ]
+        self.round = 0
+
+    def run(self, rounds: int = 1, ops_spec=None, **run_kw) -> "LockstepFabric":
+        for i in range(rounds):
+            spec = ops_spec if i == 0 else None
+            frames = {fh.host: fh.step(spec, **run_kw) for fh in self.hosts}
+            for src, out in frames.items():
+                for dst, frame in out.items():
+                    self.hosts[dst].receive(frame, peer=src)
+            self.round += 1
+        return self
+
+    # -- stitched inspection ----------------------------------------------
+
+    def state_columns(self, *names) -> dict:
+        """Global columns stitched from each host's owned lanes."""
+        out = {}
+        for name in names:
+            full = None
+            for fh in self.hosts:
+                col = fh.cl.state_columns(name)[name]
+                if full is None:
+                    full = np.zeros_like(col)
+                full[fh.own] = col[fh.own]
+            out[name] = full
+        return out
+
+    def leader_lanes(self) -> np.ndarray:
+        from raft_tpu.types import StateType
+
+        st = self.state_columns("state")["state"]
+        return np.nonzero(st == int(StateType.LEADER))[0]
+
+    def digest(self) -> str:
+        """Stitched digest of the CURRENT state (end-state oracle)."""
+        h = hashlib.sha256()
+        parts = [(fh.own, owned_rows(fh.cl, fh.own)) for fh in self.hosts]
+        n = self.placement.n_lanes
+        for i in range(len(parts[0][1])):
+            sample = parts[0][1][i]
+            full = np.zeros((n,) + sample.shape[1:], sample.dtype)
+            for own, rows in parts:
+                full[own] = rows[i]
+            h.update(np.ascontiguousarray(full).tobytes())
+        return h.hexdigest()
+
+    def fleet_trajectory(self) -> str:
+        """fleet_digest over the hosts' chained trajectories (needs
+        track_trajectory=True)."""
+        return fleet_digest([fh.trajectory.hex() for fh in self.hosts])
+
+    def metrics_snapshot(self) -> dict:
+        from raft_tpu.metrics.host import merge_snapshots
+
+        return merge_snapshots([fh.metrics_snapshot() for fh in self.hosts])
+
+    def check_no_errors(self) -> None:
+        for fh in self.hosts:
+            fh.cl.check_no_errors()
+
+
+# -- multiprocess launcher -------------------------------------------------
+
+
+def _fabric_worker(host_id: int, placement: Placement, conns: dict, result, cfg: dict):
+    """One spawned host process: lockstep rounds against pipe peers. The
+    blocking recv per (peer, round) IS the round barrier — every peer
+    sends exactly one frame per round, empty or not."""
+    try:
+        fh = FabricHost(
+            placement,
+            host_id,
+            seed=cfg["seed"],
+            cap=cfg.get("cap"),
+            schedule=cfg.get("schedule"),
+            track_trajectory=True,
+            **cfg.get("cluster_cfg") or {},
+        )
+        for r in range(cfg["rounds"]):
+            spec = cfg.get("ops_spec") if r == 0 else None
+            frames = fh.step(spec, **cfg.get("run_kw") or {})
+            for p, frame in frames.items():
+                send_frame(conns[p], frame)
+            for p in fh.peers:
+                fh.receive(recv_frame(conns[p]), peer=p)
+        own = fh.own
+        leaders = [int(x) for x in fh.cl.leader_lanes() if own[int(x)]]
+        cols = fh.cl.state_columns("state", "term", "committed", "lead")
+        result.put(
+            dict(
+                host=host_id,
+                own=own,
+                rows=owned_rows(fh.cl, own),
+                digest=fh.trajectory.hex(),
+                counters=dict(fh.counters.counts),
+                leaders=leaders,
+                columns={k: v for k, v in cols.items()},
+                n_spans=len(fh.spans.spans),
+            )
+        )
+    except Exception:
+        result.put(dict(host=host_id, error=traceback.format_exc()))
+
+
+def run_fabric_workers(
+    placement: Placement,
+    *,
+    rounds: int,
+    seed: int = 1,
+    ops_spec=None,
+    run_kw=None,
+    schedule=None,
+    cap=None,
+    cluster_cfg=None,
+    timeout: float = 600.0,
+) -> list:
+    """Fork one worker process per host (spawn context — children inherit
+    the parent's RAFT_TPU_* env), wire pairwise pipes between fabric
+    peers, run `rounds` lockstep rounds, and return the per-host result
+    dicts (own mask, owned state rows, trajectory digest, counters,
+    leaders, state columns) in host order."""
+    if not fabric_enabled():
+        raise RuntimeError("cross-host fabric is disabled: set RAFT_TPU_FABRIC=1")
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    n = placement.n_hosts
+    conns: dict = {h: {} for h in range(n)}
+    for a in range(n):
+        for b in placement.peers(a):
+            if b > a:
+                ca, cb = ctx.Pipe()
+                conns[a][b] = ca
+                conns[b][a] = cb
+    q = ctx.Queue()
+    cfg = dict(
+        seed=seed,
+        rounds=rounds,
+        ops_spec=ops_spec,
+        run_kw=run_kw,
+        schedule=schedule,
+        cap=cap,
+        cluster_cfg=cluster_cfg,
+    )
+    procs = [
+        ctx.Process(
+            target=_fabric_worker,
+            args=(h, placement, conns[h], q, cfg),
+            daemon=True,
+        )
+        for h in range(n)
+    ]
+    for p in procs:
+        p.start()
+    results: dict = {}
+    deadline = time.time() + timeout
+    try:
+        while len(results) < n:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"fabric workers timed out; got {sorted(results)} of {n}"
+                )
+            r = q.get(timeout=remaining)
+            if "error" in r:
+                raise RuntimeError(
+                    f"fabric worker {r['host']} failed:\n{r['error']}"
+                )
+            results[r["host"]] = r
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    return [results[h] for h in range(n)]
+
+
+def workers_fleet_digest(results) -> str:
+    """fleet_digest over worker results (host order)."""
+    return fleet_digest([r["digest"] for r in results])
+
+
+def stitched_columns(results, n_lanes: int) -> dict:
+    """Global state columns stitched from worker results."""
+    out: dict = {}
+    for r in results:
+        own = np.asarray(r["own"])
+        for name, col in r["columns"].items():
+            if name not in out:
+                out[name] = np.zeros_like(col)
+            out[name][own] = col[own]
+    return out

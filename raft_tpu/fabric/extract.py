@@ -1,0 +1,227 @@
+"""Egress extraction: cross-host outbound fabric cells -> compact bundle.
+
+One jitted kernel per host (ops/ready_mask.py style): a [4 * N * V]
+presence mask (per-channel kind != MSG_NONE, restricted to the host's
+static xedge cells) is cumsum-compacted into a dense index prefix, the
+message fields are gathered through that prefix into `cap`-sized columns,
+and the exported cells' kinds are cleared to MSG_NONE in the returned
+carry — so ghost lanes never receive locally and the wire is the ONLY
+path a cross-host message can take. Device->host transfer is O(active):
+`cap` columns regardless of fleet size, trimmed to the actual count on
+the host.
+
+The gathered columns are the superset of all four channel schemas
+(rep/hb/vote/vresp; placement.CHANNELS order); fields a channel lacks
+gather as 0 and are never scattered back on the inject side, so the
+gather/scatter pair is symmetric per channel. Entry columns ([cap, E])
+only exist on the rep channel and use a second fill-gather.
+
+Clearing preserves the stored carry dtypes (slim int8 kinds under
+FABRIC_SLIM), while reads go through unpack_fabric + fat_fabric so the
+same kernel serves diet and non-diet carries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.fabric import fabric_cap
+from raft_tpu.fabric.placement import CHANNELS, decode_positions
+from raft_tpu.ops import fused as fz
+from raft_tpu.ops.ready_mask import compact_mask
+from raft_tpu.types import MessageType as MT
+
+I32 = jnp.int32
+
+# Superset scalar schema, one [cap] i32 column per name on the wire; the
+# per-channel subsets below drive both the gather here and the scatter in
+# inject.py (a channel's dataclass fields are exactly its subset).
+SCALAR_FIELDS = (
+    "kind",
+    "term",
+    "index",
+    "log_term",
+    "commit",
+    "reject",
+    "reject_hint",
+    "n_ents",
+    "context",
+    "snap_index",
+    "snap_term",
+)
+ENT_FIELDS = ("ent_term", "ent_type", "ent_bytes")  # rep only, [cap, E]
+
+BUNDLE_FIELDS = SCALAR_FIELDS + ENT_FIELDS
+
+
+@dataclasses.dataclass
+class Bundle:
+    """Host-side decoded extract output: k messages in columnar form.
+    chan indexes placement.CHANNELS; cell = src_lane * V + dst_slot in
+    the CANONICAL (global) lane space, identical on every host."""
+
+    chan: np.ndarray  # [k] u8
+    cell: np.ndarray  # [k] u32
+    cols: dict  # {name: [k] i32} scalars + {ent_*: [k, E] i32}
+    round: int = -1
+
+    @property
+    def count(self) -> int:
+        return int(self.chan.shape[0])
+
+    @classmethod
+    def empty(cls, n_ents: int, rnd: int = -1) -> "Bundle":
+        cols = {f: np.zeros((0,), np.int32) for f in SCALAR_FIELDS}
+        cols.update({f: np.zeros((0, n_ents), np.int32) for f in ENT_FIELDS})
+        return cls(np.zeros((0,), np.uint8), np.zeros((0,), np.uint32), cols, rnd)
+
+
+def merge_bundles(bundles, n_ents: int, rnd: int = -1) -> Bundle:
+    """Concatenate bundles (the wire-delay release path merges deferred
+    bundles into the current frame). Distinct (chan, cell) sets by
+    construction — each cell is extracted by exactly one owner host."""
+    bundles = [b for b in bundles if b is not None and b.count]
+    if not bundles:
+        return Bundle.empty(n_ents, rnd)
+    cols = {
+        f: np.concatenate([b.cols[f] for b in bundles]) for f in BUNDLE_FIELDS
+    }
+    return Bundle(
+        np.concatenate([b.chan for b in bundles]),
+        np.concatenate([b.cell for b in bundles]),
+        cols,
+        rnd,
+    )
+
+
+def extract_bundle(fab, xedge, own, *, cap: int):
+    """Pull (and clear) the cross-host outbound cells of one round carry.
+
+    fab    the post-round Fabric carry (slim and/or diet-packed dtypes)
+    xedge  [N, V] bool static outbound cross-host cells (placement.xedge)
+    own    [N] bool static owned-lane mask (for the msgs_total count)
+    cap    static bundle capacity; count > cap is detected on the host
+
+    Returns (cleared_fab, out) where out carries pos [cap] (flat position
+    chan * N*V + cell, tail = sentinel 4*N*V), count, total (ALL non-NONE
+    owned-src messages this round, local + cross — the bench's
+    cross-vs-total denominator), and the gathered superset columns.
+    """
+    wide = fz.fat_fabric(fz.unpack_fabric(fab))
+    n, v = wide.hb.kind.shape
+    nv = n * v
+    chans = tuple(getattr(wide, c) for c in CHANNELS)
+
+    pres = [((c.kind != MT.MSG_NONE) & xedge).reshape(nv) for c in chans]
+    active, count = compact_mask(jnp.concatenate(pres))
+    idx = active[:cap]  # [cap], tail = 4*nv sentinel -> fill-gathers 0
+
+    total = sum(
+        jnp.sum(((c.kind != MT.MSG_NONE) & own[:, None]).astype(I32))
+        for c in chans
+    )
+
+    def stack(field):
+        cols = []
+        for c in chans:
+            x = getattr(c, field, None)
+            cols.append(
+                x.reshape(nv).astype(I32)
+                if x is not None
+                else jnp.zeros((nv,), I32)
+            )
+        return jnp.concatenate(cols)
+
+    out = {
+        f: jnp.take(stack(f), idx, mode="fill", fill_value=0)
+        for f in SCALAR_FIELDS
+    }
+    # rep-only entry columns: gather rows of [nv, E] by cell, but only for
+    # positions in the rep channel block (chan 0 <=> pos < nv)
+    ent_idx = jnp.where(idx < nv, idx % nv, nv)
+    for f in ENT_FIELDS:
+        x = getattr(wide.rep, f)
+        out[f] = jnp.take(
+            x.reshape(nv, -1).astype(I32),
+            ent_idx,
+            axis=0,
+            mode="fill",
+            fill_value=0,
+        )
+    out["pos"] = idx
+    out["count"] = count
+    out["total"] = total
+
+    # clear every xedge cell (occupied or not: empties are already NONE)
+    # preserving the stored carry dtypes so slim/diet layouts round-trip
+    cleared = {}
+    for name in CHANNELS:
+        c = getattr(fab, name)
+        none = jnp.asarray(int(MT.MSG_NONE), c.kind.dtype)
+        cleared[name] = dataclasses.replace(
+            c, kind=jnp.where(xedge, none, c.kind)
+        )
+    return dataclasses.replace(fab, **cleared), out
+
+
+_extract_jit = jax.jit(extract_bundle, static_argnames=("cap",))
+
+
+class FabricExtractor:
+    """Per-host extract endpoint: owns the static masks, the capacity, and
+    the device->host trim. Hosts with no cross edges skip the kernel
+    entirely (pure-local placements never build a fabric program)."""
+
+    def __init__(self, placement, host: int, cap: int | None = None):
+        self.placement = placement
+        self.host = int(host)
+        self.n_cross = placement.n_cross_cells(host)
+        # lossless default: one message per channel per cross cell per
+        # round is the most one round can emit (the outbox is rebuilt
+        # from empty each round)
+        self.cap = int(
+            cap if cap is not None else (fabric_cap() or len(CHANNELS) * self.n_cross)
+        )
+        self._xedge = jnp.asarray(placement.xedge(host))
+        self._own = jnp.asarray(placement.own_mask(host))
+
+    def __call__(self, fab, rnd: int = -1):
+        """-> (cleared_fab, Bundle, total_msgs). Bundle is None when this
+        host has no cross edges (nothing to clear either)."""
+        if self.n_cross == 0:
+            return fab, None, 0
+        cleared, out = _extract_jit(fab, self._xedge, self._own, cap=self.cap)
+        count = int(out["count"])
+        if count > self.cap:
+            raise RuntimeError(
+                f"fabric extract overflow: {count} cross-host messages in one "
+                f"round > cap {self.cap} (host {self.host}); raise "
+                f"RAFT_TPU_FABRIC_CAP"
+            )
+        pos = np.asarray(out["pos"])[:count]
+        chan, cell, _src, _dst = decode_positions(
+            pos, self.placement.n_lanes, self.placement.n_voters
+        )
+        cols = {
+            f: np.asarray(out[f])[:count].astype(np.int32)
+            for f in BUNDLE_FIELDS
+        }
+        return cleared, Bundle(chan, cell, cols, rnd), int(out["total"])
+
+
+def split_bundle(bundle: Bundle, placement, n_ents: int) -> dict:
+    """Partition one host's extract bundle by destination host (the owner
+    of each message's dst lane) -> {host: Bundle}."""
+    out = {}
+    if bundle is None or bundle.count == 0:
+        return out
+    dst = placement.dst_host_of_cells(bundle.cell)
+    for h in np.unique(dst):
+        sel = dst == h
+        cols = {f: bundle.cols[f][sel] for f in BUNDLE_FIELDS}
+        out[int(h)] = Bundle(bundle.chan[sel], bundle.cell[sel], cols, bundle.round)
+    return out

@@ -1,0 +1,121 @@
+"""Ingress injection: decoded frames -> fabric cells at the round boundary.
+
+The mirror of extract.py, with bridge.py's IMPORT convention: a message
+from remote member R (a ghost lane here) to local member L lands in
+fabric cell [lane(R), slot(L)] — exactly where R's own outbox write
+would sit — so the next round's route_fabric transpose delivers it to L
+like any resident traffic. Injection happens between dispatches, before
+the next run, which reproduces the monolithic emit-round-r /
+consume-round-r+1 latency exactly (the wire exchange IS the round
+boundary in the lockstep driver).
+
+Host-side validation happens in numpy before the jit: a row whose dst
+lane is not owned here, or whose src lane is not a ghost here, or whose
+chan/cell is out of range, is dropped and counted
+(fabric_injection_drops) — a malformed or misrouted frame can never
+scribble on resident lanes. Valid rows are padded to the static
+capacity so every round reuses ONE jit signature.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.fabric import fabric_cap
+from raft_tpu.fabric.placement import CHANNELS
+from raft_tpu.fabric.extract import Bundle, ENT_FIELDS, SCALAR_FIELDS
+
+I32 = jnp.int32
+
+
+def inject_bundle(fab, chan, cell, valid, cols):
+    """Scatter validated wire rows into the fabric carry.
+
+    fab    the pre-round Fabric carry (slim/diet dtypes preserved)
+    chan   [cap] i32 channel index (placement.CHANNELS order)
+    cell   [cap] i32 flat fabric cell src_lane * V + dst_slot
+    valid  [cap] bool (padding + host-side-dropped rows are False)
+    cols   superset columns: [cap] i32 scalars, [cap, E] i32 ent_*
+
+    Each channel scatters exactly its own dataclass fields from the
+    superset (the extract gather's symmetric inverse); invalid rows
+    scatter to the out-of-range sentinel and drop.
+    """
+    n, v = fab.hb.kind.shape
+    nv = n * v
+    out = {}
+    for ci, name in enumerate(CHANNELS):
+        c = getattr(fab, name)
+        sel = jnp.where((chan == ci) & valid, cell, nv)
+        upd = {}
+        for f in dataclasses.fields(c):
+            x = getattr(c, f.name)
+            vals = cols[f.name].astype(x.dtype)
+            if f.name in ENT_FIELDS:
+                flat = x.reshape(nv, -1).at[sel].set(vals, mode="drop")
+            else:
+                flat = x.reshape(nv).at[sel].set(vals, mode="drop")
+            upd[f.name] = flat.reshape(x.shape)
+        out[name] = dataclasses.replace(c, **upd)
+    return dataclasses.replace(fab, **out)
+
+
+_inject_jit = jax.jit(inject_bundle)
+
+
+class FabricInjector:
+    """Per-host inject endpoint: validates decoded bundles in numpy, pads
+    to the static capacity, scatters on device. Returns the drop count so
+    the driver can feed fabric_injection_drops."""
+
+    def __init__(self, placement, host: int, cap: int | None = None):
+        self.placement = placement
+        self.host = int(host)
+        self.n_in = placement.n_in_cells(host)
+        # lossless bound, mirroring the extract side: one message per
+        # channel per inbound cell per round
+        self.cap = int(
+            cap if cap is not None else (fabric_cap() or len(CHANNELS) * self.n_in)
+        )
+        self._own = placement.own_mask(host)
+        self._in_cells = placement.in_cells(host).reshape(-1)
+
+    def __call__(self, fab, bundle: Bundle):
+        """-> (fab_with_injections, n_injected, n_dropped)."""
+        if bundle is None or bundle.count == 0:
+            return fab, 0, 0
+        k = bundle.count
+        if k > self.cap:
+            raise RuntimeError(
+                f"fabric inject overflow: {k} inbound messages in one round "
+                f"> cap {self.cap} (host {self.host}); raise "
+                f"RAFT_TPU_FABRIC_CAP"
+            )
+        chan = bundle.chan.astype(np.int64)
+        cell = bundle.cell.astype(np.int64)
+        nv = self._in_cells.shape[0]
+        ok = (chan >= 0) & (chan < len(CHANNELS)) & (cell >= 0) & (cell < nv)
+        # the landing site must be a legitimate inbound cell: src ghost
+        # here AND dst owned here (placement.in_cells precomputes that)
+        ok &= self._in_cells[np.clip(cell, 0, nv - 1)]
+        dropped = int((~ok).sum())
+        if dropped == k:
+            return fab, 0, dropped
+
+        def pad(x, fill=0):
+            full = np.full((self.cap,) + x.shape[1:], fill, x.dtype)
+            full[:k] = x
+            return jnp.asarray(full)
+
+        valid = pad(ok.astype(np.bool_))
+        cols = {
+            f: pad(bundle.cols[f]) for f in SCALAR_FIELDS + ENT_FIELDS
+        }
+        fab = _inject_jit(
+            fab, pad(chan.astype(np.int32)), pad(cell.astype(np.int32)), valid, cols
+        )
+        return fab, k - dropped, dropped

@@ -1,0 +1,194 @@
+"""Global (group, voter) placement over hosts — the fabric's static map.
+
+One logical fleet of `n_groups` x `n_voters` canonical lanes is
+partitioned over `n_hosts` processes by an `owners [G, V]` table: host
+`owners[g, j]` runs the real replica of member j of group g. Every host
+still constructs the FULL monolithic geometry (same seed, same per-lane
+PRNG and timeouts as the single-process cluster — that identity is what
+the digest-parity oracle leans on); lanes owned elsewhere are ghosts in
+the bridge sense (runtime/bridge.py): marked learners in their own view
+so no tick can ever campaign them, stripped of inbound traffic by the
+extract kernel, and therefore forever silent — free outbox space whose
+cells carry the owner's outbound cross-host messages.
+
+Everything here is host-side numpy computed once at construction; the
+products are the STATIC masks the jitted extract/inject kernels close
+over:
+
+  own_mask(h)   [N]    lanes host h runs for real
+  ghost_mask(h) [N]    lanes host h mirrors for geometry only
+  xedge(h)      [N, V] outbound cross-host fabric cells: src lane owned
+                       by h, dst slot's lane owned elsewhere
+  in_cells(h)   [N, V] the inbound mirror (src ghost, dst owned) — the
+                       inject kernel's landing sites
+
+A group whose V members all land on one host never appears in any xedge
+mask — host-local groups provably never touch the wire.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Wire channel indexes: position of each Fabric channel in the extract
+# bundle's flattened [4 * N * V] presence mask (and in every frame row).
+# self_ never crosses the wire: it is the lane's message to itself.
+CHANNELS = ("rep", "hb", "vote", "vresp")
+N_CHANNELS = len(CHANNELS)
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """Immutable fleet map; plain ints + one numpy table, so it pickles
+    cleanly into spawned worker processes."""
+
+    n_groups: int
+    n_voters: int
+    n_hosts: int
+    owners: np.ndarray  # [G, V] int32 host id of each member
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def contiguous(cls, n_groups: int, n_voters: int, n_hosts: int) -> "Placement":
+        """Groups split into contiguous per-host runs, all members local:
+        the all-local baseline (zero wire traffic)."""
+        per = -(-n_groups // n_hosts)  # ceil
+        own = np.repeat(
+            np.minimum(np.arange(n_groups) // per, n_hosts - 1), n_voters
+        )
+        return cls(n_groups, n_voters, n_hosts, own.reshape(n_groups, n_voters).astype(np.int32))
+
+    @classmethod
+    def mostly_local(
+        cls, n_groups: int, n_voters: int, n_hosts: int, spanning=()
+    ) -> "Placement":
+        """Contiguous placement, except each group in `spanning` donates
+        its LAST voter slot to the next host — the canonical mostly-local
+        fleet: most groups never touch the wire, the named ones run a
+        cross-host quorum."""
+        p = cls.contiguous(n_groups, n_voters, n_hosts)
+        owners = p.owners.copy()
+        for g in spanning:
+            owners[int(g), n_voters - 1] = (owners[int(g), n_voters - 1] + 1) % n_hosts
+        return cls(n_groups, n_voters, n_hosts, owners)
+
+    # -- validation --------------------------------------------------------
+
+    def __post_init__(self):
+        owners = np.asarray(self.owners, dtype=np.int32)
+        if owners.shape != (self.n_groups, self.n_voters):
+            raise ValueError(
+                f"owners must be [{self.n_groups}, {self.n_voters}], got {owners.shape}"
+            )
+        if owners.min(initial=0) < 0 or owners.max(initial=0) >= self.n_hosts:
+            raise ValueError("owner host ids must be in [0, n_hosts)")
+        object.__setattr__(self, "owners", owners)
+
+    # -- lane-space views --------------------------------------------------
+
+    @property
+    def n_lanes(self) -> int:
+        return self.n_groups * self.n_voters
+
+    def owner_of_lane(self) -> np.ndarray:
+        """[N] host id owning each canonical lane g*V + j."""
+        return self.owners.reshape(-1)
+
+    def own_mask(self, host: int) -> np.ndarray:
+        """[N] bool: lanes host `host` runs for real."""
+        return self.owner_of_lane() == int(host)
+
+    def ghost_mask(self, host: int) -> np.ndarray:
+        """[N] bool: lanes host `host` mirrors as silent ghosts."""
+        return ~self.own_mask(host)
+
+    def xedge(self, host: int) -> np.ndarray:
+        """[N, V] bool outbound cross-host cells for `host`: fabric cell
+        (lane, j) where `lane` is owned here and group-member j is owned
+        elsewhere. Exactly the cells the extract kernel pulls and clears;
+        all other cells (local traffic, ghost rows) stay on device."""
+        own = self.own_mask(host)  # [N]
+        g = self.n_groups
+        v = self.n_voters
+        # dst lane of cell (lane, j) is (lane // v) * v + j; owned-ness of
+        # the dst therefore only depends on (group, j):
+        dst_own = own.reshape(g, v)  # [G, V] member j of group g owned here
+        return own[:, None] & ~np.repeat(dst_own, v, axis=0)
+
+    def in_cells(self, host: int) -> np.ndarray:
+        """[N, V] bool inbound cells for `host`: src lane ghost here, dst
+        member owned here — where decoded frames scatter (bridge IMPORT:
+        the message sits exactly where the remote sender's own outbox
+        write would, so next round's route transpose delivers it)."""
+        own = self.own_mask(host)
+        g, v = self.n_groups, self.n_voters
+        dst_own = own.reshape(g, v)
+        return (~own[:, None]) & np.repeat(dst_own, v, axis=0)
+
+    def n_cross_cells(self, host: int) -> int:
+        return int(self.xedge(host).sum())
+
+    def n_in_cells(self, host: int) -> int:
+        return int(self.in_cells(host).sum())
+
+    # -- group-space views -------------------------------------------------
+
+    def hosts_of_group(self, g: int) -> tuple:
+        return tuple(sorted(set(int(h) for h in self.owners[int(g)])))
+
+    def spanning_groups(self) -> tuple:
+        """Groups whose members live on more than one host — the only
+        groups that ever pay the wire."""
+        return tuple(
+            g for g in range(self.n_groups) if len(self.hosts_of_group(g)) > 1
+        )
+
+    def local_groups(self, host: int) -> tuple:
+        """Groups entirely owned by `host` (never on any xedge mask)."""
+        return tuple(
+            g
+            for g in range(self.n_groups)
+            if self.hosts_of_group(g) == (int(host),)
+        )
+
+    def peers(self, host: int) -> tuple:
+        """Hosts that share at least one spanning group with `host` — the
+        fabric edges the lockstep driver exchanges one frame per round
+        over (in both directions, so an empty frame doubles as the round
+        barrier)."""
+        out = set()
+        for g in self.spanning_groups():
+            hs = self.hosts_of_group(g)
+            if int(host) in hs:
+                out |= set(hs)
+        out.discard(int(host))
+        return tuple(sorted(out))
+
+    def dst_host_of_cells(self, cell: np.ndarray) -> np.ndarray:
+        """Destination host of flat fabric cells (cell = src_lane * V + j):
+        the owner of the dst lane (src_lane // V) * V + j."""
+        cell = np.asarray(cell, dtype=np.int64)
+        v = self.n_voters
+        src_lane = cell // v
+        dst_lane = (src_lane // v) * v + (cell % v)
+        return self.owner_of_lane()[dst_lane]
+
+
+def decode_positions(pos: np.ndarray, n_lanes: int, n_voters: int):
+    """Split flat extract-bundle positions (pos in [0, 4*N*V)) into
+    (chan, cell, src_lane, dst_lane) columns."""
+    pos = np.asarray(pos, dtype=np.int64)
+    nv = int(n_lanes) * int(n_voters)
+    chan = pos // nv
+    cell = pos % nv
+    src_lane = cell // n_voters
+    dst_lane = (src_lane // n_voters) * n_voters + (cell % n_voters)
+    return (
+        chan.astype(np.uint8),
+        cell.astype(np.uint32),
+        src_lane.astype(np.int64),
+        dst_lane.astype(np.int64),
+    )

@@ -224,6 +224,42 @@ TIER_COUNTERS = (
     "paged_pressure_evictions",
 )
 
+# cross-host fabric counter families (host plane — pure python counters
+# from the raft_tpu/fabric wire + driver layers, folded into
+# FabricHost.metrics_snapshot; no device sync beyond the O(active)
+# extract trim the driver already pays):
+#   fabric_frames_sent      frames encoded + handed to the wire (one per
+#                           (peer, round) in the lockstep driver — empty
+#                           frames double as the round barrier)
+#   fabric_frames_received  frames decoded from peers
+#   fabric_bytes_sent       wire bytes out (header + payload)
+#   fabric_bytes_received   wire bytes in
+#   fabric_msgs_exported    cross-host messages pulled by the extract
+#                           kernel (cumulative)
+#   fabric_msgs_injected    messages scattered into the carry at a round
+#                           boundary (== exported minus drops, fabric-wide)
+#   fabric_msgs_total       ALL messages emitted by owned lanes (local +
+#                           cross) — the mostly-local denominator
+#                           benches/fabric_ab.py gates cross/total on
+#   fabric_injection_drops  decoded rows refused by inject validation
+#                           (wrong-host dst, non-ghost src, bad cell)
+#   fabric_frames_dropped   whole frames dropped by a chaos wire partition
+#                           (ChaosSchedule.wire_partition)
+#   fabric_frames_deferred  frames delayed by a chaos wire delay
+#                           (ChaosSchedule.wire_delay)
+FABRIC_COUNTERS = (
+    "fabric_frames_sent",
+    "fabric_frames_received",
+    "fabric_bytes_sent",
+    "fabric_bytes_received",
+    "fabric_msgs_exported",
+    "fabric_msgs_injected",
+    "fabric_msgs_total",
+    "fabric_injection_drops",
+    "fabric_frames_dropped",
+    "fabric_frames_deferred",
+)
+
 
 class HostCounters:
     """Plain host-side counter bag speaking the snapshot schema — the
@@ -497,3 +533,15 @@ def record_tier_stats(stats: dict) -> None:
     """Mirror one tier/engine.py stats() snapshot onto the host plane."""
     for name in TIER_COUNTERS:
         TIER_EVENTS.set(name, int(stats.get(name, 0)))
+
+
+# process-wide mirror of this host's fabric counters (the TIER_EVENTS
+# twin): /metrics exports scrape the latest cross-host wire totals
+# without holding a FabricHost reference
+FABRIC_EVENTS = HostCounters()
+
+
+def record_fabric_stats(stats: dict) -> None:
+    """Mirror one fabric driver counter snapshot onto the host plane."""
+    for name in FABRIC_COUNTERS:
+        FABRIC_EVENTS.set(name, int(stats.get(name, 0)))

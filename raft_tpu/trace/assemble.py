@@ -180,7 +180,9 @@ def explain(
     """Round-ordered, human-readable timeline of one raft group: its
     lanes' recorded transitions plus its proposals' lifecycles, plus —
     when a host SpanRecorder (or its span list) is passed — the group's
-    tier transitions (tier_evict / tier_admit, RAFT_TPU_TIER). Under the
+    tier transitions (tier_evict / tier_admit, RAFT_TPU_TIER) and its
+    cross-host fabric hops (fabric_tx / fabric_rx, RAFT_TPU_FABRIC,
+    labeled by spanning group). Under the
     tier, `group` is the LOGICAL id for lifecycle/span lines; device
     event lanes are physical and follow the group's current slot."""
     lines: list[tuple[int, int, str]] = []  # (round, order, text)
@@ -212,7 +214,28 @@ def explain(
             ))
     if spans is not None:
         for name, _t0, _dur, labels in getattr(spans, "spans", spans):
-            if not str(name).startswith("tier_") or not labels:
+            sname = str(name)
+            if not labels:
+                continue
+            if sname.startswith("fabric_"):
+                # cross-host hops (raft_tpu/fabric driver): one span per
+                # frame exchanged, labeled with the spanning groups whose
+                # cells rode that frame
+                if group not in tuple(labels.get("groups", ())):
+                    continue
+                rnd = int(labels.get("round", 0))
+                verb = (
+                    f"fabric: frame out to host {labels.get('peer')}"
+                    if sname == "fabric_tx"
+                    else f"fabric: frame in from host {labels.get('peer')}"
+                )
+                lines.append((
+                    rnd, 3,
+                    f"r{rnd:05d}  {verb} ({labels.get('msgs', 0)} msgs, "
+                    f"{labels.get('bytes', 0)} B)",
+                ))
+                continue
+            if not sname.startswith("tier_"):
                 continue
             if int(labels.get("group", -1)) != group:
                 continue

@@ -91,7 +91,8 @@ smokes() {
     && run_bench benches/diet_ab.py --smoke \
     && run_bench benches/multichip_ab.py --smoke \
     && run_bench benches/paged_ab.py --smoke \
-    && run_bench benches/tier_ab.py --smoke
+    && run_bench benches/tier_ab.py --smoke \
+    && run_bench benches/fabric_ab.py --smoke
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
@@ -168,6 +169,11 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
     # the mid-election/mid-confchange eviction chaos soak, and the 1M
     # logical-group Zipfian serve acceptance demo
     run_chunk tests/test_tier.py
+    # the cross-host fabric suite gets its own process: it spawns real
+    # per-host engine processes (mp spawn children each compile the fused
+    # program) for the digest-parity and failover oracles, plus the
+    # in-process lockstep twins and the wire-chaos probes
+    run_chunk tests/test_fabric.py
     # the mesh-blocked driver gets its own process before test_sharded:
     # its sharded x blocked twins are all 8-device shard_map programs
     # (plus one subprocess A/B child trio), same crash profile as

@@ -1,0 +1,439 @@
+"""Cross-host fabric (raft_tpu/fabric/): placement partitioning, the
+extract/inject endpoint pair, the framed wire codecs, wire chaos, and the
+MILESTONE-1 ORACLE — a multi-process fabric fleet's owned-lane trajectory
+digest equals the monolithic BlockedFusedCluster's on the same seed,
+sha256-exact, frame by frame over real pipes.
+
+The in-process LockstepFabric runs the identical protocol without IPC
+(same WireGate, same frames), so most scenarios probe there; two genuine
+spawned-worker tests pin the mp path (parity + spanning failover), in the
+tests/test_bridge_process.py style."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from raft_tpu.chaos.device import NEVER
+from raft_tpu.chaos.schedule import ChaosSchedule, RecoveryProbe
+from raft_tpu.fabric.extract import Bundle, merge_bundles, split_bundle
+from raft_tpu.fabric.placement import CHANNELS, Placement, decode_positions
+from raft_tpu.fabric.wire import FabricWire
+from raft_tpu.runtime.native import _load
+from raft_tpu.types import MessageType as MT
+
+G, V, H, SEED = 4, 3, 2, 5
+ROUNDS = 24
+# lanes 0..11; mostly_local spans group 1 (its last voter, lane 5, lives
+# on host 1); the canonical milestone-1 geometry
+PLACEMENT = Placement.mostly_local(G, V, H, spanning=(1,))
+HUPS = {0: True, 3: True, 6: True, 9: True}  # voter 0 of every group
+
+
+@pytest.fixture
+def fabric_on(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_FABRIC", "1")
+
+
+# -- placement -------------------------------------------------------------
+
+
+def test_placement_partition():
+    pl = PLACEMENT
+    assert pl.n_lanes == G * V
+    owner = pl.owner_of_lane()
+    # contiguous base: groups 0-1 -> host 0, 2-3 -> host 1; spanning group
+    # 1 donates its LAST voter slot to the next host
+    assert owner.tolist() == [0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 1, 1]
+    assert pl.spanning_groups() == (1,)
+    assert pl.local_groups(0) == (0,)
+    assert pl.local_groups(1) == (2, 3)
+    assert pl.hosts_of_group(1) == (0, 1)
+    assert pl.peers(0) == (1,) and pl.peers(1) == (0,)
+    # every lane owned exactly once; ghost = complement
+    masks = np.stack([pl.own_mask(h) for h in range(H)])
+    assert (masks.sum(axis=0) == 1).all()
+    assert (pl.ghost_mask(0) == ~pl.own_mask(0)).all()
+
+
+def test_placement_edges_are_cross_host_only():
+    pl = PLACEMENT
+    owner = pl.owner_of_lane()
+    for h in range(H):
+        xe = pl.xedge(h)
+        ic = pl.in_cells(h)
+        for lane in range(pl.n_lanes):
+            for slot in range(V):
+                dst = (lane // V) * V + slot
+                assert xe[lane, slot] == (owner[lane] == h != owner[dst])
+                assert ic[lane, slot] == (owner[lane] != h == owner[dst])
+        assert pl.n_cross_cells(h) == int(xe.sum())
+    # host-local groups contribute no wire cells at all
+    assert pl.n_cross_cells(0) == 2  # lanes 3,4 -> slot 2 (lane 5)
+    assert pl.n_cross_cells(1) == 2  # lane 5 -> slots 0,1
+    # a fully-local placement has no edges anywhere
+    local = Placement.contiguous(G, V, H)
+    assert all(local.n_cross_cells(h) == 0 for h in range(H))
+    assert local.peers(0) == ()
+
+
+def test_decode_positions_roundtrip():
+    pl = PLACEMENT
+    nv = pl.n_lanes * V
+    pos = np.array([0, nv - 1, nv, 2 * nv + 17, 4 * nv - 1])
+    chan, cell, src, dst = decode_positions(pos, pl.n_lanes, V)
+    assert chan.tolist() == [0, 0, 1, 2, 3]
+    assert cell.tolist() == [0, nv - 1, 0, 17, nv - 1]
+    assert src.tolist() == [0, pl.n_lanes - 1, 0, 17 // V, pl.n_lanes - 1]
+    np.testing.assert_array_equal(dst, (src // V) * V + cell % V)
+    d = pl.dst_host_of_cells(np.asarray([3 * V + 2]))  # lane 3 -> lane 5
+    assert d.tolist() == [1]
+
+
+# -- extract ---------------------------------------------------------------
+
+
+def test_extract_pulls_and_clears_cross_cells(fabric_on):
+    from raft_tpu.fabric.driver import FabricHost
+
+    fh = FabricHost(PLACEMENT, 0, seed=SEED)
+    # round 0: owned voter-0 lanes campaign -> lane 3's vote request to
+    # lane 5 is host 0's only outbound cross cell this round
+    frames = fh.step({"hup": {0: True, 3: True}})
+    assert set(frames) == {1}
+    b = fh.wire.decode(frames[1])
+    assert b.count == 1
+    assert b.chan.tolist() == [2]  # vote channel
+    assert b.cell.tolist() == [3 * V + 2]
+    assert b.cols["kind"][0] in (int(MT.MSG_VOTE), int(MT.MSG_PRE_VOTE))
+    # the exported cell was cleared in the carry: ghost lane 5 never saw it
+    fab = fh.cl.fab
+    from raft_tpu.ops import fused as fz
+
+    wide = fz.fat_fabric(fz.unpack_fabric(fab))
+    assert int(np.asarray(wide.vote.kind)[3, 2]) == int(MT.MSG_NONE)
+    # local messages (lane 3 -> lane 4) were NOT cleared by the extract
+    assert int(np.asarray(wide.vote.kind)[3, 1]) != int(MT.MSG_NONE)
+    assert fh.counters.get("fabric_msgs_exported") == 1
+    assert fh.counters.get("fabric_msgs_total") >= 3  # 2 local + 1 cross
+
+
+def test_extract_cap_overflow_raises(fabric_on):
+    from raft_tpu.fabric.driver import FabricHost
+
+    fh = FabricHost(PLACEMENT, 1, seed=SEED, cap=1)
+    with pytest.raises(RuntimeError, match="extract overflow"):
+        # lane 5 (owned) campaigns: vote requests to lanes 3 AND 4 -> two
+        # cross messages in one round > cap 1
+        fh.step({"hup": {5: True}})
+
+
+# -- wire ------------------------------------------------------------------
+
+
+def _mk_bundle(e, *, diet_bounded=False):
+    """One realistic message per channel (rep carries 2 entries)."""
+    hi = 40_000 if diet_bounded else 1_000_000
+    cols = {
+        "kind": [int(MT.MSG_APP), int(MT.MSG_HEARTBEAT), int(MT.MSG_VOTE),
+                 int(MT.MSG_VOTE_RESP)],
+        "term": [7, 7, 8, 8],
+        "index": [hi, 0, hi - 3, 0],
+        "log_term": [6, 0, 7, 0],
+        "commit": [hi - 5, hi - 5, 0, 0],
+        "reject": [0, 0, 0, 1],
+        "reject_hint": [0, 0, 0, 0],
+        "n_ents": [2, 0, 0, 0],
+        "context": [0, 3, 0, 0],
+        "snap_index": [0, 0, 0, 0],
+        "snap_term": [0, 0, 0, 0],
+    }
+    cols = {k: np.asarray(v, np.int32) for k, v in cols.items()}
+    for f in ("ent_term", "ent_type", "ent_bytes"):
+        cols[f] = np.zeros((4, e), np.int32)
+    cols["ent_term"][0, :2] = 7
+    cols["ent_bytes"][0, :2] = (11, 0)
+    chan = np.asarray([0, 1, 2, 3], np.uint8)
+    cell = np.asarray([3 * V + 2, 3 * V + 2, 5 * V + 0, 5 * V + 1], np.uint32)
+    return Bundle(chan, cell, cols, 9)
+
+
+def _assert_bundles_equal(a, b):
+    np.testing.assert_array_equal(a.chan, b.chan)
+    np.testing.assert_array_equal(a.cell, b.cell)
+    for f in a.cols:
+        np.testing.assert_array_equal(a.cols[f], b.cols[f], err_msg=f)
+
+
+@pytest.mark.parametrize("codec", ["np", "pb"])
+def test_wire_roundtrip(codec):
+    if codec == "pb" and _load() is None:
+        pytest.skip("native codec library unavailable")
+    e = 2
+    w = FabricWire(V, e, codec=codec)
+    b = _mk_bundle(e)
+    frame = w.encode(b, rnd=9)
+    out = w.decode(frame)
+    assert out.round == 9 and out.count == 4
+    _assert_bundles_equal(b, out)
+    # empty bundles travel as header-only frames (the lockstep barrier)
+    empty = w.decode(w.encode(None, rnd=3))
+    assert empty.count == 0 and empty.round == 3
+
+
+def test_wire_diet_narrows_and_guards(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_DIET", "1")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_DIET", "1")
+    e = 2
+    wide = FabricWire(V, e, codec="np")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_DIET", "0")
+    fat = FabricWire(V, e, codec="np")
+    b = _mk_bundle(e, diet_bounded=True)
+    slim_frame = wide.encode(b, rnd=1)
+    fat_frame = fat.encode(b, rnd=1)
+    assert len(slim_frame) < len(fat_frame)
+    _assert_bundles_equal(b, wide.decode(slim_frame))  # narrowing is exact
+    # out-of-bound values refuse to encode rather than truncate
+    big = _mk_bundle(e)
+    with pytest.raises(ValueError, match="diet overflow"):
+        wide.encode(big, rnd=2)
+    # and the knob composition is validated at construction
+    monkeypatch.setenv("RAFT_TPU_FABRIC_DIET", "1")
+    monkeypatch.setenv("RAFT_TPU_DIET", "0")
+    with pytest.raises(RuntimeError, match="RAFT_TPU_DIET=1"):
+        FabricWire(V, e, codec="np")
+
+
+# -- inject ----------------------------------------------------------------
+
+
+def test_inject_validation_drops(fabric_on):
+    from raft_tpu.fabric.driver import FabricHost
+
+    fh = FabricHost(PLACEMENT, 1, seed=SEED)
+    e = fh.e
+    good_cell = 3 * V + 2  # lane 3 -> lane 5: a host-1 in-cell
+    bad_cells = [0, 7 * V + 1]  # host-1-local cells: NOT in-cells
+    cols = {f: np.zeros((3,), np.int32) for f in
+            ("kind", "term", "index", "log_term", "commit", "reject",
+             "reject_hint", "n_ents", "context", "snap_index", "snap_term")}
+    cols.update({f: np.zeros((3, e), np.int32) for f in
+                 ("ent_term", "ent_type", "ent_bytes")})
+    cols["kind"][:] = int(MT.MSG_HEARTBEAT)
+    cols["term"][:] = 4
+    b = Bundle(np.asarray([1, 1, 1], np.uint8),
+               np.asarray([good_cell] + bad_cells, np.uint32), cols, 0)
+    fab, injected, dropped = fh.injector(fh.cl.fab, b)
+    assert (injected, dropped) == (1, 2)
+    from raft_tpu.ops import fused as fz
+
+    wide = fz.fat_fabric(fz.unpack_fabric(fab))
+    hb = np.asarray(wide.hb.kind)
+    assert int(hb[3, 2]) == int(MT.MSG_HEARTBEAT)
+    assert int(hb[0, 0]) == int(MT.MSG_NONE)
+    assert int(hb[7, 1]) == int(MT.MSG_NONE)
+
+
+# -- digest parity: the milestone-1 oracle ---------------------------------
+
+
+def _mono_digest():
+    from raft_tpu.fabric.driver import mono_fleet_digest
+    from raft_tpu.scheduler import BlockedFusedCluster
+
+    mono = BlockedFusedCluster(G, V, block_groups=G, seed=SEED)
+    return mono_fleet_digest(
+        mono, PLACEMENT, ROUNDS, ops_spec={"hup": HUPS}, auto_propose=True
+    )
+
+
+def test_lockstep_parity_with_monolith(fabric_on):
+    from raft_tpu.fabric.driver import LockstepFabric
+
+    fab = LockstepFabric(PLACEMENT, seed=SEED, track_trajectory=True)
+    fab.run(ROUNDS, ops_spec={"hup": HUPS}, auto_propose=True)
+    fab.check_no_errors()
+    assert fab.fleet_trajectory() == _mono_digest()
+    # the stitched fleet is a healthy cluster: voter-0 leaders everywhere,
+    # commits advanced in every group (including the spanning one)
+    assert fab.leader_lanes().tolist() == [0, 3, 6, 9]
+    committed = fab.state_columns("committed")["committed"]
+    assert (committed.reshape(G, V) >= 1).all()
+    # wire traffic existed and was strictly a subset of all traffic
+    snap = fab.metrics_snapshot()
+    c = snap["counters"]
+    assert c["fabric_frames_sent"] == 2 * ROUNDS
+    assert 0 < c["fabric_msgs_exported"] < c["fabric_msgs_total"]
+    assert c["fabric_bytes_sent"] == c["fabric_bytes_received"] > 0
+
+
+def test_two_process_parity_with_monolith(fabric_on):
+    from raft_tpu.fabric.driver import (
+        run_fabric_workers,
+        stitched_columns,
+        workers_fleet_digest,
+    )
+
+    res = run_fabric_workers(
+        PLACEMENT, rounds=ROUNDS, seed=SEED, ops_spec={"hup": HUPS},
+        run_kw=dict(auto_propose=True), timeout=480,
+    )
+    assert workers_fleet_digest(res) == _mono_digest()
+    assert res[0]["leaders"] == [0, 3] and res[1]["leaders"] == [6, 9]
+    cols = stitched_columns(res, PLACEMENT.n_lanes)
+    assert (cols["committed"].reshape(G, V) >= 1).all()
+    for r in res:
+        assert r["counters"]["fabric_frames_sent"] == ROUNDS
+        assert r["counters"]["fabric_msgs_injected"] > 0
+        assert r["n_spans"] > 0  # fabric_tx/rx hops were recorded
+
+
+# -- wire chaos + spanning-group failover ----------------------------------
+
+# failover geometry: group 1's voter 0 (lane 3, the seeded leader) on
+# host 0, voters 1-2 (lanes 4-5, a quorum) on host 1
+FAILOVER_OWNERS = np.asarray(
+    [[0, 0, 0], [0, 1, 1], [1, 1, 1], [1, 1, 1]], np.int32
+)
+
+
+def test_wire_partition_failover_and_recovery(fabric_on):
+    from raft_tpu.fabric.driver import LockstepFabric
+    from raft_tpu.types import StateType
+
+    pl = Placement(G, V, H, FAILOVER_OWNERS)
+    cut = 12
+    sched = ChaosSchedule(G, V).wire_partition(
+        [(0, 1)], at=cut, duration=10**6, groups=(1,)
+    )
+    fab = LockstepFabric(pl, seed=SEED, schedule=sched)
+    fab.run(cut, ops_spec={"hup": HUPS}, auto_propose=True)
+    h1 = fab.hosts[1]
+    st = h1.cl.state_columns("state", "term", "committed")
+    # pre-cut: lane 3 (host 0) leads the spanning group; host 1's replicas
+    # follow it and have commits
+    assert int(st["state"][4]) == int(st["state"][5]) == int(StateType.FOLLOWER)
+    term0 = int(st["term"][4])
+    committed0 = int(st["committed"][4])
+    assert committed0 >= 1
+
+    # partitioned: host 1's quorum side (lanes 4+5) must re-elect among
+    # itself and resume committing, all within the probe budget
+    reelect = recommit = NEVER
+    for r in range(cut, cut + 96):
+        fab.run(1, auto_propose=True)
+        cols = h1.cl.state_columns("state", "term", "committed")
+        leads = [
+            ln for ln in (4, 5)
+            if int(cols["state"][ln]) == int(StateType.LEADER)
+            and int(cols["term"][ln]) > term0
+        ]
+        if leads and reelect == NEVER:
+            reelect = r
+        if reelect != NEVER and int(cols["committed"][4]) > committed0:
+            recommit = r
+            break
+    probe = RecoveryProbe(tick_budget=96)
+    probe.observe(cut, groups=(1,), reelect=[reelect], recommit=[recommit])
+    assert probe.ok(), probe.snapshot()["counters"]
+    assert probe.phases[0]["reelect_ticks"][0] >= 1
+    # frames kept flowing as (empty) barriers but payloads were dropped
+    snap = fab.metrics_snapshot()
+    assert snap["counters"]["fabric_frames_dropped"] > 0
+    fab.check_no_errors()
+
+
+def test_two_process_failover(fabric_on):
+    """The genuine mp failover: the spanning group's leader lives on host
+    0, its quorum (voters 1-2) on host 1; the wire partitions forever at
+    round 12 and host 1's side must elect a successor and keep
+    committing — entirely over (dropped) pipe frames, in two spawned
+    engine processes."""
+    from raft_tpu.fabric.driver import run_fabric_workers
+    from raft_tpu.types import StateType
+
+    pl = Placement(G, V, H, FAILOVER_OWNERS)
+    cut = 12
+    sched = ChaosSchedule(G, V).wire_partition(
+        [(0, 1)], at=cut, duration=10**6, groups=(1,)
+    )
+    res = run_fabric_workers(
+        pl, rounds=cut + 96, seed=SEED, ops_spec={"hup": HUPS},
+        run_kw=dict(auto_propose=True), schedule=sched, timeout=480,
+    )
+    h1 = res[1]
+    # a new leader rose among host 1's lanes 4/5 (lane 3's old regime)
+    lead = [ln for ln in (4, 5) if ln in h1["leaders"]]
+    assert len(lead) == 1, h1["leaders"]
+    cols = h1["columns"]
+    assert int(cols["state"][lead[0]]) == int(StateType.LEADER)
+    assert int(cols["term"][lead[0]]) > 1
+    # and the partitioned quorum side kept committing
+    assert int(cols["committed"][4]) > cut // 2
+    # both hosts dropped whole frames at the gate, deterministically
+    for r in res:
+        assert r["counters"]["fabric_frames_dropped"] > 0
+        assert r["counters"]["fabric_frames_sent"] == cut + 96
+
+
+def test_wire_delay_defers_whole_frames(fabric_on):
+    from raft_tpu.fabric.driver import LockstepFabric
+
+    sched = ChaosSchedule(G, V).wire_delay([(0, 1)], at=0, duration=6, rounds=2)
+    fab = LockstepFabric(PLACEMENT, seed=SEED, schedule=sched)
+    fab.run(ROUNDS, ops_spec={"hup": HUPS}, auto_propose=True)
+    fab.check_no_errors()
+    snap = fab.metrics_snapshot()
+    assert snap["counters"]["fabric_frames_deferred"] > 0
+    # a delayed wire is degradation, not an outage: the spanning group
+    # still commits (raft absorbs the extra round-trips as latency)
+    committed = fab.state_columns("committed")["committed"]
+    assert (committed.reshape(G, V)[1] >= 1).all()
+
+
+# -- observability ---------------------------------------------------------
+
+
+def test_fabric_counters_and_explain(fabric_on):
+    from raft_tpu.fabric.driver import LockstepFabric
+    from raft_tpu.metrics.host import FABRIC_COUNTERS, prometheus_text
+    from raft_tpu.trace.assemble import explain
+
+    fab = LockstepFabric(PLACEMENT, seed=SEED)
+    fab.run(8, ops_spec={"hup": HUPS})
+    snap = fab.metrics_snapshot()
+    for name in FABRIC_COUNTERS:
+        assert name in snap["counters"], name
+    text = prometheus_text(snap)
+    assert "raft_tpu_fabric_frames_sent" in text
+    # explain() narrates the spanning group's cross-host hops
+    spans = fab.hosts[0].spans.spans + fab.hosts[1].spans.spans
+    lines = explain(1, spans=spans, v=V)
+    assert any("fabric: frame out to host" in ln for ln in lines)
+    assert any("fabric: frame in from host" in ln for ln in lines)
+    # host-local groups never touch the wire, so they have no fabric lines
+    assert not any("fabric" in ln for ln in explain(0, spans=spans, v=V))
+
+
+def test_fabric_disabled_is_fully_elided(monkeypatch):
+    from raft_tpu.fabric import driver
+
+    monkeypatch.delenv("RAFT_TPU_FABRIC", raising=False)
+    with pytest.raises(RuntimeError, match="RAFT_TPU_FABRIC"):
+        driver.FabricHost(PLACEMENT, 0, seed=SEED)
+    with pytest.raises(RuntimeError, match="RAFT_TPU_FABRIC"):
+        driver.run_fabric_workers(PLACEMENT, rounds=1)
+
+
+def test_bundle_merge_and_split():
+    e = 2
+    b = _mk_bundle(e)
+    merged = merge_bundles([None, b, Bundle.empty(e, 0)], e, rnd=4)
+    assert merged.count == 4 and merged.round == 4
+    parts = split_bundle(merged, PLACEMENT, e)
+    # cells 3*V+2 target lane 5 (host 1); cells 5*V+* target lanes 3,4
+    # (host 0)
+    assert set(parts) == {0, 1}
+    assert parts[1].count == 2 and parts[0].count == 2
+    assert split_bundle(None, PLACEMENT, e) == {}
+    assert split_bundle(Bundle.empty(e, 0), PLACEMENT, e) == {}
