@@ -1,7 +1,8 @@
 """Cross-host fabric A/B: a multi-process fabric fleet vs the monolithic
-blocked scheduler, plus the EQuARX-style wire diet.
+blocked scheduler, the EQuARX-style wire diet, and the bounded-skew
+pipeline vs the lockstep wire.
 
-Three fresh-subprocess arms on one mostly-local placement (two hosts, one
+Fresh-subprocess arms on one mostly-local placement (two hosts, one
 spanning group, every other group host-local):
 
   mono         BlockedFusedCluster(groups, block_groups=groups) — the
@@ -13,18 +14,34 @@ spanning group, every other group host-local):
   fabric_diet  same fleet + RAFT_TPU_FABRIC_DIET=1 — every diet-bounded
                field narrowed below int16 on the wire, same np framing,
                so the bytes gate is an apples-to-apples column diet
+  fabric_lat   same fleet, skew 0, AB_WIRE_MS of injected per-frame wire
+               latency — the latency sits on the lockstep critical path
+  skew2_lat /  RAFT_TPU_FABRIC_SKEW=2/4 under the SAME injected latency —
+  skew4_lat    frame encode + socket I/O on per-peer threads, so rounds
+               overlap frames in flight and the wire falls off the
+               critical path
+  twin2/twin4  LockstepFabric running chaos skew_twin_schedule's uniform
+               D-round wire_delay — the determinism oracle for the skew
+               arms (same message timeline, zero pipelining)
 
 Asserted invariants (exit 0 = pass, 1 = regression):
 
-  - ONE identical sha256 fleet trajectory digest across all three arms —
-    process partitioning and wire quantization are invisible to raft
-  - wire bytes flowed (> 0) in both fabric arms
+  - ONE identical sha256 fleet trajectory digest across mono / fabric /
+    fabric_diet / fabric_lat — process partitioning, wire quantization,
+    and wire latency are invisible to raft at skew 0
+  - skew2_lat == twin2 and skew4_lat == twin4 digests — bounded skew is
+    bit-identical to a lockstep fleet under a uniform D-round wire_delay
+  - skew2_lat and skew4_lat steady-state per-round wall clock STRICTLY
+    below fabric_lat's — the pipeline actually hides the wire
+  - observed fabric_skew_max never exceeds the configured bound D
+  - wire bytes flowed (> 0) in the fabric arms
   - cross-host messages are STRICTLY fewer than total messages: the
     placement keeps host-local groups off the wire entirely
   - fabric_diet put strictly fewer bytes on the wire than fabric
 
 `--smoke` shrinks the workload for CI. Env: AB_GROUPS, AB_VOTERS,
-AB_ROUNDS, AB_SEED, AB_MODE (child arm selector), RAFT_TPU_* (forwarded).
+AB_ROUNDS, AB_SEED, AB_WIRE_MS, AB_MODE (child arm selector), RAFT_TPU_*
+(forwarded).
 """
 
 from __future__ import annotations
@@ -38,6 +55,11 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from raft_tpu import config
+
+
+#: mp arms that inject AB_WIRE_MS of per-frame wire latency, and the skew
+#: each runs at — the pipeline A/B triplet
+LAT_ARMS = {"fabric_lat": 0, "skew2_lat": 2, "skew4_lat": 4}
 
 
 def _placement():
@@ -55,10 +77,12 @@ def child():
     pl = _placement()
     rounds = int(os.environ.get("AB_ROUNDS", 24))
     seed = int(os.environ.get("AB_SEED", 5))
+    lat = float(os.environ.get("AB_WIRE_MS", "0")) / 1e3
     v = pl.n_voters
     ops_spec = {"hup": {g * v: True for g in range(pl.n_groups)}}
 
     t0 = time.perf_counter()
+    per_round = None
     if mode == "mono":
         from raft_tpu.fabric.driver import mono_fleet_digest
         from raft_tpu.scheduler import BlockedFusedCluster
@@ -71,14 +95,31 @@ def child():
         )
         c.check_no_errors()
         counters = {}
+    elif mode.startswith("twin"):
+        # the lockstep determinism oracle for a skew-D arm: one process,
+        # uniform D-round wire_delay on every peer edge
+        from raft_tpu.chaos.schedule import skew_twin_schedule
+        from raft_tpu.fabric.driver import LockstepFabric
+
+        d = int(mode[4:])
+        sched = skew_twin_schedule(None, pl, d, rounds + d + 2)
+        lf = LockstepFabric(
+            pl, seed=seed, schedule=sched, track_trajectory=True
+        )
+        lf.run(rounds, ops_spec=ops_spec, auto_propose=True)
+        lf.check_no_errors()
+        digest = lf.fleet_trajectory()
+        counters = {}
     else:
         from raft_tpu.fabric.driver import run_fabric_workers, workers_fleet_digest
 
         res = run_fabric_workers(
             pl, rounds=rounds, seed=seed, ops_spec=ops_spec,
             run_kw=dict(auto_propose=True), timeout=480,
+            wire_latency=lat,
         )
         digest = workers_fleet_digest(res)
+        per_round = max(r["per_round_s"] for r in res)
         counters = {}
         for r in res:
             for k, n in r["counters"].items():
@@ -94,12 +135,19 @@ def child():
         "extra": {
             "mode": mode,
             "digest": digest,
+            "per_round_ms": (
+                round(per_round * 1e3, 3) if per_round is not None else None
+            ),
+            "wire_ms": round(lat * 1e3, 3),
             "wire_bytes": counters.get("fabric_bytes_sent", 0),
             "msgs_cross": counters.get("fabric_msgs_exported", 0),
             "msgs_total": counters.get("fabric_msgs_total", 0),
             "frames": counters.get("fabric_frames_sent", 0),
+            "backpressure": counters.get("fabric_backpressure_rounds", 0),
+            "skew_max": counters.get("fabric_skew_max", 0),
             "diet": config.env_str("RAFT_TPU_FABRIC_DIET", default="0"),
             "codec": config.env_str("RAFT_TPU_FABRIC_CODEC", default=""),
+            "skew": config.env_str("RAFT_TPU_FABRIC_SKEW", default="0"),
         },
     }), flush=True)
 
@@ -113,13 +161,18 @@ def run_child(mode: str) -> dict:
         RAFT_TPU_DIET=config.env_str("RAFT_TPU_DIET", default="1"),
         RAFT_TPU_DONATE=config.env_str("RAFT_TPU_DONATE", default="1"),
         RAFT_TPU_FABRIC="1" if mode != "mono" else "0",
+        AB_WIRE_MS="0",
+        RAFT_TPU_FABRIC_SKEW="0",
     )
     if mode != "mono":
-        # both fabric arms frame with the np codec so the diet bytes gate
+        # every fabric arm frames with the np codec so the diet bytes gate
         # compares identical framing (pb frames are byte-exact raftpb and
         # cannot narrow; their parity is pinned by tests/test_fabric.py)
         env["RAFT_TPU_FABRIC_CODEC"] = "np"
         env["RAFT_TPU_FABRIC_DIET"] = "1" if mode == "fabric_diet" else "0"
+    if mode in LAT_ARMS:
+        env["AB_WIRE_MS"] = os.environ.get("AB_WIRE_MS", "20")
+        env["RAFT_TPU_FABRIC_SKEW"] = str(LAT_ARMS[mode])
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--child"],
         env=env, capture_output=True, text=True, check=True,
@@ -132,14 +185,17 @@ def main():
         os.environ.setdefault("AB_GROUPS", "4")
         os.environ.setdefault("AB_ROUNDS", "16")
     arms = {}
-    for mode in ("mono", "fabric", "fabric_diet"):
+    for mode in (
+        "mono", "fabric", "fabric_diet",
+        "fabric_lat", "skew2_lat", "skew4_lat", "twin2", "twin4",
+    ):
         r = run_child(mode)
         print(json.dumps(r), flush=True)
         arms[mode] = r
 
     fails = []
     base = arms["mono"]["extra"]
-    for mode in ("fabric", "fabric_diet"):
+    for mode in ("fabric", "fabric_diet", "fabric_lat"):
         ex = arms[mode]["extra"]
         if ex["digest"] != base["digest"]:
             fails.append(
@@ -161,6 +217,29 @@ def main():
             f"fabric_diet: wire diet did not shrink frames "
             f"({slim} B vs {fat} B)"
         )
+
+    # -- bounded-skew pipeline gates ------------------------------------
+    lockstep_ms = arms["fabric_lat"]["extra"]["per_round_ms"]
+    for mode, d in (("skew2_lat", 2), ("skew4_lat", 4)):
+        ex = arms[mode]["extra"]
+        twin = arms[f"twin{d}"]["extra"]
+        if ex["digest"] != twin["digest"]:
+            fails.append(
+                f"{mode}: digest diverged from its lockstep wire_delay({d}) "
+                "twin — bounded skew broke determinism"
+            )
+        if not ex["per_round_ms"] < lockstep_ms:
+            fails.append(
+                f"{mode}: steady-state round ({ex['per_round_ms']} ms) not "
+                f"strictly faster than lockstep under the same "
+                f"{ex['wire_ms']} ms wire latency ({lockstep_ms} ms) — the "
+                "pipeline failed to overlap compute with the wire"
+            )
+        if ex["skew_max"] > d:
+            fails.append(
+                f"{mode}: observed fabric_skew_max {ex['skew_max']} exceeds "
+                f"the configured bound {d}"
+            )
     print(json.dumps({
         "metric": "fabric_ab",
         "ok": not fails,
@@ -170,6 +249,9 @@ def main():
         "diet_ratio": round(slim / max(fat, 1), 3),
         "msgs_cross": arms["fabric"]["extra"]["msgs_cross"],
         "msgs_total": arms["fabric"]["extra"]["msgs_total"],
+        "lockstep_ms": lockstep_ms,
+        "skew2_ms": arms["skew2_lat"]["extra"]["per_round_ms"],
+        "skew4_ms": arms["skew4_lat"]["extra"]["per_round_ms"],
     }), flush=True)
     for f in fails:
         print(f"FAIL: {f}", file=sys.stderr)

@@ -345,6 +345,45 @@ class ChaosSchedule:
                 e.resolved = tuple(int(x) for x in lanes[keep])
 
 
+def skew_twin_schedule(base, placement, skew: int, horizon: int):
+    """The lockstep delay-model twin of a skewed fabric run: a copy of
+    `base` (None = empty) with one uniform `wire_delay(rounds=skew)` over
+    every fabric peer edge for [0, horizon) — the oracle schedule a
+    LockstepFabric/mono twin runs to reproduce a RAFT_TPU_FABRIC_SKEW=skew
+    fleet bit-for-bit (fabric/driver.py).
+
+    Refuses a base that already carries wire_delay events: wire_plan()
+    composes overlapping delays with max(), not addition, so stacking the
+    skew delay uniformly under a user delay would NOT model the skewed
+    run (where user delays defer the emit tag and the skew latency adds
+    on top). Skew x user-delay composition is instead pinned by the
+    commutation oracle in tests/test_fabric.py: skew D + wire_delay k ==
+    lockstep + wire_delay (D + k)."""
+    if skew < 1:
+        raise ValueError("skew_twin_schedule needs skew >= 1")
+    twin = ChaosSchedule(placement.n_groups, placement.n_voters)
+    if base is not None:
+        if any(e.kind == "wire_delay" for e in base.wire_events):
+            raise ValueError(
+                "skew_twin_schedule: base schedule already has wire_delay "
+                "events — wire_plan() max-composes overlapping delays, so "
+                "a uniform skew delay cannot be stacked under them; fold "
+                "the user delay into the skew commutation identity instead"
+            )
+        twin.events = list(base.events)
+        twin.heals = {r: set(gs) for r, gs in base.heals.items()}
+        twin.wire_events = list(base.wire_events)
+    edges = set()
+    for h in range(placement.n_hosts):
+        edges.update((h, p) for p in placement.peers(h))
+    if edges:
+        twin.wire_delay(
+            sorted(edges), at=0, duration=int(horizon), rounds=int(skew),
+            symmetric=False,
+        )
+    return twin
+
+
 # --------------------------------------------------------------------------
 # recovery probe
 

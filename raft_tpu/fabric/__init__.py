@@ -46,3 +46,17 @@ def fabric_cap() -> int:
     4 x cross-host cells — one message per channel per edge per round is
     the most one round can emit, so the default can never drop."""
     return config.env_int("RAFT_TPU_FABRIC_CAP", default=0)
+
+
+def fabric_skew() -> int:
+    """RAFT_TPU_FABRIC_SKEW: bounded-skew pipeline depth D (default 0 =
+    lockstep). The wire contract becomes a fixed D-round latency — a frame
+    emitted at round r is injected before the receiver's round r+D+1 — so
+    each host may run up to D rounds ahead of its slowest peer and socket
+    I/O overlaps compute. Deterministic by construction: the skewed fleet
+    is bit-identical to a lockstep fleet running a uniform D-round
+    chaos wire_delay on every fabric edge (driver.py's twin oracle)."""
+    d = config.env_int("RAFT_TPU_FABRIC_SKEW", default=0)
+    if d < 0:
+        raise ValueError(f"RAFT_TPU_FABRIC_SKEW must be >= 0, got {d}")
+    return d

@@ -30,12 +30,35 @@ SENDER side through WireGate: a dropped edge still sends an empty frame
 into a later round's frame. Both drivers consult the same schedule so
 in-process and multi-process runs replay identical fault timelines.
 
+Bounded skew (RAFT_TPU_FABRIC_SKEW=D, default 0 = lockstep): the wire
+contract becomes a FIXED D-round latency — a frame emitted at round r is
+injected before the receiver's round r+D+1 instead of r+1 — so each host
+may legally run up to D rounds ahead of its slowest peer. Frames stage
+in a receive-side map keyed (peer, emit_round); the only hard block is
+backpressure, when the frame due for the next round has not arrived
+(i.e. a peer is more than D rounds behind). Determinism is preserved by
+construction, not sacrificed: the chaos wire plane already models fixed
+N-round deferral, so a skew-D fleet is bit-identical to a lockstep fleet
+running chaos/schedule.skew_twin_schedule's uniform D-round wire_delay —
+the sha256 fleet-digest oracle tests/test_fabric.py and
+benches/fabric_ab.py gate on. Chaos composes: a user wire_delay of k
+rounds defers the EMIT tag sender-side exactly as in lockstep (total
+latency D+k — the commutation identity the tests pin), while a
+wire_partition moves to the receiver and drops a staged bundle tagged q
+iff the edge is cut at round q+D — the round the lockstep twin's
+WireGate would have released (and dropped) it.
+
 Two drivers:
   LockstepFabric     all hosts in one process (units, chaos probes,
-                     per-round trajectory digests without IPC)
-  run_fabric_workers spawn one OS process per host, pairwise pipes,
-                     blocking recv per (peer, round) as the barrier —
-                     the real multi-process milestone artifact
+                     per-round trajectory digests without IPC); the same
+                     step/stage/inject protocol serves any skew D
+  run_fabric_workers spawn one OS process per host, pairwise pipes.
+                     D=0: blocking recv per (peer, round) as the barrier.
+                     D>0: frame encode + socket I/O move to one sender
+                     and one receiver thread per peer, so round r+1's
+                     dispatch overlaps round r's frames in flight — the
+                     perf payoff benches/fabric_ab.py gates under an
+                     injected per-frame wire latency
 """
 
 from __future__ import annotations
@@ -47,7 +70,7 @@ import traceback
 
 import numpy as np
 
-from raft_tpu.fabric import fabric_enabled
+from raft_tpu.fabric import fabric_enabled, fabric_skew
 from raft_tpu.fabric.extract import (
     Bundle,
     FabricExtractor,
@@ -180,12 +203,21 @@ def _mark_ghosts(cl, ghost: np.ndarray, v: int) -> None:
 class WireGate:
     """Sender-side wire fault application (ChaosSchedule wire plane).
     Deterministic by construction: both drivers consult the same absolute
-    round, and faults never depend on payload contents."""
+    round, and faults never depend on payload contents.
 
-    def __init__(self, schedule, counters: HostCounters, n_ents: int):
+    sender_drop=False (the skewed driver) keeps the delay machinery —
+    user wire_delays still defer the emit tag here, preserving the
+    skew + delay commutation identity — but leaves wire_partition drops
+    to the receiver, which cuts a staged bundle tagged q iff the edge is
+    down at round q+D: the exact round a lockstep gate would have
+    released (and therefore drop-checked) it."""
+
+    def __init__(self, schedule, counters: HostCounters, n_ents: int,
+                 sender_drop: bool = True):
         self.schedule = schedule
         self.counters = counters
         self.e = n_ents
+        self.sender_drop = sender_drop
         self._held: dict = {}  # (src, dst) -> [(release_round, Bundle)]
 
     def outbound(self, rnd: int, src: int, dst: int, bundle) -> Bundle:
@@ -205,7 +237,7 @@ class WireGate:
             self.counters.inc("fabric_frames_deferred")
             bundle = None
         out = merge_bundles([bundle] + ready, self.e, rnd)
-        if edge in plan["drop"]:
+        if self.sender_drop and edge in plan["drop"]:
             if out.count:
                 self.counters.inc("fabric_frames_dropped")
             out = Bundle.empty(self.e, rnd)
@@ -258,23 +290,67 @@ class FabricHost:
         self.extractor = FabricExtractor(placement, host, cap)
         self.injector = FabricInjector(placement, host, cap)
         self.wire = FabricWire(self.v, self.e, counters=self.counters)
-        self.gate = WireGate(schedule, self.counters, self.e)
+        self.skew = fabric_skew()
+        self.gate = WireGate(
+            schedule, self.counters, self.e, sender_drop=(self.skew == 0)
+        )
         self.peers = placement.peers(host)
         self.trajectory = TrajectoryDigest() if track_trajectory else None
         self._pending: list = []
+        # skew mode: frames parked until D+1 rounds past their emit tag.
+        # Single-op dict access only (receive adds, _collect_due pops
+        # distinct keys), so the GIL is the synchronization the worker's
+        # per-peer receiver threads rely on.
+        self._staging: dict = {}  # (peer, emit_round) -> Bundle
+        self._peer_emit = {p: -1 for p in self.peers}  # max tag seen
+        # telemetry summaries (RAFT_TPU_FABRIC_DIET + skew): per-peer
+        # counter values at last emit (delta base) and the accumulated
+        # decoded summaries from each peer
+        self._sum_prev: dict = {p: {} for p in self.peers}
+        self.peer_summaries: dict = {p: {} for p in self.peers}
         self.round = 0
 
-    # -- one lockstep round ------------------------------------------------
+    # -- one round ---------------------------------------------------------
 
-    def step(self, ops_spec=None, **run_kw) -> dict:
-        """Inject pending -> run(1) -> extract -> gate + encode. Returns
-        {peer: frame_bytes}, ALWAYS one frame per peer (empty frames are
-        the round barrier). ops_spec is the global {field: {lane: value}}
-        dict, filtered to owned lanes here (the mono twin applies it
-        whole)."""
+    def _collect_due(self) -> list:
+        """Skew mode: pop this round's due staged bundles — emit tag
+        round-D-1, one per peer (their presence is the skew contract; a
+        hole means the caller failed to backpressure). The receiver-side
+        wire_partition check happens HERE, at round due+D — the round the
+        lockstep twin's sender gate would have released (and dropped) the
+        bundle — so chaos timelines compose identically under skew."""
+        due = self.round - self.skew - 1
+        if due < 0:
+            return []
+        bundles = []
+        sched = self.gate.schedule
+        plan = sched.wire_plan(due + self.skew) if sched is not None else None
+        for p in self.peers:
+            b = self._staging.pop((p, due), None)
+            if b is None:
+                raise RuntimeError(
+                    f"fabric skew underrun: host {self.host} entering round "
+                    f"{self.round} without frame ({p}, {due}) staged — the "
+                    "driver must block (backpressure) until it arrives"
+                )
+            if plan is not None and (p, self.host) in plan["drop"]:
+                if b.count:
+                    self.counters.inc("fabric_frames_dropped")
+                continue
+            if b.count:
+                bundles.append(b)
+        return bundles
+
+    def _step_core(self, ops_spec=None, **run_kw) -> tuple:
+        """Inject due bundles -> run(1) -> extract -> gate. Returns
+        (emit_round, {peer: Bundle}) with one outbound bundle per peer
+        (possibly empty — the frame is the liveness token either way);
+        encode/transport is the caller's half, so the skewed worker can
+        move it onto per-peer threads."""
         rnd = self.round
-        merged = merge_bundles(self._pending, self.e, rnd)
+        pending = self._pending + (self._collect_due() if self.skew else [])
         self._pending = []
+        merged = merge_bundles(pending, self.e, rnd)
         if merged.count:
             fab, injected, dropped = self.injector(self.cl.fab, merged)
             self.cl.fab = fab
@@ -293,32 +369,134 @@ class FabricHost:
             self.counters.inc("fabric_msgs_exported", bundle.count)
         self.counters.inc("fabric_msgs_total", int(total))
         parts = split_bundle(bundle, self.placement, self.e)
-        frames = {}
-        for p in self.peers:
-            out = self.gate.outbound(rnd, self.host, p, parts.get(p))
-            frame = self.wire.encode(out, rnd)
-            if out.count:
-                self.spans.spans.append((
-                    "fabric_tx", time.perf_counter(), 0.0,
-                    dict(round=rnd, peer=p, msgs=out.count,
-                         bytes=len(frame), groups=self._groups_of(out)),
-                ))
-            frames[p] = frame
+        outs = {
+            p: self.gate.outbound(rnd, self.host, p, parts.get(p))
+            for p in self.peers
+        }
         if self.trajectory is not None:
             self.trajectory.update(owned_rows(self.cl, self.own))
         self.round += 1
+        if self.skew:
+            # completed-round gap to the slowest peer's last emit: 0 in
+            # perfect lockstep, D at the backpressure edge
+            behind = min(self._peer_emit.values(), default=rnd - 1)
+            cur = max(0, rnd - 1 - behind)
+            self.counters.set("fabric_skew_current", cur)
+            self.counters.set_max("fabric_skew_max", cur)
+            self.counters.set("fabric_frames_staged", len(self._staging))
+        return rnd, outs
+
+    def step(self, ops_spec=None, **run_kw) -> dict:
+        """One round, synchronous transport: _step_core + encode. Returns
+        {peer: frame_bytes}, ALWAYS one frame per peer (empty frames are
+        the round barrier / skew liveness token). ops_spec is the global
+        {field: {lane: value}} dict, filtered to owned lanes here (the
+        mono twin applies it whole)."""
+        rnd, outs = self._step_core(ops_spec, **run_kw)
+        frames = {}
+        for p in self.peers:
+            frames[p] = self.encode_frame(p, outs[p], rnd)
         return frames
 
-    def receive(self, frame: bytes, peer: int = -1) -> None:
-        """Decoded frames become next round's injections (bridge IMPORT)."""
-        b = self.wire.decode(frame)
+    def encode_frame(self, peer: int, out: Bundle, rnd: int) -> bytes:
+        """Encode one peer's gated outbound bundle (+ telemetry summary
+        when the diet + skew planes are on) and record its tx span."""
+        frame = self.wire.encode(out, rnd, summary=self.emit_summary(peer))
+        if out.count:
+            self.spans.spans.append((
+                "fabric_tx", time.perf_counter(), 0.0,
+                dict(round=rnd, peer=peer, msgs=out.count,
+                     bytes=len(frame), groups=self._groups_of(out)),
+            ))
+        return frame
+
+    def receive(self, frame: bytes, peer: int = -1, wire=None) -> None:
+        """Decoded frames become injections (bridge IMPORT): immediately
+        pending in lockstep, staged under (peer, emit_round) with skew.
+
+        The emit tag is VALIDATED against the staging window rather than
+        trusted: lockstep accepts exactly round-1 (the barrier contract);
+        skew D accepts [round-D-1, round+D+1] (the +1 absorbs the benign
+        race with the main loop's round increment) and refuses duplicate
+        (peer, tag) slots. Out-of-window frames count fabric_frames_dropped
+        with a rate-limited warning instead of silently merging — a stale
+        or replayed frame can never scribble on a live round. `wire`
+        overrides the decode endpoint (the skewed worker gives each
+        receiver thread its own, so seq/summary state is per-peer)."""
+        from raft_tpu.logging import warn_rate_limited
+
+        w = wire or self.wire
+        b = w.decode(frame)
+        if w.last_summary is not None:
+            self._fold_summary(peer, w.last_summary)
+        tag = int(b.round)
+        lo = self.round - self.skew - 1
+        hi = (self.round - 1) if self.skew == 0 else (self.round + self.skew + 1)
+        bad = not lo <= tag <= hi
+        if not bad and self.skew and (peer, tag) in self._staging:
+            bad = True
+        if bad:
+            self.counters.inc("fabric_frames_dropped")
+            warn_rate_limited(
+                f"fabric_window_{self.host}", 5.0,
+                "fabric host %d: frame from peer %d with emit round %d "
+                "outside staging window [%d, %d] (or duplicate) — dropped",
+                self.host, peer, tag, lo, hi,
+            )
+            return
         if b.count:
-            self._pending.append(b)
             self.spans.spans.append((
                 "fabric_rx", time.perf_counter(), 0.0,
-                dict(round=b.round, peer=peer, msgs=b.count,
+                dict(round=tag, peer=peer, msgs=b.count,
                      bytes=len(frame), groups=self._groups_of(b)),
             ))
+        if self.skew == 0:
+            if b.count:
+                self._pending.append(b)
+            return
+        self._staging[(peer, tag)] = b
+        if tag > self._peer_emit.get(peer, -1):
+            self._peer_emit[peer] = tag
+
+    # -- quantized telemetry summaries (RAFT_TPU_FABRIC_DIET + skew) -------
+
+    def emit_summary(self, peer: int):
+        """(deltas, tallies) of this host's counters since the last frame
+        to `peer`, or None when the summary plane is off. Skew-gated so
+        the D=0 wire stays byte-identical to the lockstep milestone."""
+        if not (self.wire.diet and self.skew):
+            return None
+        from raft_tpu.fabric.wire import (
+            SUMMARY_DELTA_KEYS,
+            SUMMARY_LEVEL_KEYS,
+            SUMMARY_TALLY_KEYS,
+        )
+
+        prev = self._sum_prev[peer]
+        cur = {
+            k: self.counters.get(k)
+            for k in SUMMARY_DELTA_KEYS + SUMMARY_TALLY_KEYS
+        }
+        deltas = {
+            k: cur[k] if k in SUMMARY_LEVEL_KEYS else cur[k] - prev.get(k, 0)
+            for k in SUMMARY_DELTA_KEYS
+        }
+        tallies = {k: cur[k] - prev.get(k, 0) for k in SUMMARY_TALLY_KEYS}
+        self._sum_prev[peer] = cur
+        return deltas, tallies
+
+    def _fold_summary(self, peer: int, summary: tuple) -> None:
+        from raft_tpu.fabric.wire import SUMMARY_LEVEL_KEYS
+
+        deltas, tallies, sat = summary
+        acc = self.peer_summaries.setdefault(peer, {})
+        for name, v in list(deltas.items()) + list(tallies.items()):
+            if name in SUMMARY_LEVEL_KEYS:
+                acc[name] = int(v)  # gauge: latest level wins
+            else:
+                acc[name] = acc.get(name, 0) + int(v)
+        if sat:
+            self.counters.inc("fabric_summary_saturated", sat)
 
     def _groups_of(self, bundle: Bundle) -> tuple:
         vv = self.v * self.v
@@ -345,7 +523,11 @@ class FabricHost:
 class LockstepFabric:
     """All hosts of a placement in one process, stepped in lockstep —
     the unit-test / chaos-probe driver (no IPC, same protocol and same
-    WireGate semantics as the spawned workers)."""
+    WireGate semantics as the spawned workers). The loop is skew-agnostic:
+    under RAFT_TPU_FABRIC_SKEW=D every frame delivered at iteration r
+    stages under its emit tag and each host pops tag r-D-1 on its next
+    step, so this driver doubles as the deterministic delay-model twin
+    the multi-process skew oracle compares against."""
 
     def __init__(self, placement: Placement, seed: int = 1, **host_kw):
         self.placement = placement
@@ -417,10 +599,148 @@ class LockstepFabric:
 # -- multiprocess launcher -------------------------------------------------
 
 
+def _lockstep_worker_loop(fh: FabricHost, conns: dict, cfg: dict) -> list:
+    """D=0: the milestone-1 protocol, byte-identical to PR 18 — blocking
+    recv per (peer, round) IS the round barrier. An injected per-frame
+    wire latency (benches) sleeps on the critical path: the whole point
+    of the skewed pipeline is to move it off."""
+    lat = float(cfg.get("wire_latency") or 0.0)
+    sleep = float((cfg.get("straggle") or {}).get(fh.host, 0.0))
+    marks = []
+    for r in range(cfg["rounds"]):
+        marks.append(time.perf_counter())
+        if sleep:
+            time.sleep(sleep)
+        spec = cfg.get("ops_spec") if r == 0 else None
+        frames = fh.step(spec, **cfg.get("run_kw") or {})
+        if lat:
+            time.sleep(lat)  # frames spend `lat` seconds in flight
+        for p, frame in frames.items():
+            send_frame(conns[p], frame)
+        for p in fh.peers:
+            fh.receive(recv_frame(conns[p]), peer=p)
+    marks.append(time.perf_counter())
+    return marks
+
+
+def _skewed_worker_loop(fh: FabricHost, conns: dict, cfg: dict) -> list:
+    """D>0: frame encode and socket I/O live on one sender + one receiver
+    thread per peer, each with its own FabricWire endpoint; the main
+    thread only dispatches rounds and stages/pops bundles. The wire
+    latency model is an absolute deadline (enqueue time + lat) so frames
+    pipeline like a real link — latency, not serialization. The only
+    block is backpressure: the frame due for the next round (emit tag
+    round-D-1) has not arrived, i.e. a peer runs more than D behind."""
+    import queue as _queue
+    import threading
+
+    lat = float(cfg.get("wire_latency") or 0.0)
+    sleep = float((cfg.get("straggle") or {}).get(fh.host, 0.0))
+    cond = threading.Condition()
+    send_qs = {p: _queue.SimpleQueue() for p in fh.peers}
+    eof = set()
+    _STOP = object()
+
+    def _sender(p, wire):
+        while True:
+            item = send_qs[p].get()
+            if item is _STOP:
+                return
+            out, rnd, summary, t_enq = item
+            frame = wire.encode(out, rnd, summary=summary)
+            if out.count:
+                fh.spans.spans.append((
+                    "fabric_tx", time.perf_counter(), 0.0,
+                    dict(round=rnd, peer=p, msgs=out.count,
+                         bytes=len(frame), groups=fh._groups_of(out)),
+                ))
+            if lat:
+                delay = t_enq + lat - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+            send_frame(conns[p], frame)
+
+    def _receiver(p, wire):
+        while True:
+            try:
+                frame = recv_frame(conns[p])
+            except (EOFError, OSError):
+                with cond:
+                    eof.add(p)
+                    cond.notify_all()
+                return
+            with cond:
+                fh.receive(frame, peer=p, wire=wire)
+                cond.notify_all()
+
+    senders = [
+        threading.Thread(
+            target=_sender,
+            args=(p, FabricWire(fh.v, fh.e, counters=fh.counters)),
+            daemon=True,
+        )
+        for p in fh.peers
+    ]
+    for p, t in zip(fh.peers, senders):
+        t.start()
+        threading.Thread(
+            target=_receiver,
+            args=(p, FabricWire(fh.v, fh.e, counters=fh.counters)),
+            daemon=True,
+        ).start()
+
+    marks = []
+    d = fh.skew
+    for r in range(cfg["rounds"]):
+        marks.append(time.perf_counter())
+        if sleep:
+            time.sleep(sleep)
+        due = r - d - 1
+        if due >= 0:
+            late = [p for p in fh.peers if (p, due) not in fh._staging]
+            if late:
+                fh.counters.inc("fabric_backpressure_rounds")
+                t0 = time.perf_counter()
+                with cond:
+                    while any(
+                        (p, due) not in fh._staging for p in fh.peers
+                    ):
+                        dead = [
+                            p for p in fh.peers
+                            if p in eof and (p, due) not in fh._staging
+                        ]
+                        if dead:
+                            raise RuntimeError(
+                                f"fabric host {fh.host}: peers {dead} hung "
+                                f"up before frame round {due}"
+                            )
+                        cond.wait(timeout=1.0)
+                dur = time.perf_counter() - t0
+                for p in late:
+                    fh.spans.spans.append((
+                        "fabric_wait", t0, dur,
+                        dict(round=r, peer=p,
+                             ms=round(dur * 1e3, 3),
+                             groups=fh.placement.shared_groups(fh.host, p)),
+                    ))
+        spec = cfg.get("ops_spec") if r == 0 else None
+        rnd, outs = fh._step_core(spec, **cfg.get("run_kw") or {})
+        t_enq = time.perf_counter()
+        for p in fh.peers:
+            send_qs[p].put((outs[p], rnd, fh.emit_summary(p), t_enq))
+    marks.append(time.perf_counter())
+    # drain: peers may still need our last frames as liveness tokens
+    for p in fh.peers:
+        send_qs[p].put(_STOP)
+    for t in senders:
+        t.join(timeout=60)
+    return marks
+
+
 def _fabric_worker(host_id: int, placement: Placement, conns: dict, result, cfg: dict):
-    """One spawned host process: lockstep rounds against pipe peers. The
-    blocking recv per (peer, round) IS the round barrier — every peer
-    sends exactly one frame per round, empty or not."""
+    """One spawned host process: `rounds` fabric rounds against pipe
+    peers — lockstep (RAFT_TPU_FABRIC_SKEW=0, the recv barrier) or the
+    bounded-skew pipeline (D>0, per-peer wire threads + backpressure)."""
     try:
         fh = FabricHost(
             placement,
@@ -431,13 +751,19 @@ def _fabric_worker(host_id: int, placement: Placement, conns: dict, result, cfg:
             track_trajectory=True,
             **cfg.get("cluster_cfg") or {},
         )
-        for r in range(cfg["rounds"]):
-            spec = cfg.get("ops_spec") if r == 0 else None
-            frames = fh.step(spec, **cfg.get("run_kw") or {})
-            for p, frame in frames.items():
-                send_frame(conns[p], frame)
-            for p in fh.peers:
-                fh.receive(recv_frame(conns[p]), peer=p)
+        # compile the injection scatter NOW: under skew the first real
+        # injection lands at round D+1, inside the timing window, and a
+        # mid-run XLA compile would swamp the per-round signal
+        fh.injector.warmup(fh.cl.fab)
+        loop = _skewed_worker_loop if fh.skew else _lockstep_worker_loop
+        marks = loop(fh, conns, cfg)
+        # steady-state per-round wall clock: median round duration past
+        # the warmup rounds — robust to residual one-off stalls (a peer's
+        # compile, an OS scheduling hiccup) that the mean would smear
+        # across the whole run
+        warm = min(4, len(marks) - 2)
+        diffs = np.diff(np.asarray(marks[warm:]))
+        per_round = float(np.median(diffs)) if diffs.size else 0.0
         own = fh.own
         leaders = [int(x) for x in fh.cl.leader_lanes() if own[int(x)]]
         cols = fh.cl.state_columns("state", "term", "committed", "lead")
@@ -451,6 +777,7 @@ def _fabric_worker(host_id: int, placement: Placement, conns: dict, result, cfg:
                 leaders=leaders,
                 columns={k: v for k, v in cols.items()},
                 n_spans=len(fh.spans.spans),
+                per_round_s=per_round,
             )
         )
     except Exception:
@@ -468,12 +795,21 @@ def run_fabric_workers(
     cap=None,
     cluster_cfg=None,
     timeout: float = 600.0,
+    wire_latency: float = 0.0,
+    straggle: dict | None = None,
 ) -> list:
     """Fork one worker process per host (spawn context — children inherit
-    the parent's RAFT_TPU_* env), wire pairwise pipes between fabric
-    peers, run `rounds` lockstep rounds, and return the per-host result
-    dicts (own mask, owned state rows, trajectory digest, counters,
-    leaders, state columns) in host order."""
+    the parent's RAFT_TPU_* env, including RAFT_TPU_FABRIC_SKEW), wire
+    pairwise pipes between fabric peers, run `rounds` rounds, and return
+    the per-host result dicts (own mask, owned state rows, trajectory
+    digest, counters, leaders, state columns, per-round wall clock) in
+    host order.
+
+    wire_latency: seconds each frame spends in flight (bench/test knob —
+    on the critical path at skew 0, overlapped by the pipeline at D>0).
+    straggle: {host: seconds} slept at the top of each of that host's
+    rounds (the straggler-soak knob: everyone else runs ahead within the
+    skew bound, then backpressures)."""
     if not fabric_enabled():
         raise RuntimeError("cross-host fabric is disabled: set RAFT_TPU_FABRIC=1")
     import multiprocessing as mp
@@ -496,6 +832,8 @@ def run_fabric_workers(
         schedule=schedule,
         cap=cap,
         cluster_cfg=cluster_cfg,
+        wire_latency=wire_latency,
+        straggle=straggle,
     )
     procs = [
         ctx.Process(

@@ -62,7 +62,14 @@ BUNDLE_FIELDS = SCALAR_FIELDS + ENT_FIELDS
 class Bundle:
     """Host-side decoded extract output: k messages in columnar form.
     chan indexes placement.CHANNELS; cell = src_lane * V + dst_slot in
-    the CANONICAL (global) lane space, identical on every host."""
+    the CANONICAL (global) lane space, identical on every host.
+
+    `round` is the EMIT round tag that rides the wire header: the
+    absolute round whose post-round carry the messages were extracted
+    from, re-stamped to the release round when a chaos wire_delay defers
+    the bundle (merge_bundles). The lockstep receiver injects before
+    round+1; a bounded-skew receiver keys its staging map by (peer,
+    round) and injects before round+D+1 (driver.py)."""
 
     chan: np.ndarray  # [k] u8
     cell: np.ndarray  # [k] u32

@@ -7,7 +7,10 @@ would sit — so the next round's route_fabric transpose delivers it to L
 like any resident traffic. Injection happens between dispatches, before
 the next run, which reproduces the monolithic emit-round-r /
 consume-round-r+1 latency exactly (the wire exchange IS the round
-boundary in the lockstep driver).
+boundary in the lockstep driver). Under RAFT_TPU_FABRIC_SKEW=D the
+driver holds a decoded bundle in its staging map until D+1 rounds after
+its emit tag, so injection models a fixed D-round wire latency instead —
+same scatter, later round boundary (driver.py's skew contract).
 
 Host-side validation happens in numpy before the jit: a row whose dst
 lane is not owned here, or whose src lane is not a ghost here, or whose
@@ -83,6 +86,26 @@ class FabricInjector:
         )
         self._own = placement.own_mask(host)
         self._in_cells = placement.in_cells(host).reshape(-1)
+
+    def warmup(self, fab) -> None:
+        """Compile the scatter program before the first real injection.
+
+        Under RAFT_TPU_FABRIC_SKEW=D the first non-empty bundle lands at
+        round >= D+1 — inside any steady-state timing window — and a
+        mid-run XLA compile (~0.5 s) there dwarfs the per-round cost the
+        pipeline is trying to hide. The warmup batch is all-invalid
+        (every row scatters to the drop sentinel) and the result is
+        discarded, so the carry is untouched.
+        """
+        e = int(fab.rep.ent_term.shape[-1])
+        z = jnp.zeros((self.cap,), jnp.int32)
+        cols = {f: z for f in SCALAR_FIELDS}
+        cols.update(
+            {f: jnp.zeros((self.cap, e), jnp.int32) for f in ENT_FIELDS}
+        )
+        jax.block_until_ready(
+            _inject_jit(fab, z, z, jnp.zeros((self.cap,), jnp.bool_), cols)
+        )
 
     def __call__(self, fab, bundle: Bundle):
         """-> (fab_with_injections, n_injected, n_dropped)."""

@@ -167,6 +167,18 @@ class Placement:
         out.discard(int(host))
         return tuple(sorted(out))
 
+    def shared_groups(self, a: int, b: int) -> tuple:
+        """Spanning groups with members on BOTH hosts `a` and `b` — the
+        groups whose traffic rides the (a, b) fabric edge. The skewed
+        driver labels its backpressure wait-spans with this set so a slow
+        peer is attributable to the quorums it stalls."""
+        a, b = int(a), int(b)
+        return tuple(
+            g
+            for g in self.spanning_groups()
+            if a in self.hosts_of_group(g) and b in self.hosts_of_group(g)
+        )
+
     def dst_host_of_cells(self, cell: np.ndarray) -> np.ndarray:
         """Destination host of flat fabric cells (cell = src_lane * V + j):
         the owner of the dst lane (src_lane // V) * V + j."""

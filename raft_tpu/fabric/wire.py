@@ -28,6 +28,23 @@ carry, after the fused round's synchronous persist has already advanced
 `stabled` past every appended entry — by the time a frame exists, its
 contents are stable locally (see driver.py).
 
+Frame header round field = the EMIT round: the absolute round whose
+post-round carry the payload was extracted from (a chaos wire_delay
+re-emits deferred bundles under the release round, so the tag always
+matches the frame's wire slot). Lockstep receivers inject it before
+round r+1; a bounded-skew receiver (RAFT_TPU_FABRIC_SKEW=D) stages it
+under (peer, emit_round) and injects before round r+D+1 — the fixed
+D-round wire contract driver.py's twin oracle leans on.
+
+Telemetry summary section (RAFT_TPU_FABRIC_DIET + np codec, skewed
+fleets): FLAG_SUM frames carry an EQuARX-style quantized summary of the
+sender's per-edge counter deltas (int8-style: 7-bit magnitude + a
+saturate flag bit) and wire-fault/recovery tallies (int4-style: two
+3-bit+flag nibbles per byte) between the header and the payload.
+Exactness is load-bearing only for raft state — telemetry saturates at
+the rail and flags (never silently wraps), and the raft payload bytes
+are untouched (tests assert byte-identity with the summary stripped).
+
 Frame transport: `send_frame`/`recv_frame` speak multiprocessing
 Connections natively (message-oriented) and raw stream sockets via a
 u32le length prefix.
@@ -48,9 +65,105 @@ MAGIC = b"RFAB"
 VERSION = 1
 FLAG_DIET = 0x01
 FLAG_PB = 0x02
+FLAG_SUM = 0x04  # quantized telemetry summary section follows the header
 
-# magic, version, flags, n_ents(E), seq, round, count
+# magic, version, flags, n_ents(E), seq, round (EMIT round), count
 _HDR = struct.Struct("<4sBBHIiI")
+
+# -- quantized telemetry summary (satellite of the skew pipeline) ----------
+#
+# Fixed key tables, so a key costs one byte on the wire. int8-style
+# deltas: u1 key id + u1 value (low 7 bits = magnitude clamped to 0..127,
+# bit 7 = saturate flag). int4-style tallies: fixed-order vector, two
+# nibbles per byte (low nibble first), each nibble = 3-bit magnitude
+# clamped to 0..7 + bit 3 saturate flag. Saturation is FLAGGED, never
+# wrapped: the decoder folds flags into fabric_summary_saturated.
+SUMMARY_DELTA_KEYS = (
+    "fabric_frames_sent",
+    "fabric_frames_received",
+    "fabric_msgs_exported",
+    "fabric_msgs_injected",
+    "fabric_msgs_total",
+    "fabric_frames_staged",
+    "fabric_skew_current",
+)
+# gauge members of the delta table: emitted as the current LEVEL, not a
+# since-last-frame difference (a gauge delta can be negative, which the
+# unsigned 7-bit lane cannot carry honestly)
+SUMMARY_LEVEL_KEYS = (
+    "fabric_frames_staged",
+    "fabric_skew_current",
+)
+SUMMARY_TALLY_KEYS = (
+    "fabric_frames_dropped",
+    "fabric_frames_deferred",
+    "fabric_injection_drops",
+    "fabric_backpressure_rounds",
+)
+_SUM_LEN = struct.Struct("<H")
+
+
+def pack_summary(deltas: dict, tallies: dict) -> tuple[bytes, int]:
+    """-> (section_bytes, n_saturated). Unknown keys are refused (the key
+    table IS the schema); negative values clamp to 0 and flag."""
+    out = bytearray()
+    sat = 0
+    items = []
+    for name, v in sorted(deltas.items()):
+        if name not in SUMMARY_DELTA_KEYS:
+            raise ValueError(f"unknown summary delta key {name!r}")
+        v = int(v)
+        q = min(max(v, 0), 127)
+        s = q != v
+        sat += s
+        items.append((SUMMARY_DELTA_KEYS.index(name), q | (0x80 if s else 0)))
+    out.append(len(items))
+    for kid, b in items:
+        out += bytes((kid, b))
+    nibbles = []
+    for name in SUMMARY_TALLY_KEYS:
+        v = int(tallies.get(name, 0))
+        q = min(max(v, 0), 7)
+        s = q != v
+        sat += s
+        nibbles.append(q | (0x8 if s else 0))
+    out.append(len(nibbles))
+    for i in range(0, len(nibbles), 2):
+        lo = nibbles[i]
+        hi = nibbles[i + 1] if i + 1 < len(nibbles) else 0
+        out.append(lo | (hi << 4))
+    return bytes(out), sat
+
+
+def unpack_summary(buf: bytes) -> tuple[dict, dict, int]:
+    """-> (deltas, tallies, n_saturated); the inverse of pack_summary."""
+    deltas: dict = {}
+    sat = 0
+    off = 0
+    n = buf[off]
+    off += 1
+    for _ in range(n):
+        kid, b = buf[off], buf[off + 1]
+        off += 2
+        if kid >= len(SUMMARY_DELTA_KEYS):
+            raise ValueError(f"unknown summary delta key id {kid}")
+        sat += bool(b & 0x80)
+        deltas[SUMMARY_DELTA_KEYS[kid]] = b & 0x7F
+    nt = buf[off]
+    off += 1
+    if nt != len(SUMMARY_TALLY_KEYS):
+        raise ValueError(
+            f"summary tally vector length {nt} != {len(SUMMARY_TALLY_KEYS)}"
+        )
+    tallies: dict = {}
+    for i, name in enumerate(SUMMARY_TALLY_KEYS):
+        nib = (buf[off + i // 2] >> (4 * (i % 2))) & 0xF
+        sat += bool(nib & 0x8)
+        tallies[name] = nib & 0x7
+    off += (nt + 1) // 2
+    if off != len(buf):
+        raise ValueError(f"trailing bytes in fabric summary: {len(buf) - off}")
+    return deltas, tallies, sat
 
 # channel classification of decoded raftpb message types (bridge.py's
 # family split: requests and responses of a family share a channel)
@@ -157,10 +270,18 @@ class FabricWire:
                 )
         self.codec = name
         self.seq = 0
+        # decode side-channel: the last frame's telemetry summary
+        # (deltas, tallies, n_saturated) or None — read it right after
+        # decode() (the skewed driver folds it into peer_summaries)
+        self.last_summary: tuple | None = None
 
     # -- frame encode/decode ----------------------------------------------
 
-    def encode(self, bundle: Bundle | None, rnd: int) -> bytes:
+    def encode(self, bundle: Bundle | None, rnd: int, summary=None) -> bytes:
+        """Frame the bundle under EMIT round `rnd`. `summary` (optional
+        (deltas, tallies) dict pair) rides as a quantized telemetry
+        section between header and payload — np codec + diet only, so the
+        raft payload bytes and the pb interop format never change."""
         k = 0 if bundle is None else bundle.count
         if k == 0:
             payload = b""
@@ -168,10 +289,26 @@ class FabricWire:
             payload = self._encode_pb(bundle)
         else:
             payload = self._encode_np(bundle)
+        section = b""
         flags = (FLAG_DIET if self.diet else 0) | (
             FLAG_PB if self.codec == "pb" else 0
         )
-        frame = _HDR.pack(MAGIC, VERSION, flags, self.e, self.seq, rnd, k) + payload
+        if summary is not None:
+            if not self.diet:
+                raise RuntimeError(
+                    "fabric telemetry summaries require RAFT_TPU_FABRIC_DIET "
+                    "(the quantized section is part of the wire diet)"
+                )
+            sec, sat = pack_summary(*summary)
+            if sat and self.counters is not None:
+                self.counters.inc("fabric_summary_saturated", sat)
+            section = _SUM_LEN.pack(len(sec)) + sec
+            flags |= FLAG_SUM
+        frame = (
+            _HDR.pack(MAGIC, VERSION, flags, self.e, self.seq, rnd, k)
+            + section
+            + payload
+        )
         self.seq += 1
         if self.counters is not None:
             self.counters.inc("fabric_frames_sent")
@@ -182,7 +319,14 @@ class FabricWire:
         magic, ver, flags, e, _seq, rnd, k = _HDR.unpack_from(frame, 0)
         if magic != MAGIC or ver != VERSION:
             raise ValueError("bad fabric frame header")
-        payload = frame[_HDR.size :]
+        off = _HDR.size
+        self.last_summary = None
+        if flags & FLAG_SUM:
+            (slen,) = _SUM_LEN.unpack_from(frame, off)
+            off += _SUM_LEN.size
+            self.last_summary = unpack_summary(frame[off : off + slen])
+            off += slen
+        payload = frame[off:]
         if k == 0:
             b = Bundle.empty(self.e, rnd)
         elif flags & FLAG_PB:

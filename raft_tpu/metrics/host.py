@@ -244,9 +244,20 @@ TIER_COUNTERS = (
 #   fabric_injection_drops  decoded rows refused by inject validation
 #                           (wrong-host dst, non-ghost src, bad cell)
 #   fabric_frames_dropped   whole frames dropped by a chaos wire partition
-#                           (ChaosSchedule.wire_partition)
+#                           (ChaosSchedule.wire_partition) or refused by
+#                           receive()'s staging-window validation
 #   fabric_frames_deferred  frames delayed by a chaos wire delay
 #                           (ChaosSchedule.wire_delay)
+#   fabric_skew_current     gauge: rounds this host currently runs ahead
+#                           of its slowest peer (RAFT_TPU_FABRIC_SKEW)
+#   fabric_skew_max         gauge: high-water mark of fabric_skew_current
+#   fabric_backpressure_rounds  rounds this host blocked because a due
+#                           frame was more than D rounds late
+#   fabric_frames_staged    gauge: frames parked in the receive-side
+#                           staging map, not yet due for injection
+#   fabric_summary_saturated  int8/int4 telemetry-summary fields that hit
+#                           the saturation rail (flagged, never wrapped —
+#                           RAFT_TPU_FABRIC_DIET summary sections)
 FABRIC_COUNTERS = (
     "fabric_frames_sent",
     "fabric_frames_received",
@@ -258,23 +269,50 @@ FABRIC_COUNTERS = (
     "fabric_injection_drops",
     "fabric_frames_dropped",
     "fabric_frames_deferred",
+    "fabric_skew_current",
+    "fabric_skew_max",
+    "fabric_backpressure_rounds",
+    "fabric_frames_staged",
+    "fabric_summary_saturated",
 )
 
 
 class HostCounters:
     """Plain host-side counter bag speaking the snapshot schema — the
-    RawNodeBatch/bridge analog of the device counters (no histogram)."""
+    RawNodeBatch/bridge analog of the device counters (no histogram).
+    Thread-safe: the skewed fabric driver increments from per-peer wire
+    threads concurrently with the main loop."""
 
     def __init__(self):
         self.counts: dict[str, int] = {}
+        import threading
+
+        self._lock = threading.Lock()
+
+    def __getstate__(self):
+        return {"counts": self.counts}
+
+    def __setstate__(self, state):
+        import threading
+
+        self.counts = state["counts"]
+        self._lock = threading.Lock()
 
     def inc(self, name: str, n: int = 1):
-        self.counts[name] = self.counts.get(name, 0) + n
+        with self._lock:
+            self.counts[name] = self.counts.get(name, 0) + n
 
     def set(self, name: str, value: int):
         """Gauge write (e.g. sessions_active): the exported value is the
         level itself, not an accumulation."""
-        self.counts[name] = int(value)
+        with self._lock:
+            self.counts[name] = int(value)
+
+    def set_max(self, name: str, value: int):
+        """Gauge high-water write: keep the larger of the stored and new
+        value (fabric_skew_max)."""
+        with self._lock:
+            self.counts[name] = max(self.counts.get(name, 0), int(value))
 
     def get(self, name: str) -> int:
         return self.counts.get(name, 0)

@@ -224,6 +224,16 @@ def explain(
                 if group not in tuple(labels.get("groups", ())):
                     continue
                 rnd = int(labels.get("round", 0))
+                if sname == "fabric_wait":
+                    # skew backpressure (RAFT_TPU_FABRIC_SKEW): the round
+                    # blocked because this peer ran > D rounds behind
+                    lines.append((
+                        rnd, 3,
+                        f"r{rnd:05d}  fabric: waited on host "
+                        f"{labels.get('peer')} "
+                        f"({labels.get('ms', 0)} ms backpressure)",
+                    ))
+                    continue
                 verb = (
                     f"fabric: frame out to host {labels.get('peer')}"
                     if sname == "fabric_tx"

@@ -437,3 +437,340 @@ def test_bundle_merge_and_split():
     assert parts[1].count == 2 and parts[0].count == 2
     assert split_bundle(None, PLACEMENT, e) == {}
     assert split_bundle(Bundle.empty(e, 0), PLACEMENT, e) == {}
+
+
+# -- bounded skew (RAFT_TPU_FABRIC_SKEW) -----------------------------------
+
+
+def _twin_lockstep_digest(sched, *, pl=PLACEMENT, rounds=ROUNDS):
+    """Skew-0 LockstepFabric digest under `sched` — the delay-model twin
+    every skewed arm is compared against (callers set SKEW env first)."""
+    from raft_tpu.fabric.driver import LockstepFabric
+
+    fab = LockstepFabric(pl, seed=SEED, schedule=sched, track_trajectory=True)
+    fab.run(rounds, ops_spec={"hup": HUPS}, auto_propose=True)
+    fab.check_no_errors()
+    return fab.fleet_trajectory()
+
+
+def test_fabric_skew_env_validation(monkeypatch):
+    from raft_tpu.fabric import fabric_skew
+
+    monkeypatch.delenv("RAFT_TPU_FABRIC_SKEW", raising=False)
+    assert fabric_skew() == 0
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "3")
+    assert fabric_skew() == 3
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "-1")
+    with pytest.raises(ValueError, match="RAFT_TPU_FABRIC_SKEW"):
+        fabric_skew()
+
+
+def test_skew_twin_schedule_shape_and_refusal():
+    from raft_tpu.chaos.schedule import skew_twin_schedule
+
+    twin = skew_twin_schedule(None, PLACEMENT, 2, 40)
+    delays = [e for e in twin.wire_events if e.kind == "wire_delay"]
+    assert len(delays) == 1
+    # a base carrying its own wire_delay cannot be twinned (wire_plan
+    # max-composes overlapping delays; the commutation test below pins
+    # the correct composition instead)
+    base = ChaosSchedule(G, V).wire_delay([(0, 1)], at=4, duration=4)
+    with pytest.raises(ValueError, match="wire_delay"):
+        skew_twin_schedule(base, PLACEMENT, 2, 40)
+    with pytest.raises(ValueError, match="skew"):
+        skew_twin_schedule(None, PLACEMENT, 0, 40)
+
+
+def test_skew_lockstep_parity_with_twin(fabric_on, monkeypatch):
+    """The tentpole determinism oracle, in-process: a skew-2 fleet is
+    bit-identical to a lockstep fleet under the uniform 2-round
+    wire_delay twin — and genuinely different from the undelayed one."""
+    from raft_tpu.chaos.schedule import skew_twin_schedule
+    from raft_tpu.fabric.driver import LockstepFabric
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "2")
+    skewed = LockstepFabric(PLACEMENT, seed=SEED, track_trajectory=True)
+    skewed.run(ROUNDS, ops_spec={"hup": HUPS}, auto_propose=True)
+    skewed.check_no_errors()
+    snap = skewed.metrics_snapshot()
+    # in-process lockstep delivery: every peer keeps pace, so the skew
+    # gauge never leaves 0 even though the staging plane is live
+    assert snap["counters"]["fabric_skew_max"] == 0
+    assert snap["counters"]["fabric_frames_staged"] >= 0
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "0")
+    twin = _twin_lockstep_digest(
+        skew_twin_schedule(None, PLACEMENT, 2, ROUNDS + 4)
+    )
+    assert skewed.fleet_trajectory() == twin
+    assert skewed.fleet_trajectory() != _mono_digest()
+
+
+def test_skew_user_delay_commutes(fabric_on, monkeypatch):
+    """Chaos composes under skew: skew D + user wire_delay k over the
+    whole run == skew D' + delay k' whenever D + k == D' + k' — the
+    commutation identity skew_twin_schedule's docstring points at."""
+    from raft_tpu.fabric.driver import LockstepFabric
+
+    def arm(d, k):
+        monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", str(d))
+        sched = None
+        if k:
+            sched = ChaosSchedule(G, V).wire_delay(
+                [(0, 1)], at=0, duration=ROUNDS + 8, rounds=k
+            )
+        fab = LockstepFabric(
+            PLACEMENT, seed=SEED, schedule=sched, track_trajectory=True
+        )
+        fab.run(ROUNDS, ops_spec={"hup": HUPS}, auto_propose=True)
+        fab.check_no_errors()
+        return fab.fleet_trajectory()
+
+    d = arm(2, 1)
+    assert d == arm(1, 2) == arm(0, 3)
+
+
+def test_skew_partition_drops_staged_frames(fabric_on, monkeypatch):
+    """A wire_partition cutting mid-skew must drop the STAGED bundles the
+    lockstep twin's sender gate would have dropped — never inject stale
+    payloads — so the digests still agree and drops are counted."""
+    from raft_tpu.chaos.schedule import skew_twin_schedule
+    from raft_tpu.fabric.driver import LockstepFabric
+
+    def user_sched():
+        return ChaosSchedule(G, V).wire_partition([(0, 1)], at=8, duration=4)
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "2")
+    skewed = LockstepFabric(
+        PLACEMENT, seed=SEED, schedule=user_sched(), track_trajectory=True
+    )
+    skewed.run(ROUNDS, ops_spec={"hup": HUPS}, auto_propose=True)
+    skewed.check_no_errors()
+    assert skewed.metrics_snapshot()["counters"]["fabric_frames_dropped"] > 0
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "0")
+    twin = _twin_lockstep_digest(
+        skew_twin_schedule(user_sched(), PLACEMENT, 2, ROUNDS + 4)
+    )
+    assert skewed.fleet_trajectory() == twin
+
+
+def test_receive_validates_staging_window(fabric_on, monkeypatch):
+    """The small fix: FabricHost.receive refuses emit tags outside the
+    staging window and duplicate (peer, tag) slots — counted, never
+    merged into a live round."""
+    from raft_tpu.fabric.driver import FabricHost
+    from raft_tpu.metrics.host import HostCounters
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "2")
+    fh = FabricHost(PLACEMENT, 0, seed=SEED)
+    tx = FabricWire(V, fh.e, counters=HostCounters())
+    empty = Bundle.empty(fh.e, 0)
+
+    fh.receive(tx.encode(empty, 1), peer=1)
+    assert (1, 1) in fh._staging
+    base = fh.counters.get("fabric_frames_dropped")
+    # duplicate (peer, tag): dropped, staging untouched
+    fh.receive(tx.encode(empty, 1), peer=1)
+    assert fh.counters.get("fabric_frames_dropped") == base + 1
+    # beyond the window (round=0, D=2 -> hi = 3): dropped, not staged
+    fh.receive(tx.encode(empty, 4), peer=1)
+    assert fh.counters.get("fabric_frames_dropped") == base + 2
+    assert (1, 4) not in fh._staging
+
+    # lockstep (D=0) accepts exactly round-1: a future tag is refused
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "0")
+    fh0 = FabricHost(PLACEMENT, 0, seed=SEED)
+    tx0 = FabricWire(V, fh0.e, counters=HostCounters())
+    fh0.receive(tx0.encode(empty, 5), peer=1)
+    assert fh0.counters.get("fabric_frames_dropped") == 1
+    assert not fh0._pending
+
+
+def test_summary_pack_roundtrip_and_saturation():
+    from raft_tpu.fabric.wire import (
+        SUMMARY_DELTA_KEYS,
+        SUMMARY_TALLY_KEYS,
+        pack_summary,
+        unpack_summary,
+    )
+
+    deltas = {k: i for i, k in enumerate(SUMMARY_DELTA_KEYS)}
+    tallies = {k: i % 8 for i, k in enumerate(SUMMARY_TALLY_KEYS)}
+    buf, sat = pack_summary(deltas, tallies)
+    assert sat == 0
+    # int8-style deltas + nibble-packed tallies: tiny on the wire
+    assert len(buf) == 2 + 2 * len(deltas) + (len(SUMMARY_TALLY_KEYS) + 1) // 2
+    d2, t2, s2 = unpack_summary(buf)
+    assert d2 == deltas and t2 == tallies and s2 == 0
+
+    # saturate-and-flag, never wrap: 1000 -> 127 flagged, 9 -> 7 flagged
+    buf, sat = pack_summary(
+        {SUMMARY_DELTA_KEYS[0]: 1000}, {SUMMARY_TALLY_KEYS[0]: 9}
+    )
+    assert sat == 2
+    d2, t2, s2 = unpack_summary(buf)
+    assert d2[SUMMARY_DELTA_KEYS[0]] == 127
+    assert t2[SUMMARY_TALLY_KEYS[0]] == 7
+    assert s2 == 2
+
+    with pytest.raises(ValueError, match="unknown summary delta key"):
+        pack_summary({"not_a_counter": 1}, {})
+    with pytest.raises(ValueError, match="trailing"):
+        unpack_summary(buf + b"\x00")
+
+
+def test_summary_rides_diet_frames_only(fabric_on, monkeypatch):
+    """Frame-level contract: a summary needs the diet plane (RuntimeError
+    otherwise), adds a section without touching the raft payload, and the
+    diet frame stays strictly smaller than the wide frame."""
+    from raft_tpu.metrics.host import HostCounters
+
+    e = 2
+    b = _mk_bundle(e, diet_bounded=True)
+    wide = FabricWire(V, e, counters=HostCounters(), codec="np")
+    with pytest.raises(RuntimeError, match="diet"):
+        wide.encode(b, 3, summary=({"fabric_frames_sent": 1}, {}))
+
+    monkeypatch.setenv("RAFT_TPU_DIET", "1")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_DIET", "1")
+    diet_tx = FabricWire(V, e, counters=HostCounters(), codec="np")
+    diet_rx = FabricWire(V, e, counters=HostCounters(), codec="np")
+    summary = (
+        {"fabric_frames_sent": 5, "fabric_skew_current": 1},
+        {"fabric_frames_dropped": 2},
+    )
+    plain = diet_tx.encode(b, 3)
+    framed = diet_tx.encode(b, 3, summary=summary)
+    assert len(plain) < len(framed) < len(wide.encode(b, 3))
+
+    got = diet_rx.decode(framed)
+    _assert_bundles_equal(got, b)  # raft payload untouched by the section
+    deltas, tallies, sat = diet_rx.last_summary
+    assert deltas["fabric_frames_sent"] == 5
+    assert deltas["fabric_skew_current"] == 1
+    assert tallies["fabric_frames_dropped"] == 2 and sat == 0
+    assert diet_rx.decode(plain) is not None
+    assert diet_rx.last_summary is None  # summary is per-frame, not sticky
+
+
+def test_skew_diet_summary_plane_end_to_end(fabric_on, monkeypatch):
+    """Skew + diet: summaries flow host-to-host and fold into
+    peer_summaries, raft trajectories stay twin-identical, and the diet
+    wire is still strictly smaller than the wide one."""
+    from raft_tpu.chaos.schedule import skew_twin_schedule
+    from raft_tpu.fabric.driver import LockstepFabric
+
+    monkeypatch.setenv("RAFT_TPU_DIET", "1")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_CODEC", "np")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "2")
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_DIET", "1")
+    diet = LockstepFabric(PLACEMENT, seed=SEED, track_trajectory=True)
+    diet.run(ROUNDS, ops_spec={"hup": HUPS}, auto_propose=True)
+    diet.check_no_errors()
+    for fh in diet.hosts:
+        for p in fh.peers:
+            acc = fh.peer_summaries[p]
+            assert acc["fabric_frames_sent"] >= ROUNDS - 1
+            assert acc["fabric_msgs_exported"] > 0
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_DIET", "0")
+    wide = LockstepFabric(PLACEMENT, seed=SEED, track_trajectory=True)
+    wide.run(ROUNDS, ops_spec={"hup": HUPS}, auto_propose=True)
+    assert diet.fleet_trajectory() == wide.fleet_trajectory()
+    db = diet.metrics_snapshot()["counters"]["fabric_bytes_sent"]
+    wb = wide.metrics_snapshot()["counters"]["fabric_bytes_sent"]
+    assert 0 < db < wb  # summaries ride along, frames still net smaller
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "0")
+    twin = _twin_lockstep_digest(
+        skew_twin_schedule(None, PLACEMENT, 2, ROUNDS + 4)
+    )
+    assert diet.fleet_trajectory() == twin
+
+
+def test_explain_narrates_backpressure_wait():
+    from raft_tpu.trace.assemble import explain
+
+    spans = [
+        ("fabric_wait", 10.0, 0.25,
+         dict(round=7, peer=1, ms=250.0, groups=(1,))),
+    ]
+    lines = explain(1, spans=spans, v=V)
+    assert any(
+        "fabric: waited on host 1" in ln and "250" in ln for ln in lines
+    )
+    # the wait is attributed to the shared spanning groups only
+    assert not any("waited" in ln for ln in explain(0, spans=spans, v=V))
+
+
+@pytest.mark.slow
+def test_skew_mp_acceptance(fabric_on, monkeypatch):
+    """The ISSUE acceptance oracle: two spawned processes at skew 2, a
+    wire partition cutting mid-skew, diet + summary + metrics all on —
+    fleet digest identical to the lockstep wire_delay(2) twin."""
+    from raft_tpu.chaos.schedule import skew_twin_schedule
+    from raft_tpu.fabric.driver import (
+        LockstepFabric,
+        run_fabric_workers,
+        workers_fleet_digest,
+    )
+
+    monkeypatch.setenv("RAFT_TPU_DIET", "1")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_CODEC", "np")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_DIET", "1")
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "2")
+
+    def user_sched():
+        return ChaosSchedule(G, V).wire_partition([(0, 1)], at=8, duration=4)
+
+    res = run_fabric_workers(
+        PLACEMENT, rounds=ROUNDS, seed=SEED, ops_spec={"hup": HUPS},
+        run_kw=dict(auto_propose=True), schedule=user_sched(), timeout=480,
+    )
+    for r in res:
+        c = r["counters"]
+        assert c["fabric_skew_max"] <= 2
+        assert c["fabric_frames_sent"] == ROUNDS
+    assert sum(r["counters"]["fabric_frames_dropped"] for r in res) > 0
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "0")
+    twin = _twin_lockstep_digest(
+        skew_twin_schedule(user_sched(), PLACEMENT, 2, ROUNDS + 4)
+    )
+    assert workers_fleet_digest(res) == twin
+
+
+@pytest.mark.slow
+def test_skew_mp_straggler_soak(fabric_on, monkeypatch):
+    """A hard per-round straggler on host 0: host 1 sprints to the skew
+    bound, backpressures every round after, and the fleet still lands
+    the twin digest with commit progress everywhere (the liveness SLO)."""
+    from raft_tpu.chaos.schedule import skew_twin_schedule
+    from raft_tpu.fabric.driver import (
+        run_fabric_workers,
+        stitched_columns,
+        workers_fleet_digest,
+    )
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "2")
+    res = run_fabric_workers(
+        PLACEMENT, rounds=ROUNDS, seed=SEED, ops_spec={"hup": HUPS},
+        run_kw=dict(auto_propose=True), timeout=480,
+        straggle={0: 0.02},
+    )
+    fast = res[1]["counters"]
+    assert fast["fabric_backpressure_rounds"] > 0
+    assert fast["fabric_skew_max"] == 2  # ran to the bound, never past it
+    for r in res:
+        assert r["counters"]["fabric_skew_max"] <= 2
+    cols = stitched_columns(res, PLACEMENT.n_lanes)
+    assert (cols["committed"].reshape(G, V) >= 1).all()
+
+    monkeypatch.setenv("RAFT_TPU_FABRIC_SKEW", "0")
+    twin = _twin_lockstep_digest(
+        skew_twin_schedule(None, PLACEMENT, 2, ROUNDS + 4)
+    )
+    assert workers_fleet_digest(res) == twin
