@@ -1,0 +1,286 @@
+"""Leader-lease A/B serving bench: lease reads vs the ReadIndex handshake.
+
+Runs the SAME serving workload twice in fresh subprocesses —
+RAFT_TPU_LEASE=0 (every GET pays the ReadIndex round-trip) then =1 (the
+device lease plane, ops/lease.py + the router fast path) — and gates, per
+the ISSUE 20 acceptance bar:
+
+  1. latency: lease-on read-notify p50 == 1 device round on the calm
+     phase, vs p50 >= 3 rounds for the ReadIndex path (the measured
+     engine floor: submit -> ctx'd heartbeat -> ack quorum -> release;
+     the serve plane's coalescing hides one round of the nominal >= 4),
+  2. safety under clock skew: a probabilistic tick-skew storm
+     (chaos plane, tick_skew_num on every slot so leaders are hit) with
+     calm gaps so leases re-grant between bursts — ZERO stale reads in
+     both arms (every read's answered index >= the highest index any
+     write to that group had ALREADY notified when the read was
+     submitted) while the lease arm proves the defense actually fired
+     (engine lease_skew_revocations > 0) and the calm phase actually
+     used the fast path (lease_reads_served > 0),
+  3. digest identity: within each arm the committed KV == the scalar
+     twin replay, and ACROSS arms the KV digests are bit-identical —
+     the lease is a latency optimization, never a behavior change,
+  4. elision: the lease=0 child never traces a lease op
+     (ops/lease.py kernel_calls() == 0), carries no lease columns
+     (state.lease_left is None), and its carry has exactly 7 fewer
+     leaves than the lease=1 child's.
+
+Both children construct with check_quorum=True: the grant predicate
+requires it (the follower in-lease vote rejection is the other half of
+the safety argument), so a default-config cluster never grants.
+
+Exit 0 = pass, 1 = regression. One JSON summary line (egress_ab shape).
+--smoke runs the CPU-sized config wired into runtests.sh.
+Env: LEASE_AB_GROUPS, LEASE_AB_ROUNDS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def child():
+    import numpy as np
+
+    import jax
+
+    from raft_tpu.chaos.device import probability
+    from raft_tpu.ops import lease as lsmod
+    from raft_tpu.ops.fused import FusedCluster
+    from raft_tpu.serve import Rejected, ServeLoop
+
+    smoke = os.environ.get("LEASE_AB_SMOKE") == "1"
+    groups = int(os.environ.get("LEASE_AB_GROUPS", 4))
+    voters = 3
+    calm_rounds = int(os.environ.get("LEASE_AB_ROUNDS", 24 if smoke else 48))
+    bursts = 2 if smoke else 3
+    storm_len, gap_len = 6, 12
+    settle_rounds = 48
+
+    cluster = FusedCluster(groups, voters, seed=7, check_quorum=True)
+    loop = ServeLoop(cluster, tenant_rate=64.0, tenant_burst=256.0)
+    loop.bootstrap()
+
+    # one session per group (placement hashes the tenant name)
+    by_group = {}
+    i = 0
+    while len(by_group) < groups:
+        s = loop.open_session(f"tenant-{i}")
+        by_group.setdefault(s.group, s)
+        i += 1
+    sessions = [by_group[g] for g in sorted(by_group)]
+
+    # staleness oracle state: floor[g] = highest index any write to g had
+    # notified; each read snapshots it at submit and must answer >= it
+    floor = {g: 0 for g in range(groups)}
+    writes, lat, pending = [], [], []
+    stale = reads_done = wseq = 0
+    outstanding = {s.id: None for s in sessions}
+    twin_log = []
+
+    def poll():
+        nonlocal stale, reads_done
+        done = [t for t in writes if t.done and t.index is not None]
+        for t in done:
+            floor[t.group] = max(floor[t.group], t.index)
+            writes.remove(t)
+        still = []
+        for rt, f0, calm in pending:
+            if rt.done:
+                reads_done += 1
+                if rt.index is None or rt.index < f0:
+                    stale += 1
+                if calm and rt.notify_round is not None:
+                    lat.append(rt.notify_round - rt.submit_round)
+            else:
+                still.append((rt, f0, calm))
+        pending[:] = still
+
+    def run_rounds(n, calm, write_every=3):
+        nonlocal wseq
+        for r in range(n):
+            for s in sessions:
+                if write_every and r % write_every == 0:
+                    wseq += 1
+                    t = loop.put(s, f"k{wseq % 8}", f"{s.tenant}.{wseq}")
+                    if not isinstance(t, Rejected):
+                        writes.append(t)
+                        twin_log.append((s.group, t.cmd, 0))
+                rt = outstanding[s.id]
+                if rt is None or rt.done:
+                    rt = loop.get(s, "k0")
+                    if isinstance(rt, Rejected):
+                        outstanding[s.id] = None
+                    else:
+                        outstanding[s.id] = rt
+                        pending.append((rt, floor[s.group], calm))
+            loop.step()
+            loop.flush()
+            poll()
+
+    # seed the keyspace, then a fixed settle so every put notifies
+    for s in sessions:
+        for k in range(8):
+            t = loop.put(s, f"k{k}", f"{s.tenant}.seed{k}")
+            if not isinstance(t, Rejected):
+                writes.append(t)
+                twin_log.append((s.group, t.cmd, 0))
+    run_rounds(12, calm=False, write_every=0)
+
+    # calm phase: the latency measurement (stable leaders, no chaos)
+    run_rounds(calm_rounds, calm=True)
+
+    # skew storm: bursts of probabilistic tick skipping on EVERY slot
+    # (leaders included), calm gaps in between so the lease re-grants —
+    # skew_revocations > 0 then proves revocation, not non-grant
+    if cluster.chaos is not None:
+        num = int(probability(0.7))
+        for _ in range(bursts):
+            cluster.set_chaos(tick_skew_num=num)
+            run_rounds(storm_len, calm=False)
+            cluster.set_chaos(tick_skew_num=0)
+            run_rounds(gap_len, calm=False)
+
+    # fixed-length settle (NOT drain(): loop.round must be identical
+    # across arms for the cross-arm digest compare), no new submissions
+    for _ in range(settle_rounds):
+        loop.step()
+        loop.flush()
+        poll()
+        if not loop.outstanding and not pending:
+            # keep stepping anyway — round count must stay fixed
+            pass
+    drained = loop.outstanding == 0 and not pending
+
+    from raft_tpu.serve.kv import replay
+
+    digest = loop.digest()
+    twin = replay(groups, twin_log, loop.round)
+    est = cluster.lease_stats() or {}
+    sm = loop.metrics_snapshot()["counters"]
+    print(json.dumps({
+        "lease": lsmod.lease_enabled(),
+        "backend": jax.default_backend(),
+        "rounds": loop.round,
+        "drained": drained,
+        "reads_done": reads_done,
+        "stale_reads": stale,
+        "read_p50": float(np.percentile(lat, 50)) if lat else None,
+        "read_p99": float(np.percentile(lat, 99)) if lat else None,
+        "digest": digest,
+        "twin_equal": digest == twin,
+        "lease_reads_served": sm.get("lease_reads_served", 0),
+        "lease_reads_fallback": sm.get("lease_reads_fallback", 0),
+        "grants": est.get("lease_grants", 0),
+        "renewals": est.get("lease_renewals", 0),
+        "revocations": est.get("lease_revocations", 0),
+        "skew_revocations": est.get("lease_skew_revocations", 0),
+        "kernel_calls": lsmod.kernel_calls(),
+        "state_leaves": len(jax.tree_util.tree_leaves(cluster.state)),
+    }))
+
+
+def run_child(lease: str) -> dict:
+    env = dict(
+        os.environ,
+        RAFT_TPU_LEASE=lease,
+        RAFT_TPU_EGRESS="1",
+        RAFT_TPU_CHAOS="1",
+    )
+    if "--smoke" in sys.argv:
+        env["LEASE_AB_SMOKE"] = "1"
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, capture_output=True, text=True, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    off = run_child("0")
+    on = run_child("1")
+    lat_ok = (
+        on["read_p50"] is not None
+        and on["read_p50"] == 1.0
+        and off["read_p50"] is not None
+        and off["read_p50"] >= 3.0
+    )
+    fast_path_ok = on["lease_reads_served"] > 0
+    stale_ok = on["stale_reads"] == 0 and off["stale_reads"] == 0
+    skew_ok = on["skew_revocations"] > 0
+    digest_ok = (
+        on["twin_equal"] and off["twin_equal"] and on["digest"] == off["digest"]
+    )
+    elide_ok = (
+        off["kernel_calls"] == 0
+        and on["kernel_calls"] > 0
+        and off["state_leaves"] == on["state_leaves"] - 7
+    )
+    drain_ok = on["drained"] and off["drained"]
+    ok = (
+        lat_ok and fast_path_ok and stale_ok and skew_ok and digest_ok
+        and elide_ok and drain_ok
+    )
+    print(json.dumps({
+        "metric": "lease_ab",
+        "ok": ok,
+        "backend": on["backend"],
+        "read_p50_on": on["read_p50"],
+        "read_p99_on": on["read_p99"],
+        "read_p50_off": off["read_p50"],
+        "read_p99_off": off["read_p99"],
+        "lease_reads_served": on["lease_reads_served"],
+        "lease_reads_fallback": on["lease_reads_fallback"],
+        "grants": on["grants"],
+        "renewals": on["renewals"],
+        "revocations": on["revocations"],
+        "skew_revocations": on["skew_revocations"],
+        "stale_reads_on": on["stale_reads"],
+        "stale_reads_off": off["stale_reads"],
+        "digest_equal": digest_ok,
+        "elided_off": elide_ok,
+    }))
+    if not lat_ok:
+        print(
+            f"FAIL: read-notify p50 on={on['read_p50']} (want 1.0) "
+            f"off={off['read_p50']} (want >= 3.0)", file=sys.stderr,
+        )
+    if not fast_path_ok:
+        print("FAIL: lease arm served zero reads from the lease",
+              file=sys.stderr)
+    if not stale_ok:
+        print(
+            f"FAIL: stale reads under skew (on={on['stale_reads']}, "
+            f"off={off['stale_reads']})", file=sys.stderr,
+        )
+    if not skew_ok:
+        print("FAIL: skew storm produced zero lease_skew_revocations "
+              "(the defense never fired)", file=sys.stderr)
+    if not digest_ok:
+        print(
+            f"FAIL: digest mismatch (twin on={on['twin_equal']} "
+            f"off={off['twin_equal']}, cross-arm "
+            f"{on['digest'][:16]} vs {off['digest'][:16]})",
+            file=sys.stderr,
+        )
+    if not elide_ok:
+        print(
+            f"FAIL: lease=0 not elided (kernel_calls={off['kernel_calls']}, "
+            f"leaves off={off['state_leaves']} on={on['state_leaves']})",
+            file=sys.stderr,
+        )
+    if not drain_ok:
+        print("FAIL: settle phase left work outstanding", file=sys.stderr)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -28,7 +28,16 @@ count in logical groups (default 16x), the per-size rung hibernates half
 its cohort to the host cold store before timing, and the JSON line grows
 logical-vs-resident occupancy plus cold_host_bytes_per_logical columns —
 the O(resident) HBM / O(total) logical-groups artifact: live bytes track
-the RESIDENT column while the logical column scales away."""
+the RESIDENT column while the logical column scales away.
+
+PROBE_LEASE=0/1 flips the leader-lease plane (RAFT_TPU_LEASE, ISSUE 20).
+The lease arm constructs every rung with check_quorum=True (the grant
+predicate requires it) and the JSON line grows the lease counters plus
+`reads_per_round`: lease-covered group-rounds per device round over the
+timed window ((grants + renewals) / rounds) — each one is a group that
+could have answered a coalesced batch of linearizable GETs that round
+with ZERO quorum traffic, the capacity the serve plane's fast path
+draws on."""
 
 from __future__ import annotations
 
@@ -110,6 +119,54 @@ def tier_columns(c) -> dict:
     }
 
 
+def lease_kwargs() -> dict:
+    """Constructor kwargs for the PROBE_LEASE=1 arm: the grant predicate
+    (ops/lease.py lease_round) requires check_quorum — off in the probe's
+    default LaneConfig — so the lease arm flips it on; a default-config
+    rung would report an all-zero lease column set."""
+    if not config.env_flag("RAFT_TPU_LEASE", default=False):
+        return {}
+    return {"check_quorum": True}
+
+
+def lease_snapshot(c) -> dict | None:
+    """Summed lease counters over resident blocks (FusedCluster.lease_stats
+    per block); None when RAFT_TPU_LEASE is off."""
+    stats = None
+    for b in getattr(c, "blocks", [c]):
+        b = getattr(b, "inner", b)
+        if getattr(b.state, "lease_left", None) is None:
+            continue
+        s = b.lease_stats()
+        if stats is None:
+            stats = dict(s)
+        else:
+            for k, v in s.items():
+                stats[k] += v
+    return stats
+
+
+def lease_columns(s0, s1, rounds: int) -> dict:
+    """Lease columns for the PROBE_LEASE=1 arm, measured as deltas over
+    the TIMED window: reads_per_round counts lease-covered group-rounds
+    per device round — every grant or renewal is one group able to answer
+    an arbitrarily large coalesced GET batch that round without touching
+    a quorum. {"lease": 0} when the plane is off."""
+    if s1 is None:
+        return {"lease": 0}
+    d = {k: s1[k] - (s0 or {}).get(k, 0) for k in s1}
+    return {
+        "lease": 1,
+        "reads_per_round": round(
+            (d["lease_grants"] + d["lease_renewals"]) / max(rounds, 1), 1
+        ),
+        "lease_grants": d["lease_grants"],
+        "lease_renewals": d["lease_renewals"],
+        "lease_revocations": d["lease_revocations"],
+        "lease_skew_revocations": d["lease_skew_revocations"],
+    }
+
+
 def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
     from raft_tpu.config import Shape
     from raft_tpu.ops.fused import FusedCluster
@@ -125,7 +182,7 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
         max_read_index=r,
     )
     c = FusedCluster(n_groups, n_voters, seed=42, shape=shape,
-                     **tier_logical(n_groups))
+                     **tier_logical(n_groups), **lease_kwargs())
     lag = min(8, w // 2)
     t0 = time.perf_counter()
     c.run(block, auto_propose=True, auto_compact_lag=lag)
@@ -144,12 +201,14 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
         for g in list(c.tier.residents())[::2]:
             c.tier.request_evict(g)
         c.tier.apply(1 << 20)
+    ls0 = lease_snapshot(c)
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
         c.run(block, auto_propose=True, auto_compact_lag=lag)
         jax.block_until_ready(c.state.term)
         best = min(best, time.perf_counter() - t0)
+    ls1 = lease_snapshot(c)
     lanes = n_groups * n_voters
     round_ms = 1000 * best / block
     from raft_tpu.utils.profiling import live_buffer_bytes
@@ -180,6 +239,7 @@ def measure(n_groups, n_voters, block=32, iters=5, w=16, e=2):
                 "live_bytes_per_lane": round(live_per_lane, 1),
                 **paged_columns(c),
                 **tier_columns(c),
+                **lease_columns(ls0, ls1, iters * block),
                 **mem,
             }
         ),
@@ -201,7 +261,7 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
     )
     c = BlockedFusedCluster(
         n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape,
-        **tier_logical(n_groups),
+        **tier_logical(n_groups), **lease_kwargs(),
     )
     lag = min(8, w // 2)
     t0 = time.perf_counter()
@@ -212,12 +272,14 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
     while c.leader_count() < n_groups and warm < 40 * 16:
         c.run(block, auto_propose=True, auto_compact_lag=lag)
         warm += block
+    ls0 = lease_snapshot(c)
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
         c.run(block, auto_propose=True, auto_compact_lag=lag)
         c.block_until_ready()
         best = min(best, time.perf_counter() - t0)
+    ls1 = lease_snapshot(c)
     lanes = n_groups * n_voters
     from raft_tpu.utils.profiling import live_buffer_bytes
 
@@ -247,6 +309,7 @@ def measure_blocked(n_groups, n_voters, block_groups, block=32, iters=5,
                 "live_bytes_per_lane": round(live_per_lane, 1),
                 **paged_columns(c),
                 **tier_columns(c),
+                **lease_columns(ls0, ls1, iters * block),
                 **mem,
             }
         ),
@@ -277,7 +340,7 @@ def measure_mesh(n_groups, n_voters, block_groups, block=32, iters=5,
     )
     c = MeshBlockedCluster(
         n_groups, n_voters, block_groups=block_groups, seed=42, shape=shape,
-        **tier_logical(n_groups),
+        **tier_logical(n_groups), **lease_kwargs(),
     )
     lag = min(8, w // 2)
     t0 = time.perf_counter()
@@ -288,12 +351,14 @@ def measure_mesh(n_groups, n_voters, block_groups, block=32, iters=5,
     while c.leader_count() < n_groups and warm < 40 * 16:
         c.run(block, auto_propose=True, auto_compact_lag=lag)
         warm += block
+    ls0 = lease_snapshot(c)
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
         c.run(block, auto_propose=True, auto_compact_lag=lag)
         c.block_until_ready()
         best = min(best, time.perf_counter() - t0)
+    ls1 = lease_snapshot(c)
     lanes = n_groups * n_voters
     from raft_tpu.utils.profiling import live_buffer_bytes
 
@@ -325,6 +390,7 @@ def measure_mesh(n_groups, n_voters, block_groups, block=32, iters=5,
                 "live_bytes_per_lane": round(live_per_lane, 1),
                 **paged_columns(c),
                 **tier_columns(c),
+                **lease_columns(ls0, ls1, iters * block),
                 **mem,
             }
         ),
@@ -348,6 +414,11 @@ if __name__ == "__main__":
         # RAFT_TPU_PAGED for every rung and each JSON line grows the
         # pool-occupancy + paged_bytes_per_lane columns
         os.environ["RAFT_TPU_PAGED"] = os.environ["PROBE_PAGED"]
+    if os.environ.get("PROBE_LEASE") is not None:
+        # and for the leader-lease plane (ISSUE 20): flip RAFT_TPU_LEASE
+        # for every rung (check_quorum rides along, see lease_kwargs) and
+        # each JSON line grows reads_per_round + the lease counters
+        os.environ["RAFT_TPU_LEASE"] = os.environ["PROBE_LEASE"]
     voters = int(os.environ.get("PROBE_VOTERS", 3))
     w = int(os.environ.get("PROBE_WINDOW", 16))
     e = int(os.environ.get("PROBE_ENTRIES", 2))

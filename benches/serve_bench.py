@@ -1,11 +1,16 @@
 """Serving-frontend bench: closed-loop latency + open-loop saturation on
 the multi-tenant KV frontend (raft_tpu/serve/ServeLoop).
 
-Two phases over one BlockedFusedCluster:
+Three phases over one BlockedFusedCluster:
 
   closed  M sessions, each keeping ONE put outstanding (submit on
           notify): reports notify latency p50/p99 in device rounds and
           committed ops/round — the interactive-client view.
+  read    M sessions, each keeping ONE linearizable GET outstanding:
+          reports the READ-notify p50/p99 split separately from the
+          write path (the ReadIndex pipeline has its own floor, and
+          under RAFT_TPU_LEASE=1 the lease fast path collapses it to a
+          single round — lease_served in the JSON says which path ran).
   open    every session submits a fixed burst per round regardless of
           completions, deliberately past its token bucket: admission must
           shed the excess as typed Rejected(reason) counts (NONZERO, no
@@ -110,6 +115,32 @@ def main():
         "proposals_notified", 0
     )
 
+    # -- read phase: closed-loop GETs, the read-notify split --------------
+    # one outstanding linearizable GET per session; read latency is its
+    # own histogram (read_notify_latency_rounds) because the ReadIndex
+    # pipeline — or the lease fast path under RAFT_TPU_LEASE=1 — has a
+    # different floor than the propose->commit->notify write path
+    read_rounds = max(16, rounds // 4)
+    read_lat = []
+    reading = {}
+    for s in sessions:
+        r = loop.get(s, f"{s.tenant}/k0")
+        reading[s.id] = None if isinstance(r, Rejected) else r
+    tr = time.perf_counter()
+    for _ in range(read_rounds):
+        loop.step()
+        loop.flush()
+        for s in sessions:
+            rt = reading[s.id]
+            if rt is None or rt.done:
+                if rt is not None and rt.notify_round is not None:
+                    read_lat.append(rt.notify_round - rt.submit_round)
+                r = loop.get(s, f"{s.tenant}/k0")
+                reading[s.id] = None if isinstance(r, Rejected) else r
+    read_wall = time.perf_counter() - tr
+    read_drained = loop.drain(256)
+    reads_served = loop.metrics_snapshot()["counters"].get("reads_served", 0)
+
     # -- open loop: burst past the bucket ---------------------------------
     burst = 8  # vs rate 4/round: guaranteed shed
     t2 = time.perf_counter()
@@ -141,7 +172,10 @@ def main():
     digest_ok = digest == twin
     open_ok = rejected > 0 and open_drained
 
-    ok = exactly_once and digest_ok and closed_drained and open_ok
+    read_ok = read_drained and reads_served > 0
+    lease_served = m.get("lease_reads_served", 0)
+
+    ok = exactly_once and digest_ok and closed_drained and open_ok and read_ok
     print(json.dumps({
         "metric": "serve_bench",
         "ok": ok,
@@ -157,6 +191,13 @@ def main():
             "p99_rounds": round(pct(lat, 99), 2),
             "ops_per_round": round(len(lat) / max(1, rounds), 2),
             "wall_ms_per_round": round(closed_wall * 1000 / rounds, 2),
+        },
+        "read": {
+            "served": reads_served,
+            "lease_served": lease_served,
+            "p50_rounds": round(pct(read_lat, 50), 2),
+            "p99_rounds": round(pct(read_lat, 99), 2),
+            "wall_ms_per_round": round(read_wall * 1000 / read_rounds, 2),
         },
         "open": {
             "admitted": admitted,
@@ -191,6 +232,11 @@ def main():
         )
     if not closed_drained:
         print("FAIL: closed loop failed to drain", file=sys.stderr)
+    if not read_ok:
+        print(
+            f"FAIL: read phase served={reads_served} drained={read_drained}",
+            file=sys.stderr,
+        )
     sys.exit(0 if ok else 1)
 
 

@@ -92,6 +92,7 @@ BENCH_ALLOW = {
     "benches/egress_ab.py": set(),
     "benches/fabric_ab.py": {"child"},
     "benches/latency_probe.py": {"measure", "measure_blocked"},
+    "benches/lease_ab.py": {"child"},
     "benches/metrics_smoke.py": set(),
     "benches/multichip_ab.py": set(),
     "benches/paged_ab.py": {"child"},

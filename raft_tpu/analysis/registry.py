@@ -71,6 +71,11 @@ _BASE = {
     # program (no round-jaxpr footprint), pinned off except in its own
     # profile where its extract/inject boundary jits are audited
     "RAFT_TPU_FABRIC": None,
+    # the leader-lease plane is pinned OFF in every profile except
+    # "lease": the RAFT_TPU_LEASE=0 elision claim (no lease op, carry
+    # bytes/lane unchanged) is asserted on every other entry
+    "RAFT_TPU_LEASE": None,
+    "RAFT_TPU_LEASE_MARGIN": None,
 }
 
 PROFILES = {
@@ -170,6 +175,21 @@ PROFILES = {
         RAFT_TPU_PAGED="0",
         RAFT_TPU_TIER="1",
     ),
+    # the leader-lease plane on (ISSUE 20): the serve profile plus
+    # RAFT_TPU_LEASE=1 — the lease columns ride the packed scan carry
+    # (uint16 countdown/epoch/skew under diet) and the lease maintenance
+    # ops must be IN this jaxpr and in no other entry's
+    "lease": dict(
+        _BASE,
+        RAFT_TPU_METRICS="1",
+        RAFT_TPU_CHAOS="0",
+        RAFT_TPU_TRACELOG="0",
+        RAFT_TPU_DIET="1",
+        RAFT_TPU_DONATE="1",
+        RAFT_TPU_PAGED="0",
+        RAFT_TPU_EGRESS="1",
+        RAFT_TPU_LEASE="1",
+    ),
     # the cross-host fabric's dispatch-boundary jits (fabric/extract.py,
     # fabric/inject.py): planes off so the jaxprs are pure gather/scatter
     # over the fabric carry; the carry buffers they return feed the next
@@ -230,6 +250,16 @@ def _round_xla_off():
 
 def _round_pallas():
     return _cluster("pallas", rounds_per_call=2).audit_programs()
+
+
+def _round_xla_lease():
+    # check_quorum on: the lease grant predicate requires it (the
+    # follower in-lease vote rejection is the safety other half), and the
+    # audited jaxpr should be the configuration the plane actually runs in
+    recs = _cluster("xla", check_quorum=True).audit_programs()
+    for r in recs:
+        r["name"] = r["name"] + ".lease"
+    return recs
 
 
 def _round_pallas_inkernel():
@@ -536,11 +566,13 @@ def _fabric_entries():
 
 
 _ALL_ON = {"metrics": True, "chaos": True, "trace": True, "paged": False,
-           "tier": False}
+           "tier": False, "lease": False}
 _ALL_OFF = {"metrics": False, "chaos": False, "trace": False,
-            "paged": False, "tier": False}
+            "paged": False, "tier": False, "lease": False}
 _TIER_ON = {"metrics": False, "chaos": False, "trace": False,
-            "paged": False, "tier": True}
+            "paged": False, "tier": True, "lease": False}
+_LEASE_ON = {"metrics": True, "chaos": False, "trace": False,
+             "paged": False, "tier": False, "lease": True}
 
 ENTRIES = (
     Entry("round.xla", "planes_on", _round_xla,
@@ -567,12 +599,19 @@ ENTRIES = (
     Entry("mesh.step.xla", "planes_on", _mesh_step, compile_budget=1),
     Entry("serve.round", "serve", _serve_round, compile_budget=1,
           expect_on={"metrics": True, "chaos": False, "trace": False,
-                     "paged": False, "tier": False},
+                     "paged": False, "tier": False, "lease": False},
           diet=True),
+    # the leader-lease plane (ISSUE 20): the serve-shaped round with the
+    # lease columns riding the packed scan carry; every OTHER entry
+    # asserts "lease": False under its pinned-off profile — the
+    # RAFT_TPU_LEASE=0 full-elision claim the ledger's bytes/lane rows
+    # corroborate
+    Entry("round.xla.lease", "lease", _round_xla_lease, compile_budget=1,
+          expect_on=_LEASE_ON, diet=True),
     Entry("round.xla.diet_paged", "diet_paged", _round_diet_paged,
           compile_budget=1,
           expect_on={"metrics": True, "chaos": False, "trace": False,
-                     "paged": True, "tier": False},
+                     "paged": True, "tier": False, "lease": False},
           diet=True),
     # the in-kernel paged megakernel (ISSUE 17): page_in/page_out fused
     # into the K=2 pallas grid over two lane tiles — elision, capture,
@@ -581,7 +620,7 @@ ENTRIES = (
     Entry("round.pallas.paged_inkernel", "paged_inkernel",
           _round_pallas_inkernel, compile_budget=1,
           expect_on={"metrics": True, "chaos": False, "trace": False,
-                     "paged": True, "tier": False}),
+                     "paged": True, "tier": False, "lease": False}),
     # the hot/cold tier's dispatch-boundary pair (tier/engine.py): the
     # evict-snapshot gather and the donating admit-restore scatter; every
     # OTHER entry above asserts "tier": False under its pinned-off

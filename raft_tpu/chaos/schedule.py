@@ -150,6 +150,24 @@ class ChaosSchedule:
         )
         return self
 
+    def lease_skew_storm(self, groups, at, bursts, duration=6, gap=8,
+                         prob=0.75, members=None):
+        """The leader-lease adversary (RAFT_TPU_LEASE): `bursts` waves of
+        heavy clock skew on EVERY member slot (leaders included — slot 0
+        alone would miss most leaders), each `duration` rounds long with
+        `gap` calm rounds between waves. The calm gaps matter as much as
+        the bursts: the lease plane must re-grant between waves so the
+        soak's `lease_skew_revocations > 0` gate proves leases were
+        REVOKED by the skew, not quietly never granted
+        (benches/lease_ab.py)."""
+        members = tuple(range(self.v)) if members is None else tuple(members)
+        for k in range(bursts):
+            self.skew(
+                groups, at + k * (duration + gap), duration, prob,
+                members=members,
+            )
+        return self
+
     def kill(self, lanes, at, down):
         """Crash explicit global lanes at `at`, restart at `at+down`
         (down=0: instant restart — volatile wipe only)."""

@@ -276,6 +276,32 @@ FABRIC_COUNTERS = (
     "fabric_summary_saturated",
 )
 
+# leader-lease counter families (RAFT_TPU_LEASE). The first four are
+# host sums of the per-lane device event counters (ops/lease.py, pulled
+# by FusedCluster.lease_stats at host sync points); the last two are pure
+# host counters incremented by the serve plane (serve/router.py) as it
+# routes reads:
+#   lease_grants           fresh leases granted (lease_left 0 -> window)
+#   lease_renewals         in-flight leases extended by a fresh ack quorum
+#   lease_revocations      conservative revocations (leadership loss,
+#                          transfer, confchange, or accumulated tick skew)
+#   lease_skew_revocations the skew-only subset of revocations — the
+#                          chaos clock-skew soak gates on this being > 0
+#                          (leases measurably revoked, not never granted)
+#   lease_reads_served     batched GETs answered from the lease fast path
+#                          (1 bundle round, no ReadIndex quorum touch)
+#   lease_reads_fallback   lease-routed GETs bounced back to the ReadIndex
+#                          path (lease lapsed/epoch moved between snapshot
+#                          and serve)
+LEASE_COUNTERS = (
+    "lease_grants",
+    "lease_renewals",
+    "lease_revocations",
+    "lease_skew_revocations",
+    "lease_reads_served",
+    "lease_reads_fallback",
+)
+
 
 class HostCounters:
     """Plain host-side counter bag speaking the snapshot schema — the
@@ -583,3 +609,19 @@ def record_fabric_stats(stats: dict) -> None:
     """Mirror one fabric driver counter snapshot onto the host plane."""
     for name in FABRIC_COUNTERS:
         FABRIC_EVENTS.set(name, int(stats.get(name, 0)))
+
+
+# process-wide mirror of the lease plane's counters. The device-derived
+# four are set (levels) by record_lease_stats; the serve-plane pair is
+# incremented in place by serve/router.py — so the mirror only sets the
+# keys present in the stats dict, never zeroing the host-owned halves
+LEASE_EVENTS = HostCounters()
+
+
+def record_lease_stats(stats: dict) -> None:
+    """Mirror one FusedCluster.lease_stats() snapshot onto the host
+    plane (device-derived counters only — lease_reads_served/_fallback
+    are owned and incremented by the serve plane directly)."""
+    for name in LEASE_COUNTERS:
+        if name in stats:
+            LEASE_EVENTS.set(name, int(stats[name]))

@@ -67,6 +67,7 @@ from raft_tpu import config
 from raft_tpu.chaos import device as chmod
 from raft_tpu.metrics import device as metmod
 from raft_tpu.trace import device as trmod
+from raft_tpu.ops import lease as lsmod
 from raft_tpu.ops import log as lg
 from raft_tpu.ops import onehot as ohm
 from raft_tpu.ops import paged as pgmod
@@ -1465,6 +1466,38 @@ def fused_round(
             state.snap_index, state.applied - jnp.int32(auto_compact_lag)
         )
         state = lg.compact(state, target, lg.term_at(state, target))
+
+    # ---- leader-lease maintenance (RAFT_TPU_LEASE, ops/lease.py) ----
+    # Runs LAST, against the round's final role/transfer/confchange state.
+    # The renewal evidence is a joint quorum of THIS round's append +
+    # heartbeat acks (fresh, unlike the cumulative pr_recent_active): a
+    # lane that only just won leadership has no ack cells yet and cannot
+    # grant itself a lease on its election round. Purely observational —
+    # nothing here feeds back into a raft decision, so lease on/off walks
+    # a bit-identical raft trajectory.
+    if state.lease_left is not None:
+        ack_now = ar_all | hr_cell | is_self
+        ack_votes = jnp.where(
+            ack_now, jnp.int32(VoteState.GRANTED), jnp.int32(VoteState.PENDING)
+        )
+        ack_quorum = (
+            qr.joint_vote(ack_votes, state.voters_in, state.voters_out)
+            == VoteResult.VOTE_WON
+        )
+        skipped = jnp.zeros((n,), BOOL)
+        if do_tick and tick_mask is not None:
+            skipped = ~tick_mask
+        state = dataclasses.replace(
+            state,
+            **lsmod.lease_round(
+                state,
+                is_leader=state.state == StateType.LEADER,
+                ack_quorum=ack_quorum,
+                skipped_tick=skipped,
+                margin=lsmod.lease_margin(),
+            ),
+        )
+
     if metrics is None:
         return state, out.fab
     # ---- end-of-round measurement (one fused reduction pass) ----
@@ -2809,6 +2842,11 @@ class FusedCluster:
             # device sync at all)
             for k, val in self.tier.stats(mirror=True).items():
                 snap["counters"][k] = val
+        if self.state.lease_left is not None:
+            # lease grant/renew/revoke totals ride the same snapshot and
+            # mirror onto metrics/host.py LEASE_COUNTERS
+            for k, val in (self.lease_stats() or {}).items():
+                snap["counters"][k] = val
         return snap
 
     def paged_stats(self) -> dict | None:
@@ -2824,6 +2862,32 @@ class FusedCluster:
 
         stats = pgmod.paged_stats(self.paged)
         record_paged_stats(stats)
+        return stats
+
+    def lease_stats(self) -> dict | None:
+        """Host sums of the per-lane lease event counters (ops/lease.py;
+        None when RAFT_TPU_LEASE=0). Mirrors onto the metrics host plane
+        (metrics/host.py LEASE_COUNTERS — the serve-plane reads_served /
+        reads_fallback halves are pure host counters incremented by
+        serve/router.py). Forces a device sync — call at host sync points
+        only, like paged_stats."""
+        if self.state.lease_left is None:
+            return None
+        import numpy as np
+
+        from raft_tpu.metrics.host import record_lease_stats
+
+        # counters are unpacked int32 even under diet-v2 (unbounded
+        # monotone sums must not ride a uint16 cast), so sum directly
+        leaves = [getattr(self.state, f) for f in lsmod.LEASE_COUNTER_FIELDS]
+        for x in leaves:
+            if hasattr(x, "copy_to_host_async"):
+                x.copy_to_host_async()
+        stats = {
+            f: int(np.asarray(x).sum())
+            for f, x in zip(lsmod.LEASE_COUNTER_FIELDS, leaves)
+        }
+        record_lease_stats(stats)
         return stats
 
     def leader_lanes(self):

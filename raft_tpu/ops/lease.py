@@ -1,0 +1,178 @@
+"""Device half of the leader-lease plane (RAFT_TPU_LEASE, ISSUE 20).
+
+Every linearizable GET today pays the full ReadIndex handshake — a
+propose→ctx'd-heartbeat→ack-quorum→release pipeline that costs ≥4 device
+rounds even on a stable leader. The standard cure (PAPERS.md, the
+Paxos/Raft-parallels line of work) is a leader lease renewed implicitly by
+the quorum traffic the leader already generates: while the lease holds, the
+leader's commit index IS a linearizable read index, no quorum touch needed.
+
+Rounds ARE ticks in this engine, so the lease clock is exact modulo the
+chaos plane's injected tick skew — which is precisely what the margin and
+the skew-revocation below defend against. The carry holds four things per
+lane (optional RaftState fields, None and therefore jaxpr-absent when the
+plane is off):
+
+  lease_left   [N] countdown in rounds (0 = no lease). A COUNTDOWN, not an
+               absolute round: the carry has no round counter, and a
+               countdown needs no rebase under diet-v2 (packs as uint16 —
+               bounded by election_tick <= 2^14).
+  lease_epoch  [N] grant generation, wraps at 2^15 so the uint16 diet cast
+               is exact by construction. The serve plane snapshots it when
+               it routes a read batch to lease service and refuses to serve
+               against a different generation.
+  lease_skew   [N] ticks the lane's clock was observed skipping (chaos
+               tick_mask false on a ticking round) while it held a lease.
+               NOT reset by renewal — only by grant/revocation — so a
+               probabilistic skew storm accumulates to the margin instead
+               of being quietly forgiven every heartbeat quorum.
+  lease_grants / lease_renewals / lease_revocations /
+  lease_skew_revocations
+               [N] monotone event counters (per-lane because the pallas
+               engine tiles every carry leaf over the lane axis — a scalar
+               cannot ride the megakernel carry). The host sums them at
+               metrics_snapshot (lease_stats), mirroring the paged plane.
+
+Safety shape: a lease is granted/renewed only when the lane is leader,
+runs with check_quorum (the follower half of the argument: an in-lease
+follower rejects non-TRANSFER votes, ops/fused.py), and a FRESH quorum of
+this round's append/heartbeat acks landed — pr_recent_active is cumulative
+over an election timeout and therefore too stale to bound follower clocks.
+The window is election_tick - 1 - margin: the acks prove the followers
+heard this leader no earlier than the previous round, so their election
+timers cannot fire before round + election_tick - 1; the margin absorbs
+the serve plane's bundle latency. Conservative revocations: leadership
+loss, a pending leadership transfer (TRANSFER campaigns bypass the
+follower in-lease vote rejection), an active confchange (the voter set the
+quorum was computed over may no longer be the voter set), and accumulated
+tick skew beyond the margin. The plane is purely observational — it never
+feeds back into a raft decision, so lease on/off walks a bit-identical
+raft trajectory (benches/lease_ab.py pins the KV digests together).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.config import env_flag, env_int
+from raft_tpu.testing.counters import CallCounter
+
+I32 = jnp.int32
+
+# per-lane event-counter fields, in the order LEASE_STATE_FIELDS lists the
+# whole column set (state.py init/wipe and the host fold iterate these)
+LEASE_COUNTER_FIELDS = (
+    "lease_grants",
+    "lease_renewals",
+    "lease_revocations",
+    "lease_skew_revocations",
+)
+LEASE_STATE_FIELDS = (
+    "lease_left", "lease_epoch", "lease_skew",
+) + LEASE_COUNTER_FIELDS
+
+# lease_epoch wraps here so the diet-v2 uint16 cast is exact by
+# construction (no clamp, no ERR_DIET_OVERFLOW); the serve plane only ever
+# compares epochs across a couple of rounds, so wrap collisions are
+# unreachable in practice
+EPOCH_WRAP = 1 << 15
+
+_CALLS = CallCounter("lease")
+kernel_calls = _CALLS.calls
+
+
+def lease_enabled() -> bool:
+    """Read RAFT_TPU_LEASE lazily (default OFF); like every other plane
+    the value is baked into each cluster's carry at construction — with
+    the knob off the lease fields are None and contribute nothing to any
+    jaxpr."""
+    return env_flag("RAFT_TPU_LEASE", default=False)
+
+
+def lease_margin() -> int:
+    """RAFT_TPU_LEASE_MARGIN: rounds shaved off the lease window AND the
+    accumulated-tick-skew budget before a conservative revocation.
+    Default 1 — enough to absorb the serve plane's one-round bundle lag;
+    raise it when injecting heavier clock skew than the chaos soak's."""
+    return max(env_int("RAFT_TPU_LEASE_MARGIN", default=1), 0)
+
+
+def lease_round(
+    state,
+    *,
+    is_leader,
+    ack_quorum,
+    skipped_tick,
+    margin: int,
+):
+    """One round of lease maintenance. Called by the fused round AFTER the
+    round's role/transfer/confchange transitions are final, guarded by
+    `state.lease_left is not None` (the plane's elision guard).
+
+    Args:
+      state: post-transition RaftState (lease fields from the PREVIOUS
+        round — this function produces their successors).
+      is_leader: [N] bool, leadership after this round's transitions.
+      ack_quorum: [N] bool, a joint-config quorum of THIS round's
+        append/heartbeat acks (self included) landed at the lane.
+      skipped_tick: [N] bool, the lane's clock skipped this round's tick
+        (chaos tick_mask) — False everywhere when chaos is off or the
+        round is not a ticking round.
+      margin: static python int (lease_margin()).
+
+    Returns dict of the seven successor lease columns.
+    """
+    _CALLS.bump()
+    left = state.lease_left.astype(I32)
+    epoch = state.lease_epoch.astype(I32)
+    skew = state.lease_skew.astype(I32)
+    held = left > 0
+
+    # the natural expiry: one round elapsed
+    left = jnp.maximum(left - 1, 0)
+
+    # conservative revocation conditions, evaluated on the post-round
+    # state: leadership lost (covers term bumps — a bumped lane is a
+    # follower), transfer pending, confchange in flight (joint config or
+    # an unapplied conf entry)
+    cc_active = (state.pending_conf_index > state.applied) | state.voters_out.any(
+        axis=-1
+    )
+    unsafe = (~is_leader) | (state.lead_transferee != 0) | cc_active
+
+    # accumulated clock skew while holding a lease; revoke past the margin
+    skew = jnp.where(held & skipped_tick, skew + 1, skew)
+    skew_revoke = held & (skew > margin)
+    revoke = held & (unsafe | skew_revoke)
+
+    # grant/renewal: leader under check_quorum with a fresh ack quorum and
+    # nothing unsafe in flight. Window = election_tick - 1 - margin: the
+    # acks bound every voter's election timer at >= election_tick - 1
+    # rounds out, minus the margin for serve-plane latency and skew.
+    window = jnp.maximum(state.cfg.election_tick.astype(I32) - 1 - margin, 1)
+    renew = (
+        is_leader & state.cfg.check_quorum & ack_quorum & ~unsafe & ~skew_revoke
+    )
+    granted = renew & ~held
+    renewed = renew & held
+
+    left = jnp.where(revoke, 0, jnp.where(renew, window, left))
+    epoch = jnp.where(granted, (epoch + 1) % EPOCH_WRAP, epoch)
+    # skew resets on grant and on revocation — never on renewal (see
+    # module doc: a storm must be able to accumulate to the margin)
+    skew = jnp.where(granted | revoke, 0, skew)
+
+    def count(x, ev):
+        return x.astype(I32) + ev.astype(I32)
+
+    return dict(
+        lease_left=left,
+        lease_epoch=epoch,
+        lease_skew=skew,
+        lease_grants=count(state.lease_grants, granted),
+        lease_renewals=count(state.lease_renewals, renewed),
+        lease_revocations=count(state.lease_revocations, revoke),
+        lease_skew_revocations=count(
+            state.lease_skew_revocations, held & skew_revoke & ~unsafe
+        ),
+    )

@@ -133,6 +133,12 @@ class DeltaBundle(NamedTuple):
     # (FusedCluster.drain_read_states) — the serving frontend's wake-up
     # signal for the linearizable-read path (raft_tpu/serve/router.py)
     rs_count: jax.Array  # [N] i32
+    # leader-lease columns (RAFT_TPU_LEASE, ops/lease.py) — None when the
+    # lease plane is off, so the bundle's pytree/bytes are unchanged. Full
+    # [N] columns, NOT deltas: the serve plane's read fast path indexes
+    # them directly at the leader lane on every block, no new host sync
+    lease_ok: jax.Array | None = None  # [N] bool — leader holds a live lease
+    lease_epoch: jax.Array | None = None  # [N] i32 grant generation
 
 
 def compact_mask(ready: jax.Array):
@@ -233,11 +239,22 @@ def delta_bundle(state, prev: PrevCursors) -> DeltaBundle:
         | (rs_count > 0)
     )
     active, count = compact_mask(changed)
+    lease_ok = lease_epoch = None
+    if getattr(state, "lease_left", None) is not None:
+        # lease validity rides the bundle the serve plane already pulls:
+        # leader + countdown live THIS block. Observational only — never
+        # part of `changed` (the sink fires every block with the full
+        # columns, so the serve plane sees lease state without a lane
+        # having to go active for it)
+        from raft_tpu.types import StateType
+
+        lease_ok = (st == int(StateType.LEADER)) & (i32(state.lease_left) > 0)
+        lease_epoch = i32(state.lease_epoch)
     return DeltaBundle(
         changed=changed, active=active, count=count,
         term=term, lead=lead, state=st,
         committed=committed, applied=applied, last=last,
-        rs_count=rs_count,
+        rs_count=rs_count, lease_ok=lease_ok, lease_epoch=lease_epoch,
     )
 
 

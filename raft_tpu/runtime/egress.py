@@ -62,17 +62,26 @@ class EgressView(NamedTuple):
     applied: object
     last: object
     rs_count: object
+    # lease plane columns (RAFT_TPU_LEASE) — None when the plane is off,
+    # so the view's pytree shape (and the jit cache key) is unchanged
+    lease_left: object = None
+    lease_epoch: object = None
 
 
 def shard_egress_view(state, lo: int, hi: int) -> EgressView:
     """Slice a (possibly diet-packed) state's externally visible cursor
     columns to one shard's lane window; slices are lazy device views, so
     only the shard's rows ride the delta dispatch and D2H copy."""
+    lease_left = lease_epoch = None
+    if getattr(state, "lease_left", None) is not None:
+        lease_left = state.lease_left[lo:hi]
+        lease_epoch = state.lease_epoch[lo:hi]
     return EgressView(
         term=state.term[lo:hi], lead=state.lead[lo:hi],
         state=state.state[lo:hi], committed=state.committed[lo:hi],
         applied=state.applied[lo:hi], last=state.last[lo:hi],
         rs_count=state.rs_count[lo:hi],
+        lease_left=lease_left, lease_epoch=lease_epoch,
     )
 
 
@@ -98,8 +107,10 @@ class EgressStream:
         dev = ready_mask.compute_delta(state, self._prev)
         for a in dev:
             # start the D2H transfer now; it overlaps the next block's
-            # device execution (JAX async dispatch + async host copy)
-            a.copy_to_host_async()
+            # device execution (JAX async dispatch + async host copy).
+            # The lease columns are None when RAFT_TPU_LEASE=0
+            if a is not None:
+                a.copy_to_host_async()
         self._pending = (self.blocks, dev)
         self.blocks += 1
 
@@ -111,13 +122,15 @@ class EgressStream:
             return
         block_id, dev = self._pending
         self._pending = None
-        bundle = ready_mask.DeltaBundle(*(np.asarray(a) for a in dev))
+        bundle = ready_mask.DeltaBundle(
+            *(None if a is None else np.asarray(a) for a in dev)
+        )
         self._prev = ready_mask.PrevCursors(
             term=bundle.term, lead=bundle.lead, state=bundle.state,
             committed=bundle.committed, applied=bundle.applied,
             last=bundle.last,
         )
-        self.bytes += sum(a.nbytes for a in bundle)
+        self.bytes += sum(a.nbytes for a in bundle if a is not None)
         self.lanes_scanned += int(bundle.changed.shape[0])
         self.lanes_active += int(bundle.count)
         if self.sink is not None:
@@ -203,6 +216,11 @@ def merge_delta_bundles(bundles: list) -> "ready_mask.DeltaBundle":
         for f in ("term", "lead", "state", "committed", "applied", "last",
                   "rs_count")
     }
+    if bundles[0].lease_ok is not None:
+        for f in ("lease_ok", "lease_epoch"):
+            cols[f] = np.concatenate(
+                [np.asarray(getattr(b, f)) for b in bundles]
+            )
     return ready_mask.DeltaBundle(
         changed=changed, active=active, count=np.int32(cnt), **cols
     )

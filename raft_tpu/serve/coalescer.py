@@ -135,6 +135,11 @@ class ProposalCoalescer:
         self._batches_of: dict[int, set] = {}
         self._next_ctx = 1
         self.on_read_retry = None  # optional hook (ServeLoop -> metrics)
+        # lease fast-path hook (ServeLoop -> router.route_lease_reads,
+        # wired only when the device lease plane is on): offered a group's
+        # NEW waiting reads at build time; True = the router took them
+        # (no read_ctx injection), False = ReadIndex path as always
+        self.lease_route = None
 
     def _pending(self, group: int) -> deque:
         q = self.pending.get(group)
@@ -254,6 +259,15 @@ class ProposalCoalescer:
                 prop_n[view.leader_lane] = m
                 prop_bytes[view.leader_lane] = self.cmd_bytes
                 injections.append((view, batch))
+            if (
+                self.lease_route is not None
+                and self.read_wait.get(g)
+                and self.lease_route(view, self.read_wait[g])
+            ):
+                # the router took this group's new reads onto the lease
+                # fast path — already-open ReadIndex batches still retry
+                # through _pick_read_ctx below
+                self.read_wait.pop(g)
             ctx = self._pick_read_ctx(g, view, round_id)
             if ctx:
                 if prop_n is None:

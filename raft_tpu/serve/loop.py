@@ -77,16 +77,26 @@ class ServeMetrics:
     def __init__(self):
         self.counters = HostCounters()
         self.hist = HostHistogram()
+        # read-notify latency, split from write-notify: one histogram hid
+        # the lease plane's read win behind the proposal pipeline's 2-3
+        # round floor (benches/serve_bench.py reports both percentiles)
+        self.read_hist = HostHistogram()
         self.rounds = 0
 
     def snapshot(self) -> dict:
         # the stamped hist_name lets merge_snapshots namespace this family
         # away from the device plane's commit-latency histogram, so the
-        # registry below can merge serve + step-stats sources safely
+        # registry below can merge serve + step-stats sources safely; the
+        # named "hists" map carries the write/read split (merge_snapshots
+        # setdefault keeps the legacy "hist" from double counting)
         return {
             "counters": dict(self.counters.counts),
             "hist": self.hist.snapshot(),
             "hist_name": "notify_latency_rounds",
+            "hists": {
+                "notify_latency_rounds": self.hist.snapshot(),
+                "read_notify_latency_rounds": self.read_hist.snapshot(),
+            },
             "rounds": int(self.rounds),
         }
 
@@ -197,6 +207,12 @@ class ServeLoop:
             self.coalescer,
             compact_lag=self.compact_lag,
         )
+        # leader-lease read fast path (RAFT_TPU_LEASE): wired only when
+        # the cluster's carry actually holds the lease columns — the
+        # coalescer then offers each group's new waiting reads to the
+        # router's lease router before opening a ReadIndex batch
+        if getattr(base.state, "lease_left", None) is not None:
+            self.coalescer.lease_route = self.router.route_lease_reads
         # one egress stream per resident block; the sink closure pins the
         # SCHEDULER block index (the stream's own push counter is a
         # sequence number, not lane addressing)

@@ -34,6 +34,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from raft_tpu.metrics.host import LEASE_EVENTS
+from raft_tpu.serve.coalescer import ReadBatch
 from raft_tpu.serve.kv import KVStore
 from raft_tpu.types import StateType
 
@@ -137,6 +139,22 @@ class CompletionRouter:
         self.on_group_activity = None
         self.needs_resync: set[int] = set()
         self.round = 0  # the serving loop's clock, stamped before each run
+        # -- leader-lease read fast path (RAFT_TPU_LEASE) ---------------
+        # latest bundle's lease columns per scheduler block: full [N]
+        # (state, term, committed, lease_ok, lease_epoch) numpy views —
+        # populated only when the bundles carry lease columns, i.e. the
+        # device lease plane is compiled in. No extra host sync: these are
+        # the same resolved arrays on_bundle already holds.
+        self._lease_cols: dict[int, tuple] = {}
+        # gid -> [(tickets, term, epoch), ...]: read batches the coalescer
+        # routed past ReadIndex on a lease-valid snapshot; served (or
+        # bounced back) against the NEXT bundle's columns
+        self.lease_pending: dict[int, list] = {}
+        # narration feed for trace/assemble.py explain(): (round, gid,
+        # event, n) tuples, kept only under the flight recorder
+        from raft_tpu.trace.device import tracelog_enabled as _tl
+
+        self.lease_log: list | None = [] if _tl() else None
         # apply-ordered (group, Command, tick) log for the scalar twin
         self.applied_log: list = []
         self._served_batches: list = []  # released batches awaiting watermark
@@ -168,6 +186,7 @@ class CompletionRouter:
         mid-flight would orphan its attribution)."""
         out = {g for g, d in self.cmd_log.items() if d}
         out.update(b.group for b, _ in self._served_batches)
+        out.update(g for g, pend in self.lease_pending.items() if pend)
         return out
 
     # -- the egress sink --------------------------------------------------
@@ -182,6 +201,13 @@ class CompletionRouter:
         state = np.asarray(bundle.state)
         term = np.asarray(bundle.term)
         committed = np.asarray(bundle.committed)
+        if getattr(bundle, "lease_ok", None) is not None:
+            # refresh the block's lease snapshot (full columns — the fast
+            # path must see lease state even for lanes that went quiet)
+            self._lease_cols[block_id] = (
+                state, term, committed,
+                np.asarray(bundle.lease_ok), np.asarray(bundle.lease_epoch),
+            )
         for j in range(count):
             lane_local = int(active[j])
             glane = lo + lane_local
@@ -206,6 +232,11 @@ class CompletionRouter:
             c = int(committed[lane_local])
             if c > view.watermark:
                 self._advance(view, c)
+        if self.lease_pending:
+            # AFTER the active sweep: deposed leaders already detached and
+            # watermarks already cover this bundle's committed cursors, so
+            # a lease-served batch resolves in this very call
+            self._serve_lease_pending(block_id, lo)
         if self._served_batches:
             self._serve_ready_batches()
 
@@ -237,6 +268,85 @@ class CompletionRouter:
                 t.group, t.submit_round, t.inject_round,
                 t.commit_round, t.notify_round,
             ))
+
+    # -- the lease read fast path (RAFT_TPU_LEASE) ------------------------
+
+    def route_lease_reads(self, view, tickets) -> bool:
+        """Coalescer hook, called at build time for a group with NEW
+        waiting reads: when the latest bundle shows the group's leader
+        holding a live lease at the view's attached term, take the
+        tickets onto the lease fast path — no read_ctx injection, no
+        quorum touch — snapshotting (term, epoch). The snapshot is
+        re-validated against the NEXT bundle before anything serves, so
+        a revocation (or a revoke+regrant, which moves the epoch) in the
+        gap bounces the batch to the ReadIndex path instead of serving
+        stale. Returns False to leave the tickets on the ReadIndex path."""
+        glane = view.leader_lane
+        if glane < 0:
+            return False
+        cols = self._lease_cols.get(glane // self.lanes_per_block)
+        if cols is None:
+            return False
+        state, term, _committed, ok, epoch = cols
+        local = glane % self.lanes_per_block
+        if (
+            int(state[local]) != _LEADER
+            or int(term[local]) != view.term
+            or not bool(ok[local])
+        ):
+            return False
+        self.lease_pending.setdefault(view.gid, []).append(
+            (list(tickets), view.term, int(epoch[local]))
+        )
+        return True
+
+    def _serve_lease_pending(self, block_id: int, lo: int) -> None:
+        """Resolve lease-routed batches against this block's fresh
+        columns: the leader must still be THE leader at the snapshotted
+        term with a live lease of the SAME epoch — then the leader's
+        commit index IS a linearizable read index (every write notified
+        before the read was routed is <= it), and the batch rides the
+        ordinary watermark machinery. Any mismatch falls back to
+        ReadIndex; reads are idempotent, so the fallback only costs the
+        round-trip the fast path tried to skip."""
+        cols = self._lease_cols.get(block_id)
+        hi = lo + self.lanes_per_block
+        for gid in list(self.lease_pending.keys()):
+            view = self.views[gid]
+            glane = view.leader_lane
+            if glane >= 0 and not (lo <= glane < hi):
+                continue  # another block's bundle owns this leader lane
+            entries = self.lease_pending.pop(gid, None) or ()
+            for tickets, term0, epoch0 in entries:
+                index = None
+                if glane >= 0 and cols is not None:
+                    state, term, committed, ok, epoch = cols
+                    local = glane - lo
+                    if (
+                        int(state[local]) == _LEADER
+                        and view.term == term0 == int(term[local])
+                        and bool(ok[local])
+                        and int(epoch[local]) == epoch0
+                    ):
+                        index = int(committed[local])
+                if index is None:
+                    # lease lapsed / epoch moved / leadership changed in
+                    # the snapshot->serve gap: back to the wait queue (the
+                    # next build re-batches through ReadIndex or a fresh
+                    # lease snapshot)
+                    self.coalescer._read_wait(gid).extend(tickets)
+                    self._count_lease("lease_reads_fallback", gid, len(tickets))
+                    continue
+                self._served_batches.append(
+                    (ReadBatch(0, gid, tickets, self.round), index)
+                )
+                self._count_lease("lease_reads_served", gid, len(tickets))
+
+    def _count_lease(self, name: str, gid: int, n: int) -> None:
+        self.metrics.counters.inc(name, n)
+        LEASE_EVENTS.inc(name, n)  # the process-wide Prometheus mirror
+        if self.lease_log is not None:
+            self.lease_log.append((self.round, gid, name, n))
 
     # -- the linearizable read path --------------------------------------
 
@@ -277,6 +387,7 @@ class CompletionRouter:
         rt.done = True
         self.admission.release()
         self.metrics.counters.inc("reads_served")
+        self.metrics.read_hist.observe(self.round - rt.submit_round)
 
     @property
     def reads_waiting_apply(self) -> int:
@@ -323,6 +434,11 @@ class CompletionRouter:
             self.coalescer.requeue_front(gid, survivors)
             for rt in self.coalescer.drop_group_reads(gid):
                 self.coalescer._read_wait(gid).append(rt)
+            # lease-routed batches of a resynced group cancel the same
+            # way: their (term, epoch) snapshot is void by definition
+            for tickets, _t, _e in self.lease_pending.pop(gid, ()):
+                self.coalescer._read_wait(gid).extend(tickets)
+                self._count_lease("lease_reads_fallback", gid, len(tickets))
             if was_attached:  # the initial bootstrap attach is not a resync
                 self.metrics.counters.inc("epoch_resyncs")
             self.needs_resync.discard(gid)

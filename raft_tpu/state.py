@@ -176,6 +176,20 @@ class RaftState:
 
     cfg: LaneConfig
 
+    # --- leader-lease plane (RAFT_TPU_LEASE, ops/lease.py) ---
+    # Optional columns: None (and therefore absent from every jaxpr and
+    # every carry byte count) unless the lease plane is enabled at
+    # construction. lease_left is a COUNTDOWN in rounds, not an absolute
+    # round — the carry has no round counter and a countdown needs no
+    # rebase under diet-v2 (packs as uint16, bounded by election_tick).
+    lease_left: Any = None  # [N] rounds of lease remaining (0 = none)
+    lease_epoch: Any = None  # [N] grant generation (wraps at 2^15)
+    lease_skew: Any = None  # [N] skipped ticks observed while leased
+    lease_grants: Any = None  # [N] monotone event counters (host sums)
+    lease_renewals: Any = None  # [N]
+    lease_revocations: Any = None  # [N]
+    lease_skew_revocations: Any = None  # [N]
+
     # Convenience views ----------------------------------------------------
     @property
     def first_index(self):
@@ -371,6 +385,14 @@ def pack_state(state: "RaftState") -> "RaftState":
         narrow(f, jnp.int16)
     for f in PACK_BITSET:
         upd[f] = pack_bits(getattr(state, f), bd)
+    if state.lease_left is not None:
+        # optional lease-plane columns (RAFT_TPU_LEASE, ops/lease.py):
+        # lease_left/lease_skew are bounded by election_tick (<= 2^14,
+        # validated) and lease_epoch wraps at 2^15 by construction, so
+        # uint16 is exact; the monotone event counters are unbounded and
+        # stay int32
+        for f in ("lease_left", "lease_epoch", "lease_skew"):
+            narrow(f, jnp.uint16)
     upd["error_bits"] = state.error_bits | jnp.where(
         ovf, jnp.int32(ERR_DIET_OVERFLOW), jnp.int32(0)
     )
@@ -396,6 +418,9 @@ def unpack_state(state: "RaftState") -> "RaftState":
     }
     for f in PACK_BITSET:
         upd[f] = unpack_bits(getattr(state, f), v)
+    if state.lease_left is not None:
+        for f in ("lease_left", "lease_epoch", "lease_skew"):
+            upd[f] = getattr(state, f).astype(I32)
     upd["cfg"] = dataclasses.replace(
         state.cfg,
         **{k: getattr(state.cfg, k).astype(I32) for k in CFG_PACK},
@@ -492,8 +517,20 @@ def wipe_volatile(state: RaftState, mask) -> RaftState:
         ),
         state.randomized_election_timeout,
     )
+    lease_upd = {}
+    if state.lease_left is not None:
+        # a crashed lane's lease is gone; lease_epoch deliberately
+        # SURVIVES the wipe (a reset epoch could collide with a pre-crash
+        # serve-plane snapshot of the same value), and the monotone event
+        # counters survive like error_bits — they are the metrics oracle,
+        # not raft state
+        lease_upd = dict(
+            lease_left=jnp.where(m, 0, state.lease_left),
+            lease_skew=jnp.where(m, 0, state.lease_skew),
+        )
     return dataclasses.replace(
         state,
+        **lease_upd,
         state=jnp.where(m, int(StateType.FOLLOWER), state.state),
         lead=jnp.where(m, 0, state.lead),
         lead_transferee=jnp.where(m, 0, state.lead_transferee),
@@ -596,7 +633,18 @@ def init_state(
     cfg = cfg if cfg is not None else make_lane_config(shape)
     rand_to = draw_timeout(jnp.asarray(rng), cfg.election_tick)
 
+    # leader-lease plane (RAFT_TPU_LEASE, ops/lease.py): like every other
+    # optional plane the knob is read at construction; off leaves the
+    # fields None — absent from every jaxpr and every carry byte. Each
+    # column gets its OWN zero buffer (donation, see zeros_n above).
+    from raft_tpu.ops.lease import LEASE_STATE_FIELDS, lease_enabled
+
+    lease_cols = (
+        {f: zeros_n() for f in LEASE_STATE_FIELDS} if lease_enabled() else {}
+    )
+
     return RaftState(
+        **lease_cols,
         id=jnp.asarray(ids),
         term=zeros_n(),
         vote=zeros_n(),

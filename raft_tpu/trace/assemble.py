@@ -43,6 +43,8 @@ from raft_tpu.trace.device import (
     COMMIT_STALL,
     KIND_NAMES,
     LEADER_ELECTED,
+    LEASE_GRANTED,
+    LEASE_REVOKED,
     SNAPSHOT_INSTALL,
     TERM_BUMP,
 )
@@ -175,6 +177,7 @@ def explain(
     events=None,
     lifecycle=None,
     spans=None,
+    lease=None,
     v: int = 1,
 ) -> list[str]:
     """Round-ordered, human-readable timeline of one raft group: its
@@ -182,7 +185,10 @@ def explain(
     when a host SpanRecorder (or its span list) is passed — the group's
     tier transitions (tier_evict / tier_admit, RAFT_TPU_TIER) and its
     cross-host fabric hops (fabric_tx / fabric_rx, RAFT_TPU_FABRIC,
-    labeled by spanning group). Under the
+    labeled by spanning group). `lease` takes the router's lease_log
+    (serve-plane lease routing: reads served off the leader lease vs
+    bounced to ReadIndex — the device-side grant/revoke edges already
+    narrate through `events` as lease_granted/lease_revoked). Under the
     tier, `group` is the LOGICAL id for lifecycle/span lines; device
     event lanes are physical and follow the group's current slot."""
     lines: list[tuple[int, int, str]] = []  # (round, order, text)
@@ -265,6 +271,19 @@ def explain(
                 rnd, 2,
                 f"r{rnd:05d}  {verb}" + (f" ({extra})" if extra else ""),
             ))
+    if lease is not None:
+        for rnd, g, event, n in lease:
+            if int(g) != group:
+                continue
+            rnd, n = int(rnd), int(n)
+            verb = (
+                f"lease: served {n} read(s) from the leader lease "
+                "(no ReadIndex round-trip)"
+                if event == "lease_reads_served"
+                else f"lease: {n} read(s) fell back to ReadIndex "
+                "(lease lapsed or epoch moved)"
+            )
+            lines.append((rnd, 4, f"r{rnd:05d}  {verb}"))
     lines.sort(key=lambda t: (t[0], t[1]))
     return [s for _, _, s in lines]
 
@@ -275,6 +294,8 @@ _ARG_LABEL = {
     SNAPSHOT_INSTALL: "snap_index",
     COMMIT_STALL: "committed",
     CHAOS_FAULT: "crash+2*restart",
+    LEASE_GRANTED: "epoch",
+    LEASE_REVOKED: "epoch",
 }
 
 

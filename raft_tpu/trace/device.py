@@ -59,8 +59,10 @@ SNAPSHOT_INSTALL = 4  # arg = installed snapshot index
 CONFCHANGE_APPLY = 5  # arg = conf-change entry index applied
 COMMIT_STALL = 6  # arg = committed index the leader is stuck at
 CHAOS_FAULT = 7  # arg = 1 crash, 2 restart, 3 both edges same round
+LEASE_GRANTED = 8  # arg = lease epoch of the fresh grant (RAFT_TPU_LEASE)
+LEASE_REVOKED = 9  # arg = lease epoch that was revoked
 
-N_KINDS = 8
+N_KINDS = 10
 KIND_NAMES = (
     "leader_elected",
     "leadership_lost",
@@ -70,6 +72,8 @@ KIND_NAMES = (
     "confchange_apply",
     "commit_stall",
     "chaos_fault",
+    "lease_granted",
+    "lease_revoked",
 )
 
 # a leader blocked (last > committed) with no commit progress for this many
@@ -200,6 +204,22 @@ def record_round(
     else:
         masks[CHAOS_FAULT] = jnp.zeros((n,), jnp.bool_)
         args[CHAOS_FAULT] = jnp.zeros((n,), I32)
+
+    if getattr(st1, "lease_left", None) is not None:
+        # lease plane transitions (RAFT_TPU_LEASE): the countdown crossing
+        # zero<->nonzero IS the grant/revoke edge — renewals (nonzero ->
+        # nonzero) are deliberately not events (one per heartbeat quorum
+        # would drown the ring; the metrics plane counts them instead)
+        held0 = st0.lease_left > 0
+        held1 = st1.lease_left > 0
+        masks[LEASE_GRANTED] = held1 & ~held0
+        args[LEASE_GRANTED] = st1.lease_epoch
+        masks[LEASE_REVOKED] = held0 & ~held1
+        args[LEASE_REVOKED] = st1.lease_epoch
+    else:
+        zero = jnp.zeros((n,), jnp.bool_)
+        masks[LEASE_GRANTED] = masks[LEASE_REVOKED] = zero
+        args[LEASE_GRANTED] = args[LEASE_REVOKED] = jnp.zeros((n,), I32)
 
     ev_mask = jnp.stack(masks, axis=1)  # [N, K] lane-major flatten below
     ev_arg = jnp.stack(args, axis=1)

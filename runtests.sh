@@ -92,7 +92,8 @@ smokes() {
     && run_bench benches/multichip_ab.py --smoke \
     && run_bench benches/paged_ab.py --smoke \
     && run_bench benches/tier_ab.py --smoke \
-    && run_bench benches/fabric_ab.py --smoke
+    && run_bench benches/fabric_ab.py --smoke \
+    && run_bench benches/lease_ab.py --smoke
 }
 
 if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
@@ -169,6 +170,13 @@ if [ $# -eq 0 ] || [ "$*" = "tests/" ]; then
     # the mid-election/mid-confchange eviction chaos soak, and the 1M
     # logical-group Zipfian serve acceptance demo
     run_chunk tests/test_tier.py
+    # the leader-lease suite gets its own process: lease-on carries are
+    # distinct jit signatures per engine (7 extra columns), and the suite
+    # mixes fused clusters, ServeLoops, a blocked cluster, and one
+    # interpreted pallas tile twin; the minutes-long skew/confchange
+    # soaks and the blocked/diet twins are slow-marked and excluded
+    # here like everywhere else
+    run_chunk tests/test_lease.py -m "not slow"
     # the cross-host fabric suite gets its own process: it spawns real
     # per-host engine processes (mp spawn children each compile the fused
     # program) for the digest-parity and failover oracles, plus the

@@ -1,0 +1,573 @@
+"""Device-side leader leases (RAFT_TPU_LEASE, ops/lease.py, ISSUE 20).
+
+Device plane: elision by default (no lease op in any jaxpr, no carry
+leaves, flat CallCounter), the grant/renew predicate (leader + fresh ack
+quorum UNDER check_quorum — a default-config cluster must never grant),
+conservative revocations (leadership transfer, confchange in flight,
+accumulated chaos tick-skew past RAFT_TPU_LEASE_MARGIN), the randomized
+safety property (whenever a lane holds a lease it is a transfer-free,
+confchange-free leader within the skew budget), the diet-v2 uint16
+round-trip, and pallas K>1 tile bit-identity.
+
+Serve plane: the coalescer->router lease fast path answers batched GETs in
+ONE round off the leader lease (vs 3 for the ReadIndex pipeline), bounces
+stale (term, epoch) snapshots back to ReadIndex, counts both paths into
+the metrics planes, and never serves a stale read under a skew storm (the
+floor oracle: every read's answered index >= the highest index any write
+to its group had already notified when the read was submitted).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu import confchange as ccm
+from raft_tpu.chaos.device import probability
+from raft_tpu.config import Shape
+from raft_tpu.ops import fused
+from raft_tpu.ops import lease as lsmod
+from raft_tpu.ops.fused import FusedCluster
+from raft_tpu.serve import Rejected, ServeLoop
+from raft_tpu.types import StateType
+
+V = 3
+G = 4
+N = G * V
+
+
+def _shape(n_lanes=N, v=V):
+    return Shape(
+        n_lanes=n_lanes, max_peers=v, log_window=8, max_msg_entries=2,
+        max_inflight=2, max_read_index=2,
+    )
+
+
+def _cols(c, *names):
+    return {k: np.asarray(v) for k, v in c.state_columns(*names).items()}
+
+
+def _held(c):
+    s = _cols(c, "state", "lease_left")
+    return (s["lease_left"].astype(np.int32) > 0), (
+        s["state"] == int(StateType.LEADER)
+    )
+
+
+def _elect_all(c, tries=40):
+    hups = {l: True for l in range(0, c.g * c.v, c.v)}
+    c.run(1, ops=c.ops(hup=hups), do_tick=False)
+    for _ in range(tries):
+        if len(c.leader_lanes()) == c.g:
+            return
+        c.run(4, auto_propose=True)
+    assert len(c.leader_lanes()) == c.g, "elections did not converge"
+
+
+# -- elision ---------------------------------------------------------------
+
+
+def test_elided_by_default(monkeypatch):
+    """No env -> no lease: None carry fields, no lease op traced, exactly
+    7 fewer carry leaves than a lease-on twin."""
+    monkeypatch.delenv("RAFT_TPU_LEASE", raising=False)
+    c = FusedCluster(G, V, seed=3, shape=_shape())
+    assert c.state.lease_left is None
+    assert c.lease_stats() is None
+    calls0 = lsmod.kernel_calls()
+    c.run(6, auto_propose=True)
+    assert lsmod.kernel_calls() == calls0
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    on = FusedCluster(G, V, seed=3, shape=_shape())
+    assert on.state.lease_left is not None
+    n_off = len(jax.tree_util.tree_leaves(c.state))
+    n_on = len(jax.tree_util.tree_leaves(on.state))
+    assert n_on == n_off + len(lsmod.LEASE_STATE_FIELDS)
+
+
+@pytest.mark.slow
+def test_grant_requires_check_quorum(monkeypatch):
+    """check_quorum is the follower half of the safety argument (in-lease
+    vote rejection): with it off — the LaneConfig default — the plane
+    must never grant, only count nothing."""
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    c = FusedCluster(G, V, seed=5, shape=_shape())
+    _elect_all(c)
+    c.run(20, auto_propose=True)
+    held, _ = _held(c)
+    assert not held.any()
+    assert c.lease_stats()["lease_grants"] == 0
+
+
+# -- grant / renew / revoke ------------------------------------------------
+
+
+def test_grant_renew_and_transfer_revocation(monkeypatch):
+    """Stable leaders under check_quorum grant and keep renewing; a
+    leadership transfer revokes the moment lead_transferee is set, and
+    the new leader's grant bumps the epoch."""
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    c = FusedCluster(G, V, seed=7, shape=_shape(), check_quorum=True)
+    _elect_all(c)
+    c.run(6, auto_propose=True)
+    held, leader = _held(c)
+    assert (held == (held & leader)).all() and held.sum() == G
+    s0 = c.lease_stats()
+    assert s0["lease_grants"] >= G and s0["lease_revocations"] == 0
+    c.run(4, auto_propose=True)
+    assert c.lease_stats()["lease_renewals"] > s0["lease_renewals"]
+
+    # transfer group 0's lease-holding leader to another member: the
+    # TRANSFER campaign bypasses the in-lease vote rejection, and the
+    # lease must fall with lead_transferee, not with the election result
+    lead0 = [l for l in c.leader_lanes() if l // V == 0][0]
+    epoch0 = int(np.asarray(c.state.lease_epoch)[lead0])
+    target_id = (lead0 % V + 1) % V + 1  # another slot's raft id
+    c.run(1, ops=c.ops(transfer_to={lead0: target_id}), do_tick=False)
+    assert int(np.asarray(c.state.lease_left)[lead0]) == 0
+    s1 = c.lease_stats()
+    assert s1["lease_revocations"] > s0["lease_revocations"]
+    c.run(30, auto_propose=True)
+    new_lead = [l for l in c.leader_lanes() if l // V == 0][0]
+    assert new_lead != lead0
+    held, _ = _held(c)
+    assert held[new_lead]
+    # the new holder's grant opened a new epoch
+    assert int(np.asarray(c.state.lease_epoch)[new_lead]) != epoch0 or (
+        int(np.asarray(c.state.lease_epoch)[lead0]) == epoch0
+    )
+    c.check_no_errors()
+
+
+@pytest.mark.slow
+def test_skew_revocation_and_regrant(monkeypatch):
+    """Chaos tick skew accumulates across renewals (lease_skew only resets
+    on grant/revoke) until it crosses the margin and revokes; healing the
+    clock re-grants with a bumped epoch."""
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "1")
+    c = FusedCluster(G, V, seed=9, shape=_shape(), check_quorum=True)
+    _elect_all(c)
+    c.run(6, auto_propose=True)
+    held, _ = _held(c)
+    assert held.sum() == G
+    epochs0 = np.asarray(c.state.lease_epoch).copy()
+    c.set_chaos(tick_skew_num=int(probability(1.0)))  # every tick skips
+    c.run(12, auto_propose=True)
+    s = c.lease_stats()
+    assert s["lease_skew_revocations"] > 0
+    c.set_chaos(tick_skew_num=0)
+    c.run(12, auto_propose=True)
+    held, _ = _held(c)
+    assert held.sum() > 0
+    re_granted = np.asarray(c.state.lease_epoch) != epochs0
+    assert (held & ~re_granted).sum() == 0  # every live lease is a NEW epoch
+    c.check_no_errors()
+
+
+@pytest.mark.slow
+def test_confchange_revokes(monkeypatch):
+    """An in-flight membership change revokes (the quorum the grant was
+    computed over may no longer be the voter set); the lease returns once
+    the change settles."""
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    v = 4
+    shape = Shape(n_lanes=2 * v, max_peers=v, log_window=32,
+                  max_msg_entries=2, max_inflight=2)
+    c = FusedCluster(2, v, seed=7, shape=shape, learner_ids=(4,),
+                     check_quorum=True)
+    hups = {l: True for l in range(0, c.g * c.v, c.v)}
+    c.run(1, ops=c.ops(hup=hups), do_tick=False)
+    c.run(8, auto_propose=True)
+    assert len(c.leader_lanes()) == 2
+    c.run(6, auto_propose=True)
+    held, _ = _held(c)
+    assert held.sum() == 2
+    s0 = c.lease_stats()
+    ch = c.conf_changer()
+    cc = ccm.ConfChange(type=int(ccm.ConfChangeType.ADD_NODE), node_id=4)
+    accepted = ch.propose(cc)
+    assert len(accepted) == 2
+    # pendingConfIndex > applied right after the propose round: revoked
+    held, _ = _held(c)
+    assert not held.any()
+    assert c.lease_stats()["lease_revocations"] > s0["lease_revocations"]
+    ch.settle(auto_propose=True)
+    c.run(8, auto_propose=True)
+    held, _ = _held(c)
+    assert held.sum() == 2  # settled config grants again
+    c.check_no_errors()
+
+
+# -- randomized safety property --------------------------------------------
+
+
+def test_randomized_lease_safety(monkeypatch):
+    """Property soak: random campaigns, leadership transfers and chaos
+    tick skew for 150 rounds; after EVERY round, any lane holding a lease
+    is a leader with no transfer pending, no confchange in flight, and
+    accumulated skew within the margin — and epochs never move backward.
+    (lease_round computes on the post-round state, so the invariant must
+    hold exactly at every round boundary, not just eventually.)"""
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "1")
+    c = FusedCluster(G, V, seed=11, shape=_shape(), check_quorum=True)
+    rng = np.random.default_rng(42)
+    margin = lsmod.lease_margin()
+    last_epoch = np.zeros(N, np.int64)
+    for rnd in range(150):
+        kw = {}
+        roll = rng.random()
+        if roll < 0.06:
+            kw["hup"] = {int(rng.integers(N)): True}
+        elif roll < 0.12:
+            leaders = list(c.leader_lanes())
+            if leaders:
+                lane = int(leaders[int(rng.integers(len(leaders)))])
+                kw["transfer_to"] = {lane: int(rng.integers(1, V + 1))}
+        if rng.random() < 0.1:
+            c.set_chaos(tick_skew_num=int(probability(0.5)))
+        elif rng.random() < 0.3:
+            c.set_chaos(tick_skew_num=0)
+        ops = c.ops(**kw) if kw else None
+        c.run(1, ops=ops, auto_propose=True)
+        s = _cols(
+            c, "state", "lease_left", "lease_epoch", "lease_skew",
+            "lead_transferee", "pending_conf_index", "applied",
+        )
+        held = s["lease_left"].astype(np.int32) > 0
+        if held.any():
+            assert (s["state"][held] == int(StateType.LEADER)).all(), rnd
+            assert (s["lead_transferee"][held] == 0).all(), rnd
+            assert (
+                s["pending_conf_index"][held] <= s["applied"][held]
+            ).all(), rnd
+            assert (s["lease_skew"][held].astype(np.int32) <= margin).all(), rnd
+        ep = s["lease_epoch"].astype(np.int64)
+        assert (ep >= last_epoch).all(), rnd  # wrap unreachable in 150 rounds
+        last_epoch = ep
+    c.check_no_errors()
+
+
+# -- diet round-trip -------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_diet_roundtrip(monkeypatch):
+    """Under diet-v2 the countdown/epoch/skew columns ride the carry as
+    uint16 (bounded by election_tick and EPOCH_WRAP, so the cast is
+    exact) while the monotone counters stay int32; pack(unpack(s)) is the
+    identity and a running lease survives the cycle bit-for-bit."""
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    monkeypatch.setenv("RAFT_TPU_DIET", "1")
+    from raft_tpu.state import pack_state, unpack_state
+
+    c = FusedCluster(G, V, seed=13, shape=_shape(), check_quorum=True)
+    assert c.state.lease_left.dtype == np.uint16
+    assert c.state.lease_epoch.dtype == np.uint16
+    assert c.state.lease_skew.dtype == np.uint16
+    assert c.state.lease_grants.dtype == np.int32
+    _elect_all(c)
+    c.run(8, auto_propose=True)
+    held, _ = _held(c)
+    assert held.sum() == G and c.lease_stats()["lease_grants"] >= G
+    wide = unpack_state(c.state)
+    assert wide.lease_left.dtype == np.int32
+    back = pack_state(wide)
+    for f in lsmod.LEASE_STATE_FIELDS:
+        a, b = np.asarray(getattr(c.state, f)), np.asarray(getattr(back, f))
+        assert a.dtype == b.dtype and (a == b).all(), f
+    c.check_no_errors()
+
+
+def test_wipe_volatile_keeps_epoch_and_counters(monkeypatch):
+    """Restart wipe: the countdown and skew die with the process (a
+    restarted lane must re-earn its lease) but the epoch and the event
+    counters are durable history."""
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    from raft_tpu.state import wipe_volatile
+
+    c = FusedCluster(G, V, seed=15, shape=_shape(), check_quorum=True)
+    _elect_all(c)
+    c.run(8, auto_propose=True)
+    held, _ = _held(c)
+    assert held.any()
+    mask = np.ones(N, bool)
+    st = wipe_volatile(c.state, jax.numpy.asarray(mask))
+    assert (np.asarray(st.lease_left) == 0).all()
+    assert (np.asarray(st.lease_skew) == 0).all()
+    assert (
+        np.asarray(st.lease_epoch) == np.asarray(c.state.lease_epoch)
+    ).all()
+    assert (
+        np.asarray(st.lease_grants) == np.asarray(c.state.lease_grants)
+    ).all()
+
+
+# -- pallas K>1 bit-identity -----------------------------------------------
+
+
+def test_pallas_tile_bit_identity(monkeypatch):
+    """The lease columns ride the megakernel carry: 2 lane tiles, 24
+    rounds from an elected state with live leases — every lease field
+    (and everything else) bit-identical to the XLA engine."""
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    from raft_tpu.ops import pallas_round as plr
+
+    c = FusedCluster(G, V, seed=7, shape=_shape(), check_quorum=True)
+    _elect_all(c)
+    c.run(6, auto_propose=True)
+    assert c.lease_stats()["lease_grants"] > 0  # live lease in the window
+    kw = dict(
+        v=V, n_rounds=24, do_tick=True, auto_propose=True,
+        auto_compact_lag=4, ops_first_round_only=True,
+        metrics=None, chaos=None,
+    )
+    ref = fused._fused_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute, straddle=None, **kw
+    )
+    got = plr._pallas_rounds_nodonate_jit(
+        c.state, c.fab, c._no_ops, c.mute,
+        tile_lanes=2 * V, interpret=True, **kw
+    )
+    la = jax.tree_util.tree_leaves_with_path(ref[0])
+    lb = jax.tree_util.tree_leaves(got[0])
+    assert len(la) == len(lb)
+    for (path, x), y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), path
+    # the compared trajectory renewed leases (the fields are live, not
+    # just carried)
+    assert int(np.asarray(ref[0].lease_renewals).sum()) > int(
+        np.asarray(c.state.lease_renewals).sum()
+    )
+
+
+# -- serve plane -----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lease_loop():
+    mp = pytest.MonkeyPatch()
+    mp.setenv("RAFT_TPU_LEASE", "1")
+    mp.setenv("RAFT_TPU_METRICS", "1")
+    sl = ServeLoop(
+        FusedCluster(G, V, seed=21, shape=_shape(), check_quorum=True),
+        read_retry_rounds=6,
+    )
+    sl.bootstrap()
+    yield sl
+    mp.undo()
+
+
+def test_serve_lease_read_single_round(lease_loop):
+    """Batched GETs on a lease-holding leader notify ONE round after
+    submit (ReadIndex pays 3), through the unchanged egress bundle."""
+    sl = lease_loop
+    s = sl.open_session("rd-x")
+    t = sl.put(s, "k", "v1")
+    assert sl.drain(64) and t.done
+    sl.step(6)  # let the lease grant/renew after bootstrap traffic
+    sl.flush()
+    lats = []
+    for _ in range(8):
+        rt = sl.get(s, "k")
+        assert not isinstance(rt, Rejected)
+        sl.step()
+        sl.flush()
+        assert rt.done and rt.value == "v1"
+        lats.append(rt.notify_round - rt.submit_round)
+    assert lats.count(1) >= 6  # p50 == 1 round (first may race the grant)
+    m = sl.metrics_snapshot()["counters"]
+    assert m.get("lease_reads_served", 0) >= 6
+    assert m.get("notify_violations", 0) == 0
+
+
+def test_serve_lease_counters_flow(lease_loop):
+    """Engine counters fold into the cluster metrics snapshot, mirror
+    onto metrics/host.py LEASE_EVENTS, and the read-notify histogram
+    renders as its own Prometheus family."""
+    from raft_tpu.metrics.host import LEASE_EVENTS, prometheus_text
+
+    sl = lease_loop
+    es = sl.engine_snapshot()["counters"]
+    assert es["lease_grants"] >= 1
+    assert es["lease_renewals"] > 0
+    assert LEASE_EVENTS.get("lease_grants") == es["lease_grants"]
+    served = sl.metrics_snapshot()["counters"].get("lease_reads_served", 0)
+    assert LEASE_EVENTS.get("lease_reads_served") == served > 0
+    txt = prometheus_text(sl.metrics_snapshot())
+    assert "lease_reads_served" in txt
+    assert "read_notify_latency_rounds" in txt
+
+
+def test_serve_lease_epoch_bounce(lease_loop):
+    """A (term, epoch) snapshot that no longer matches at serve time —
+    revoke/re-grant between routing and the bundle — falls back to
+    ReadIndex instead of serving possibly-stale state."""
+    from raft_tpu.serve.coalescer import ReadTicket
+
+    sl = lease_loop
+    r = sl.router
+    s = sl.open_session("rd-bounce")
+    g = s.group
+    view = r.views[g]
+    glane = view.leader_lane
+    assert glane >= 0
+    rt = ReadTicket(s.id, g, "k", sl.round)
+    before = sl.metrics_snapshot()["counters"].get("lease_reads_fallback", 0)
+    # route against the LIVE columns, then age the snapshot by one epoch
+    assert r.route_lease_reads(view, [rt])
+    tickets, term0, epoch0 = r.lease_pending[g][-1]
+    r.lease_pending[g][-1] = (tickets, term0, epoch0 - 1)
+    block = glane // r.lanes_per_block
+    r._serve_lease_pending(block, block * r.lanes_per_block)
+    after = sl.metrics_snapshot()["counters"].get("lease_reads_fallback", 0)
+    assert after == before + 1
+    assert rt in sl.coalescer._read_wait(g)  # re-queued for ReadIndex
+    sl.coalescer._read_wait(g).remove(rt)  # never admitted: drop it
+
+
+def test_serve_lease_stale_term_refused(lease_loop):
+    """route_lease_reads refuses when the router's view term moved past
+    the cached bundle columns (no pending entry, no counter)."""
+    sl = lease_loop
+    r = sl.router
+    g = sl.open_session("rd-term").group
+    view = r.views[g]
+    t0 = view.term
+    view.term = t0 + 1
+    try:
+        assert not r.route_lease_reads(view, [object()])
+    finally:
+        view.term = t0
+
+
+@pytest.mark.slow
+def test_serve_lease_floor_oracle_under_skew(monkeypatch):
+    """Randomized staleness soak: interleaved puts and lease-served GETs
+    through skew storms — every completed read answers at an index >= the
+    highest index any write to its group had notified BEFORE the read was
+    submitted (the client-observable linearizability floor), the defense
+    actually fires (skew revocations > 0), and the KV digest still
+    matches the scalar twin."""
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "1")
+    c = FusedCluster(G, V, seed=23, shape=_shape(), check_quorum=True)
+    sl = ServeLoop(c, read_retry_rounds=6)
+    sl.bootstrap()
+    sessions = [sl.open_session(f"fl-{i}") for i in range(G)]
+    floor = {s.group: 0 for s in sessions}
+    writes, pending = [], []
+    stale = served = 0
+
+    def poll():
+        nonlocal stale, served
+        for t in [w for w in writes if w.done and w.index is not None]:
+            floor[t.group] = max(floor[t.group], t.index)
+            writes.remove(t)
+        for rt, f0 in [p for p in pending if p[0].done]:
+            pending.remove((rt, f0))
+            served += 1
+            if rt.index is None or rt.index < f0:
+                stale += 1
+
+    rng = np.random.default_rng(7)
+    for rnd in range(90):
+        if rnd % 30 == 10:
+            c.set_chaos(tick_skew_num=int(probability(0.8)))
+        elif rnd % 30 == 18:
+            c.set_chaos(tick_skew_num=0)
+        for s in sessions:
+            if rng.random() < 0.5:
+                t = sl.put(s, f"k{int(rng.integers(4))}", rnd)
+                if not isinstance(t, Rejected):
+                    writes.append(t)
+            rt = sl.get(s, "k0")
+            if not isinstance(rt, Rejected):
+                pending.append((rt, floor[s.group]))
+        sl.step()
+        sl.flush()
+        poll()
+    c.set_chaos(tick_skew_num=0)
+    for _ in range(60):
+        sl.step()
+        sl.flush()
+        poll()
+    assert stale == 0 and served > 0
+    assert sl.outstanding == 0 and not pending
+    assert c.lease_stats()["lease_skew_revocations"] > 0
+    m = sl.metrics_snapshot()["counters"]
+    assert m.get("lease_reads_served", 0) > 0  # the fast path ran
+    assert sl.digest() == sl.twin_digest()
+
+
+@pytest.mark.slow
+def test_serve_lease_blocked_cluster(monkeypatch):
+    """K=2 resident blocks: the router's lease columns are cached per
+    block and leader lanes resolve through the block-local offset — reads
+    in BOTH blocks serve off the lease in one round."""
+    monkeypatch.setenv("RAFT_TPU_LEASE", "1")
+    from raft_tpu.scheduler import BlockedFusedCluster
+
+    sl = ServeLoop(
+        BlockedFusedCluster(4, V, block_groups=2, seed=25,
+                            shape=_shape(2 * V), check_quorum=True),
+        read_retry_rounds=6,
+    )
+    sl.bootstrap()
+    by_group = {}
+    i = 0
+    while len(by_group) < 4:
+        s = sl.open_session(f"bl-{i}")
+        by_group.setdefault(s.group, s)
+        i += 1
+    for g, s in by_group.items():
+        t = sl.put(s, "k", f"v{g}")
+        assert not isinstance(t, Rejected)
+    assert sl.drain(64)
+    sl.step(6)
+    sl.flush()
+    lats = {g: [] for g in by_group}
+    for _ in range(6):
+        rts = {g: sl.get(s, "k") for g, s in by_group.items()}
+        sl.step()
+        sl.flush()
+        for g, rt in rts.items():
+            assert rt.done and rt.value == f"v{g}"
+            lats[g].append(rt.notify_round - rt.submit_round)
+    for g, ls in lats.items():
+        assert ls.count(1) >= 4, (g, ls)
+    m = sl.metrics_snapshot()["counters"]
+    assert m.get("lease_reads_served", 0) >= 16
+
+
+# -- narration -------------------------------------------------------------
+
+
+def test_explain_lease_narration():
+    from raft_tpu.trace.assemble import explain
+
+    log = [
+        (5, 0, "lease_reads_served", 3),
+        (6, 1, "lease_reads_served", 9),  # other group: filtered out
+        (7, 0, "lease_reads_fallback", 2),
+    ]
+    lines = explain(0, lease=log)
+    txt = "\n".join(lines)
+    assert "served 3 read(s) from the leader lease" in txt
+    assert "2 read(s) fell back to ReadIndex" in txt
+    assert "9 read(s)" not in txt
+
+
+def test_record_lease_stats_partial_keys():
+    """The engine half sets only the device-derived keys; the serve-plane
+    halves are host-owned and must not be zeroed by an engine pull."""
+    from raft_tpu.metrics.host import LEASE_EVENTS, record_lease_stats
+
+    LEASE_EVENTS.inc("lease_reads_served", 5)
+    served0 = LEASE_EVENTS.get("lease_reads_served")
+    record_lease_stats({"lease_grants": 3, "lease_renewals": 8})
+    assert LEASE_EVENTS.get("lease_grants") == 3
+    assert LEASE_EVENTS.get("lease_reads_served") == served0
